@@ -1,0 +1,39 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet-1.5
+capabilities (`import mxnet_tpu as mx` is the intended spelling).
+
+Re-designed from scratch for TPU (see SURVEY.md at the repo root): compute
+lowers to XLA through jax, captured graphs compile to cached executables,
+device placement is GSPMD sharding, and distributed sync is XLA collectives
+over ICI/DCN. API parity follows the reference `python/mxnet/__init__.py`.
+"""
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+
+from . import autograd
+from . import random
+from .random import seed
+
+from . import engine
+from . import runtime
+
+from . import initializer
+from . import initializer as init
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import monitor
+from . import profiler
+from . import util
+from . import visualization
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from .name import NameManager

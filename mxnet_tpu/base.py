@@ -1,0 +1,198 @@
+"""Core shared pieces: error type, dtype maps, registries, env config.
+
+TPU-native re-design of the reference's binding base
+(`python/mxnet/base.py`, `3rdparty/dmlc-core` GetEnv / Parameter reflection).
+There is no ctypes ABI here by design: the "C API" layer of the reference
+(`src/c_api/`, ~212 functions) existed to bridge Python to a C++ kernel
+runtime; in this framework the kernel runtime *is* XLA, reached through jax.
+The native C++ runtime (engine / recordio / shm storage in `src/`) is loaded
+lazily via :mod:`mxnet_tpu.lib` instead.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "data_dir",
+    "getenv",
+    "setenv",
+]
+
+
+class MXNetError(RuntimeError):
+    """Default error raised by mxnet_tpu (name kept for API parity with the
+    reference's ``mxnet.base.MXNetError``, `python/mxnet/base.py:78`)."""
+
+
+class NotImplementedForSymbol(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__()
+        self.function = function.__name__
+        self.alias = alias
+
+    def __str__(self):
+        return f"Function {self.function} is not implemented for Symbol and only available in NDArray."
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__()
+        self.function = function.__name__
+        self.alias = alias
+
+    def __str__(self):
+        return f"Function {self.function} is not supported for SparseNDArray."
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# ---------------------------------------------------------------------------
+# dtype handling.  The reference maps type-flag ints across the C ABI
+# (`python/mxnet/base.py` _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP); we keep the same
+# flag numbering for serialization-format compatibility.
+# ---------------------------------------------------------------------------
+
+_DTYPE_NP_TO_MX = {
+    None: -1,
+    _np.float32: 0,
+    _np.float64: 1,
+    _np.float16: 2,
+    _np.uint8: 3,
+    _np.int32: 4,
+    _np.int8: 5,
+    _np.int64: 6,
+    _np.bool_: 7,
+}
+
+_DTYPE_MX_TO_NP = {
+    -1: None,
+    0: _np.float32,
+    1: _np.float64,
+    2: _np.float16,
+    3: _np.uint8,
+    4: _np.int32,
+    5: _np.int8,
+    6: _np.int64,
+    7: _np.bool_,
+}
+
+# TPU-native extension: bfloat16 is first-class on the MXU.
+try:  # pragma: no cover - ml_dtypes ships with jax
+    import ml_dtypes as _ml_dtypes
+
+    _DTYPE_NP_TO_MX[_ml_dtypes.bfloat16] = 12
+    _DTYPE_MX_TO_NP[12] = _ml_dtypes.bfloat16
+    bfloat16 = _np.dtype(_ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+
+_STORAGE_TYPE_STR_TO_ID = {"undefined": -1, "default": 0, "row_sparse": 1, "csr": 2}
+_STORAGE_TYPE_ID_TO_STR = {v: k for k, v in _STORAGE_TYPE_STR_TO_ID.items()}
+
+
+def np_dtype(dtype):
+    """Canonicalize a dtype-ish value to a numpy dtype (bfloat16-aware).
+    64-bit types narrow to 32-bit unless jax x64 is enabled (jax semantics;
+    the reference's int64 large-tensor build maps to enabling x64)."""
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16" and bfloat16 is not None:
+        return bfloat16
+    dt = _np.dtype(dtype)
+    try:
+        from jax import config as _jcfg
+
+        x64 = _jcfg.jax_enable_x64
+    except Exception:
+        x64 = False
+    if not x64:
+        if dt == _np.int64:
+            return _np.dtype(_np.int32)
+        if dt == _np.float64:
+            return _np.dtype(_np.float32)
+        if dt == _np.uint64:
+            return _np.dtype(_np.uint32)
+    return dt
+
+
+# ---------------------------------------------------------------------------
+# Env config registry: the TPU-era answer to dmlc::GetEnv + docs/faq/env_var.md.
+# Knobs keep their MXNET_* names where they still make sense.
+# ---------------------------------------------------------------------------
+
+_env_lock = threading.Lock()
+_env_registry = {}
+
+
+def register_env(name, default, doc=""):
+    with _env_lock:
+        _env_registry[name] = (default, doc)
+    return name
+
+
+def getenv(name, default=None):
+    if default is None and name in _env_registry:
+        default = _env_registry[name][0]
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if isinstance(default, bool):
+        return val not in ("0", "false", "False", "")
+    if isinstance(default, int):
+        return int(val)
+    if isinstance(default, float):
+        return float(val)
+    return val
+
+
+def setenv(name, value):
+    os.environ[name] = str(value)
+
+
+def list_env():
+    """All registered config knobs → (default, doc)."""
+    return dict(_env_registry)
+
+
+register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", "host-side engine impl")
+register_env("MXNET_CPU_WORKER_NTHREADS", 1, "host worker threads")
+register_env("MXNET_EXEC_BULK_EXEC_INFERENCE", True, "fuse inference graphs (always on: XLA)")
+register_env("MXNET_EXEC_BULK_EXEC_TRAIN", True, "fuse training graphs (always on: XLA)")
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, "kept for API parity")
+register_env("MXNET_BACKWARD_DO_MIRROR", False, "rematerialize activations (jax.checkpoint)")
+register_env("MXNET_SAFE_ACCUMULATION", True, "accumulate reductions in fp32")
+
+
+def data_dir():
+    """Data directory used by gluon datasets (parity: `python/mxnet/base.py data_dir`)."""
+    return os.getenv("MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet"))
+
+
+# ---------------------------------------------------------------------------
+# Generic registry helper (parity: dmlc Registry / python/mxnet/registry.py)
+# ---------------------------------------------------------------------------
+
+
+def _as_list(obj):
+    if obj is None:
+        return []
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]
+
+
+class classproperty:
+    def __init__(self, f):
+        self.f = f
+
+    def __get__(self, obj, owner):
+        return self.f(owner)

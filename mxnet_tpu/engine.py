@@ -1,0 +1,53 @@
+"""Host-side execution engine.
+
+Parity: `src/engine/` (NaiveEngine / ThreadedEnginePerDevice) + Python
+`python/mxnet/engine.py` (bulk scope).
+
+TPU-native redesign (SURVEY.md §7): **on-device** ordering/fusion is the
+compiled XLA program — jax dispatches asynchronously and XLA's runtime owns
+device streams, so the reference's dependency-variable scheduler is not
+re-implemented for compute. What remains host-side is ordering of IO,
+checkpoint and collective-issue work; that engine lives in the native C++
+runtime (``src/engine.cc`` via :mod:`mxnet_tpu.lib`) with this module
+exposing the reference's Python surface (bulk, engine-type query).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .base import getenv
+
+__all__ = ["bulk", "engine_type", "push", "wait_all"]
+
+
+def engine_type():
+    return getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Parity `mx.engine.bulk`: a no-op scope on TPU — XLA whole-program
+    compilation is the limit case of engine bulking (`threaded_engine.h:413`)."""
+    yield
+
+
+def push(fn, *args, **kwargs):
+    """Push host-side async work onto the native engine (falls back to inline
+    execution when the native library is unavailable)."""
+    from . import lib
+
+    eng = lib.native_engine()
+    if eng is not None:
+        return eng.push(fn, args, kwargs)
+    fn(*args, **kwargs)
+    return None
+
+
+def wait_all():
+    from . import lib
+    from .ndarray import waitall
+
+    eng = lib.native_engine()
+    if eng is not None:
+        eng.wait_all()
+    waitall()

@@ -1,0 +1,67 @@
+"""Loader for the native C++ runtime (librt_tpu.so).
+
+The reference loads libmxnet.so via ctypes (`python/mxnet/base.py`); here the
+native library provides the host-side runtime only (dependency engine for
+IO/checkpoint ordering, RecordIO reader, shared-memory arena) — compute is
+XLA. Everything degrades gracefully to pure-python fallbacks when the .so
+has not been built (`make -C src`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+_lib = None
+_lib_tried = False
+_engine = None
+_lock = threading.Lock()
+
+_LIB_NAMES = ("librt_tpu.so",)
+
+
+def _find_lib():
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.path.join(here, "_native"),
+        os.path.join(os.path.dirname(here), "build"),
+        os.path.join(os.path.dirname(here), "src"),
+    ]
+    for d in candidates:
+        for n in _LIB_NAMES:
+            p = os.path.join(d, n)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def get_lib():
+    global _lib, _lib_tried
+    with _lock:
+        if not _lib_tried:
+            _lib_tried = True
+            path = _find_lib()
+            if path:
+                try:
+                    _lib = ctypes.CDLL(path)
+                except OSError:
+                    _lib = None
+    return _lib
+
+
+def native_available():
+    return get_lib() is not None
+
+
+def native_engine():
+    """Python-facing handle to the native host engine; None if not built."""
+    global _engine
+    lib = get_lib()
+    if lib is None:
+        return None
+    with _lock:
+        if _engine is None:
+            from .native_engine import NativeEngine
+
+            _engine = NativeEngine(lib)
+    return _engine

@@ -1,0 +1,646 @@
+"""NDArray — the framework's value type.
+
+Parity: `include/mxnet/ndarray.h:82` + `python/mxnet/ndarray/ndarray.py`.
+
+TPU-native redesign: an NDArray wraps a `jax.Array`. The reference's
+engine-variable machinery (read/write vars, `WaitToRead/WaitToWrite`) is
+subsumed by XLA's async dispatch — every jax op is enqueued asynchronously
+and `wait_to_read` maps to `block_until_ready`. Mutation (`x[:] = v`,
+``out=`` kwargs, optimizer updates) is rendered functionally: the wrapper
+swaps its underlying buffer, which is exactly the version-bump the
+reference's `ThreadedVar` performed (`threaded_engine.h:119`).
+
+Divergence (documented): slicing returns a copy-on-write functional view,
+not an aliased buffer; writes through a slice do not propagate to the
+parent (XLA buffers are immutable). `__setitem__` on the parent works.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype, integer_types, numeric_types
+from ..context import Context, current_context, cpu
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange", "concatenate", "waitall"]
+
+
+def _dtype_name(dt):
+    dt = _np.dtype(dt)
+    name = dt.name
+    return name
+
+
+class NDArray:
+    __slots__ = (
+        "_data", "_ctx", "grad", "grad_req", "_ag_marked", "_stype",
+        "__weakref__",
+    )
+
+    def __init__(self, data, ctx=None, stype="default"):
+        self._data = data
+        self._ctx = ctx if ctx is not None else _ctx_of(data)
+        self.grad = None
+        self.grad_req = "null"
+        self._ag_marked = False
+        self._stype = stype
+
+    # -- basic properties ---------------------------------------------------
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def handle(self):
+        return self._data  # "handle" is the jax array itself
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        return f"\n{_np.asarray(self._data)}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    def __str__(self):
+        return self.__repr__()
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous.")
+
+    # -- conversion ---------------------------------------------------------
+
+    def asnumpy(self):
+        """Blocking copy to host (reference `WaitToRead` + copy)."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def astype(self, dtype, copy=True):
+        return _invoke("Cast", self, dtype=_dtype_name(np_dtype(dtype)))
+
+    def as_in_context(self, ctx):
+        if ctx == self._ctx:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device), ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other._ctx.jax_device)
+            return other
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def copy(self):
+        return NDArray(jnp.array(self._data), self._ctx)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+
+        return _sp.cast_storage(self, stype)
+
+    # -- engine-var parity --------------------------------------------------
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # -- autograd -----------------------------------------------------------
+
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate gradient buffer (parity `ndarray.py attach_grad`)."""
+        self.grad = NDArray(jnp.zeros(self.shape, self.dtype), self._ctx)
+        self.grad_req = grad_req
+        self._ag_marked = True
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    # -- shape ops (methods) ------------------------------------------------
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if not shape:
+            shape = kwargs.get("shape")
+        return _invoke("Reshape", self, shape=shape, reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return _invoke("Reshape", self, shape=other.shape)
+
+    def expand_dims(self, axis):
+        return _invoke("expand_dims", self, axis=axis)
+
+    def squeeze(self, axis=None):
+        return _invoke("squeeze", self, axis=axis)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return _invoke("transpose", self, axes=axes if axes else None)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self):
+        return _invoke("Flatten", self)
+
+    def flip(self, axis):
+        return _invoke("reverse", self, axis=axis)
+
+    def tile(self, reps):
+        return _invoke("tile", self, reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return _invoke("repeat", self, repeats=repeats, axis=axis)
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke("SwapAxis", self, dim1=dim1, dim2=dim2)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke("SliceChannel", self, num_outputs=num_outputs, axis=axis,
+                       squeeze_axis=squeeze_axis)
+
+    def slice(self, begin, end, step=None):
+        return _invoke("slice", self, begin=begin, end=end, step=step)
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke("slice_axis", self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke("take", self, indices, axis=axis, mode=mode)
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return _invoke("pick", self, index, axis=axis, keepdims=keepdims)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return _invoke("one_hot", self, depth=depth, on_value=on_value, off_value=off_value,
+                       dtype=dtype)
+
+    def broadcast_to(self, shape):
+        return _invoke("broadcast_to", self, shape=shape)
+
+    def broadcast_like(self, other):
+        return _invoke("broadcast_like", self, other)
+
+    def diag(self, k=0):
+        return _invoke("diag", self, k=k)
+
+    # -- reductions ---------------------------------------------------------
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _invoke("sum", self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _invoke("mean", self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return _invoke("prod", self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return _invoke("max", self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return _invoke("min", self, axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _invoke("norm", self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke("argmax", self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke("argmin", self, axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _invoke("argsort", self, axis=axis, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _invoke("sort", self, axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _invoke("topk", self, axis=axis, k=k, ret_typ=ret_typ, is_ascend=is_ascend)
+
+    def clip(self, a_min, a_max):
+        return _invoke("clip", self, a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return _invoke("abs", self)
+
+    def sign(self):
+        return _invoke("sign", self)
+
+    def exp(self):
+        return _invoke("exp", self)
+
+    def log(self):
+        return _invoke("log", self)
+
+    def sqrt(self):
+        return _invoke("sqrt", self)
+
+    def square(self):
+        return _invoke("square", self)
+
+    def sigmoid(self):
+        return _invoke("sigmoid", self)
+
+    def tanh(self):
+        return _invoke("tanh", self)
+
+    def relu(self):
+        return _invoke("relu", self)
+
+    def softmax(self, axis=-1):
+        return _invoke("softmax", self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return _invoke("log_softmax", self, axis=axis)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _invoke("dot", self, other, transpose_a=transpose_a, transpose_b=transpose_b)
+
+    def as_nd_ndarray(self):
+        return self
+
+    # -- arithmetic ---------------------------------------------------------
+
+    def _binary(self, other, op, scalar_op, rop=None):
+        if isinstance(other, NDArray):
+            return _invoke(op, self, other)
+        if isinstance(other, numeric_types):
+            return _invoke(scalar_op, self, scalar=float(other))
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return _invoke("_rminus_scalar", self, scalar=float(o))
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return _invoke("_rdiv_scalar", self, scalar=float(o))
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return _invoke("_rmod_scalar", self, scalar=float(o))
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return _invoke("_rpower_scalar", self, scalar=float(o))
+
+    def __neg__(self):
+        return _invoke("negative", self)
+
+    def __abs__(self):
+        return _invoke("abs", self)
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._data = out._data
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._data = out._data
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._data = out._data
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._data = out._data
+        return self
+
+    # -- indexing -----------------------------------------------------------
+
+    def __getitem__(self, key):
+        key = _convert_index(key)
+        out = self._data[key]
+        return NDArray(out, self._ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, (_np.ndarray, list, tuple, float, int)):
+            value = jnp.asarray(value, dtype=self.dtype)
+        if isinstance(key, slice) and key == slice(None):
+            self._data = jnp.broadcast_to(value, self.shape).astype(self.dtype)
+            return
+        key = _convert_index(key)
+        self._data = self._data.at[key].set(value.astype(self.dtype) if hasattr(value, "astype") else value)
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # -- serialization ------------------------------------------------------
+
+    def save(self, fname):
+        from .utils import save
+
+        save(fname, self)
+
+
+def _convert_index(key):
+    if isinstance(key, NDArray):
+        return key._data.astype(jnp.int32)
+    if isinstance(key, tuple):
+        return tuple(_convert_index(k) for k in key)
+    if isinstance(key, _np.ndarray):
+        return key
+    return key
+
+
+def _ctx_of(data):
+    try:
+        dev = list(data.devices())[0]
+        if dev.platform == "cpu":
+            return cpu(dev.id)
+        from ..context import tpu
+
+        return tpu(_accel_index(dev))
+    except Exception:
+        return cpu(0)
+
+
+def _accel_index(dev):
+    import jax as _jax
+
+    accels = [d for d in _jax.devices() if d.platform != "cpu"]
+    for i, d in enumerate(accels):
+        if d == dev:
+            return i
+    return 0
+
+
+def _invoke(op_name, *args, **kwargs):
+    from .register import invoke_nd
+
+    return invoke_nd(op_name, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# creation helpers (parity: python/mxnet/ndarray/utils.py + ndarray.py)
+# ---------------------------------------------------------------------------
+
+
+def _place(jarr, ctx):
+    ctx = ctx if ctx is not None else current_context()
+    if ctx.device_type in ("cpu", "cpu_pinned", "cpu_shared") and _default_is_cpu():
+        return NDArray(jarr, ctx)
+    return NDArray(jax.device_put(jarr, ctx.jax_device), ctx)
+
+
+def _default_is_cpu():
+    return jax.default_backend() == "cpu"
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        dtype = dtype or source_array.dtype
+    else:
+        src = _np.asarray(source_array)
+        if dtype is None:
+            dtype = src.dtype if src.dtype != _np.float64 else _np.float32
+    return _place(jnp.asarray(src, dtype=np_dtype(dtype)), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    return _place(jnp.zeros(_shape_t(shape), dtype=np_dtype(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    return _place(jnp.ones(_shape_t(shape), dtype=np_dtype(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    return _place(jnp.full(_shape_t(shape), val, dtype=np_dtype(dtype)), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, infer_range=False, ctx=None, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return _place(out, ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _invoke("Concat", *arrays, dim=axis, num_args=len(arrays))
+
+
+def _shape_t(shape):
+    if isinstance(shape, integer_types):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+_PY_SCALAR_FN = {
+    "broadcast_add": lambda a, b: a + b, "broadcast_sub": lambda a, b: a - b,
+    "broadcast_mul": lambda a, b: a * b, "broadcast_div": lambda a, b: a / b,
+    "broadcast_mod": lambda a, b: a % b, "broadcast_power": lambda a, b: a ** b,
+    "broadcast_maximum": max, "broadcast_minimum": min,
+    "broadcast_hypot": lambda a, b: (a * a + b * b) ** 0.5,
+    "broadcast_equal": lambda a, b: float(a == b),
+    "broadcast_not_equal": lambda a, b: float(a != b),
+    "broadcast_greater": lambda a, b: float(a > b),
+    "broadcast_greater_equal": lambda a, b: float(a >= b),
+    "broadcast_lesser": lambda a, b: float(a < b),
+    "broadcast_lesser_equal": lambda a, b: float(a <= b),
+}
+
+
+def _ufunc_helper(lhs, rhs, fn_array, fn_scalar, rfn_scalar=None):
+    """Dispatch array/scalar combinations (parity `ndarray.py _ufunc_helper`)."""
+    from .register import invoke_nd
+
+    if isinstance(lhs, numeric_types):
+        if isinstance(rhs, numeric_types):
+            return _PY_SCALAR_FN[fn_array](lhs, rhs)
+        return invoke_nd(rfn_scalar or fn_scalar, rhs, scalar=float(lhs))
+    if isinstance(rhs, numeric_types):
+        return invoke_nd(fn_scalar, lhs, scalar=float(rhs))
+    return invoke_nd(fn_array, lhs, rhs)
+
+
+def maximum(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_maximum", "_maximum_scalar")
+
+
+def minimum(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_minimum", "_minimum_scalar")
+
+
+def power(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_power", "_power_scalar", "_rpower_scalar")
+
+
+def hypot(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_hypot", "_hypot_scalar")
+
+
+def add(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_add", "_plus_scalar")
+
+
+def subtract(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_sub", "_minus_scalar", "_rminus_scalar")
+
+
+def multiply(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_mul", "_mul_scalar")
+
+
+def divide(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_div", "_div_scalar", "_rdiv_scalar")
+
+
+def modulo(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_mod", "_mod_scalar", "_rmod_scalar")
+
+
+def equal(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_equal", "_equal_scalar")
+
+
+def not_equal(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_not_equal", "_not_equal_scalar")
+
+
+def greater(lhs, rhs):
+    # scalar-lhs mirrors to the opposite comparison: 2 > x  ==  x < 2
+    return _ufunc_helper(lhs, rhs, "broadcast_greater", "_greater_scalar", "_lesser_scalar")
+
+
+def greater_equal(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_greater_equal", "_greater_equal_scalar",
+                         "_lesser_equal_scalar")
+
+
+def lesser(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_lesser", "_lesser_scalar", "_greater_scalar")
+
+
+def lesser_equal(lhs, rhs):
+    return _ufunc_helper(lhs, rhs, "broadcast_lesser_equal", "_lesser_equal_scalar",
+                         "_greater_equal_scalar")
+
+
+def true_divide(lhs, rhs):
+    return divide(lhs, rhs)
+
+
+def waitall():
+    """Block until all async work completes (parity `mx.nd.waitall`)."""
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    try:
+        jax.block_until_ready(jnp.zeros(()))
+    except Exception:
+        pass
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
+
+
+def onehot_encode(indices, out):
+    res = _invoke("one_hot", indices, depth=out.shape[1])
+    out._data = res._data
+    return out

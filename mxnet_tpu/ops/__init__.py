@@ -1,0 +1,14 @@
+"""Operator library — importing this package registers all ops."""
+from . import registry
+from .registry import register, get_op, list_ops, invoke_raw
+
+from . import elemwise      # noqa: F401
+from . import broadcast     # noqa: F401
+from . import reduce        # noqa: F401
+from . import shape_ops     # noqa: F401
+from . import indexing      # noqa: F401
+from . import linalg        # noqa: F401
+from . import init_ops      # noqa: F401
+from . import random_ops    # noqa: F401
+from . import nn            # noqa: F401
+from . import optimizer_ops # noqa: F401

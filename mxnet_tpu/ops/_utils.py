@@ -1,0 +1,63 @@
+"""Shared helpers for op implementations."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def normalize_axis(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(normalize_axis(a, ndim) for a in axis)
+    axis = int(axis)
+    if axis < 0:
+        axis += ndim
+    if not 0 <= axis < max(ndim, 1):
+        raise ValueError(f"axis {axis} out of range for ndim {ndim}")
+    return axis
+
+
+def reduce_axes(axis, ndim, exclude=False):
+    """MXNet reduce-axis semantics: None → all; exclude=True inverts the set
+    (reference `src/operator/tensor/broadcast_reduce_op.h` ReduceAxesParam)."""
+    if axis is None or (isinstance(axis, (tuple, list)) and len(axis) == 0):
+        axes = tuple(range(ndim)) if not exclude else ()
+        return axes
+    if isinstance(axis, int):
+        axis = (axis,)
+    axes = tuple(sorted(a % ndim for a in axis))
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def as_tuple(v, n=None, name="param"):
+    """Parse MXNet-style Shape params: int | tuple | str '(1, 2)'."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        v = v.strip()
+        if v.startswith("(") or v.startswith("["):
+            v = v[1:-1]
+        v = tuple(int(x) for x in v.replace(",", " ").split() if x)
+    elif isinstance(v, (int, np.integer)):
+        v = (int(v),) if n is None else (int(v),) * n
+    else:
+        v = tuple(int(x) for x in v)
+    if n is not None and len(v) == 1:
+        v = v * n
+    return v
+
+
+def parse_bool(v):
+    if isinstance(v, str):
+        return v not in ("0", "false", "False", "")
+    return bool(v)
+
+
+def safe_acc_dtype(dtype):
+    """Accumulate low-precision reductions in fp32 (MXNET_SAFE_ACCUMULATION)."""
+    if dtype in (jnp.float16, jnp.bfloat16):
+        return jnp.float32
+    return None

@@ -1,0 +1,77 @@
+"""Broadcasting binary ops + explicit broadcast shape ops.
+
+Parity: `src/operator/tensor/elemwise_binary_broadcast_op_basic.cc`,
+`broadcast_reduce_op_value.cc` (broadcast_to/broadcast_axis/broadcast_like).
+jnp broadcasting matches MXNet's numpy-style broadcast semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import as_tuple
+
+_BROADCAST = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+}
+
+for _name, _f in _BROADCAST.items():
+    register(_name)((lambda f: lambda a, b, **kw: f(a, b))(_f))
+
+register("broadcast_plus")(lambda a, b, **kw: jnp.add(a, b))
+register("broadcast_minus")(lambda a, b, **kw: jnp.subtract(a, b))
+
+
+def _bcmp(f):
+    def impl(a, b, **kw):
+        return f(a, b).astype(jnp.promote_types(a.dtype, b.dtype))
+
+    return impl
+
+
+register("broadcast_equal")(_bcmp(jnp.equal))
+register("broadcast_not_equal")(_bcmp(jnp.not_equal))
+register("broadcast_greater")(_bcmp(jnp.greater))
+register("broadcast_greater_equal")(_bcmp(jnp.greater_equal))
+register("broadcast_lesser")(_bcmp(jnp.less))
+register("broadcast_lesser_equal")(_bcmp(jnp.less_equal))
+register("broadcast_logical_and")(_bcmp(jnp.logical_and))
+register("broadcast_logical_or")(_bcmp(jnp.logical_or))
+register("broadcast_logical_xor")(_bcmp(jnp.logical_xor))
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=None, **kw):
+    shape = as_tuple(shape)
+    # MXNet: 0 in target shape keeps the input dim
+    tgt = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"])
+def _broadcast_axis(x, axis=(), size=(), **kw):
+    axis = as_tuple(axis) or ()
+    size = as_tuple(size) or ()
+    tgt = list(x.shape)
+    for a, s in zip(axis, size):
+        tgt[a % x.ndim] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_like")
+def _broadcast_like(x, like, lhs_axes=None, rhs_axes=None, **kw):
+    lhs_axes, rhs_axes = as_tuple(lhs_axes), as_tuple(rhs_axes)
+    if lhs_axes is None:
+        return jnp.broadcast_to(x, like.shape)
+    tgt = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la % x.ndim] = like.shape[ra % like.ndim]
+    return jnp.broadcast_to(x, tuple(tgt))

@@ -1,0 +1,227 @@
+"""Elementwise ops — parity with the reference's
+`src/operator/tensor/elemwise_unary_op_basic.cc`, `elemwise_binary_op*.cc`,
+`elemwise_binary_scalar_op*.cc` and the math functors of
+`src/operator/mshadow_op.h`, re-expressed as jnp fns that XLA fuses.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "round": jnp.round,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt,
+    "square": jnp.square,
+    "reciprocal": lambda x: 1.0 / x,
+    "rsqrt": jax.lax.rsqrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "gamma": lambda x: jnp.exp(jax.lax.lgamma(x)),
+    "gammaln": jax.lax.lgamma,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _f in _UNARY.items():
+    register(_name)(
+        (lambda f: lambda x, **kw: f(x))(_f)
+    )
+
+@register("negative", aliases=["_np_negative"])
+def _negative(x, **kw):
+    return -x
+
+
+@register("identity", aliases=["_copy", "stop_gradient_identity"])
+def _identity(x, **kw):
+    return x
+
+
+@register("BlockGrad", aliases=["stop_gradient"])
+def _block_grad(x, **kw):
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss")
+def _make_loss(x, **kw):
+    return x
+
+
+@register("zeros_like")
+def _zeros_like(x, **kw):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x, **kw):
+    return jnp.ones_like(x)
+
+
+@register("shape_array")
+def _shape_array(x, **kw):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+@register("size_array")
+def _size_array(x, **kw):
+    return jnp.asarray([x.size], dtype=jnp.int32)
+
+
+@register("Cast", aliases=["cast"])
+def _cast(x, dtype="float32", **kw):
+    from ..base import np_dtype
+
+    return x.astype(np_dtype(dtype))
+
+
+@register("amp_cast")
+def _amp_cast(x, dtype="float32", **kw):
+    from ..base import np_dtype
+
+    return x.astype(np_dtype(dtype))
+
+
+@register("amp_multicast", num_outputs=lambda attrs: int(attrs.get("num_outputs", 1)))
+def _amp_multicast(*xs, num_outputs=1, **kw):
+    widest = jnp.result_type(*[x.dtype for x in xs])
+    return tuple(x.astype(widest) for x in xs)
+
+
+@register("clip")
+def _clip(x, a_min=None, a_max=None, **kw):
+    return jnp.clip(x, float(a_min), float(a_max))
+
+
+# ---------------------------------------------------------------------------
+# binary elemwise (same-shape) — `elemwise_add` etc.
+# ---------------------------------------------------------------------------
+
+
+_BINARY = {
+    "elemwise_add": jnp.add,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+    "_maximum": jnp.maximum,
+    "_minimum": jnp.minimum,
+    "_power": jnp.power,
+    "_hypot": jnp.hypot,
+    "_mod": jnp.mod,
+}
+
+for _name, _f in _BINARY.items():
+    register(_name)((lambda f: lambda a, b, **kw: f(a, b))(_f))
+
+alias("_plus", "elemwise_add")
+alias("_add", "elemwise_add")
+alias("_sub", "elemwise_sub")
+alias("_minus", "elemwise_sub")
+alias("_mul", "elemwise_mul")
+alias("_div", "elemwise_div")
+alias("_Plus", "elemwise_add")
+
+
+def _cmp(f):
+    def impl(a, b, **kw):
+        return f(a, b).astype(jnp.promote_types(a.dtype, b.dtype))
+
+    return impl
+
+
+register("_equal")(_cmp(jnp.equal))
+register("_not_equal")(_cmp(jnp.not_equal))
+register("_greater")(_cmp(jnp.greater))
+register("_greater_equal")(_cmp(jnp.greater_equal))
+register("_lesser")(_cmp(jnp.less))
+register("_lesser_equal")(_cmp(jnp.less_equal))
+register("_logical_and")(_cmp(jnp.logical_and))
+register("_logical_or")(_cmp(jnp.logical_or))
+register("_logical_xor")(_cmp(jnp.logical_xor))
+
+
+@register("add_n", aliases=["ElementWiseSum", "_sum"])
+def _add_n(*xs, num_args=None, **kw):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scalar ops — `_plus_scalar` family
+# ---------------------------------------------------------------------------
+
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: jnp.logical_and(x, s).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: jnp.logical_or(x, s).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: jnp.logical_xor(x, s).astype(x.dtype),
+    "_scatter_plus_scalar": lambda x, s: x + s,
+}
+
+for _name, _f in _SCALAR.items():
+    register(_name)(
+        (lambda f: lambda x, scalar=0.0, **kw: f(x, float(scalar)))(_f)
+    )
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar=1.0, **kw):
+    s2 = float(scalar) ** 2
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)

@@ -1,0 +1,184 @@
+"""Indexing / gather-scatter ops.
+
+Parity: `src/operator/tensor/indexing_op.cc` (take, Embedding, one_hot,
+gather_nd, scatter_nd, batch_take/pick), `src/operator/tensor/control_flow_op.cc`
+(where), `src/operator/contrib/boolean_mask.cc`, `ravel.cc`.
+Gather/scatter are XLA-native; these lower to single HLO gather/scatter ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import parse_bool
+
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip", **kw):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[int(axis)])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[int(axis)] - 1)
+    return jnp.take(a, idx, axis=int(axis))
+
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False, **kw):
+    """Parity: `indexing_op.cc` Embedding. One XLA gather feeding the MXU."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot")
+def _one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    from ..base import np_dtype
+
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth), dtype=np_dtype(dtype))
+    return oh * (float(on_value) - float(off_value)) + float(off_value)
+
+
+@register("pick", aliases=["choose_element_0index"])
+def _pick(data, index, axis=-1, keepdims=False, mode="clip", **kw):
+    axis = int(axis)
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    idxe = jnp.expand_dims(idx, axis=axis)
+    out = jnp.take_along_axis(data, idxe, axis=axis)
+    if not parse_bool(keepdims):
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("batch_take")
+def _batch_take(a, indices, **kw):
+    flat = a.reshape(-1)
+    off = jnp.arange(a.shape[0]) * a.shape[1]
+    return jnp.take(flat, indices.astype(jnp.int32) + off)
+
+
+@register("gather_nd")
+def _gather_nd(data, indices, **kw):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=None, **kw):
+    from ._utils import as_tuple
+
+    shape = as_tuple(shape)
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].add(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(data, indices, shape=None, **kw):
+    from ._utils import as_tuple
+
+    shape = as_tuple(shape)
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("where")
+def _where(condition, x, y, **kw):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("_contrib_boolean_mask", aliases=["contrib_boolean_mask"])
+def _boolean_mask(data, index, axis=0, **kw):
+    # Dynamic-shape op: XLA needs static shapes, so we return a dense result
+    # compacted to the front with zero padding plus count is not exposed;
+    # eager-only op (documented divergence; reference boolean_mask.cc).
+    mask = index.astype(bool)
+    return jnp.compress(mask, data, axis=int(axis))
+
+
+@register("ravel_multi_index")
+def _ravel_multi_index(data, shape=None, **kw):
+    from ._utils import as_tuple
+
+    shape = as_tuple(shape)
+    out = jnp.zeros(data.shape[1:], dtype=data.dtype)
+    stride = 1
+    for i in range(len(shape) - 1, -1, -1):
+        out = out + data[i] * stride
+        stride *= shape[i]
+    return out
+
+
+@register("unravel_index")
+def _unravel_index(data, shape=None, **kw):
+    from ._utils import as_tuple
+
+    shape = as_tuple(shape)
+    idx = data.astype(jnp.int32)
+    outs = []
+    rem = idx
+    strides = []
+    stride = 1
+    for s in reversed(shape):
+        strides.append(stride)
+        stride *= s
+    strides = list(reversed(strides))
+    for i, s in enumerate(shape):
+        outs.append((rem // strides[i]) % s)
+    return jnp.stack(outs, axis=0).astype(data.dtype)
+
+
+@register("_contrib_index_copy")
+def _index_copy(old, idx, new, **kw):
+    return old.at[idx.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_index_array")
+def _index_array(data, axes=None, **kw):
+    from ._utils import as_tuple
+
+    axes = as_tuple(axes) or tuple(range(data.ndim))
+    grids = jnp.meshgrid(*[jnp.arange(data.shape[a]) for a in axes], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype(jnp.int32)
+
+
+@register("SequenceMask")
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0, **kw):
+    if not parse_bool(use_sequence_length) or sequence_length is None:
+        return data
+    axis = int(axis)
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # data layout: (seq, batch, ...) if axis==0 else (batch, seq, ...)
+    mask = steps[:, None] < sequence_length[None, :].astype(steps.dtype) if axis == 0 else (
+        steps[None, :] < sequence_length[:, None].astype(steps.dtype)
+    )
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(float(value), data.dtype))
+
+
+@register("SequenceLast")
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    axis = int(axis)
+    if not parse_bool(use_sequence_length) or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        d = jnp.moveaxis(data, 0, 1)  # (batch, seq, ...)
+    else:
+        d = data
+    return jnp.take_along_axis(d, idx.reshape(-1, *([1] * (d.ndim - 1))), axis=1).squeeze(1)
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    if not parse_bool(use_sequence_length) or sequence_length is None:
+        return jnp.flip(data, axis=int(axis))
+    # (seq, batch, ...) layout
+    seq = data.shape[0]
+    steps = jnp.arange(seq)
+    lens = sequence_length.astype(jnp.int32)
+    idx = jnp.where(steps[:, None] < lens[None, :], lens[None, :] - 1 - steps[:, None], steps[:, None])
+    gather = jnp.take_along_axis(data, idx.reshape(seq, -1, *([1] * (data.ndim - 2))), axis=0)
+    return gather

@@ -1,0 +1,61 @@
+"""Creation ops (no array inputs).
+
+Parity: `src/operator/tensor/init_op.cc` (_zeros/_ones/_full/_eye/_arange/
+_linspace + *_like). These take no tensor inputs; the nd frontend calls them
+with ``shape``/``dtype`` attrs and places the result on the requested context.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import as_tuple
+
+
+def _dt(dtype):
+    from ..base import np_dtype
+
+    return np_dtype(dtype)
+
+
+@register("_zeros", aliases=["zeros"])
+def _zeros(shape=(), dtype="float32", ctx=None, **kw):
+    return jnp.zeros(as_tuple(shape) or (), dtype=_dt(dtype))
+
+
+@register("_ones", aliases=["ones"])
+def _ones(shape=(), dtype="float32", ctx=None, **kw):
+    return jnp.ones(as_tuple(shape) or (), dtype=_dt(dtype))
+
+
+@register("_full", aliases=["full"])
+def _full(shape=(), value=0.0, dtype="float32", ctx=None, **kw):
+    return jnp.full(as_tuple(shape) or (), float(value), dtype=_dt(dtype))
+
+
+@register("_eye", aliases=["eye"])
+def _eye(N=1, M=0, k=0, dtype="float32", ctx=None, **kw):
+    M = int(M) or None
+    return jnp.eye(int(N), M, k=int(k), dtype=_dt(dtype))
+
+
+@register("_arange", aliases=["arange"])
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False, dtype="float32", ctx=None, **kw):
+    if stop is None or stop == "None":
+        start, stop = 0.0, start
+    out = jnp.arange(float(start), float(stop), float(step), dtype=_dt(dtype))
+    if int(repeat) > 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+@register("_linspace", aliases=["linspace"])
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32", ctx=None, **kw):
+    from ._utils import parse_bool
+
+    return jnp.linspace(float(start), float(stop), int(num), endpoint=parse_bool(endpoint), dtype=_dt(dtype))
+
+
+@register("full_like")
+def _full_like(x, fill_value=0.0, **kw):
+    return jnp.full_like(x, float(fill_value))

@@ -1,0 +1,191 @@
+"""Matrix products + linear algebra.
+
+Parity: `src/operator/tensor/dot.cc`, `la_op.cc` (gemm/gemm2/potrf/potri/
+trmm/trsm/syrk/gelqf/syevd/inverse/det/slogdet), `khatri_rao.cc`.
+``dot``/``batch_dot`` are the MXU ops: on TPU they map straight onto the
+systolic array; bf16 inputs with fp32 accumulation is the preferred mode
+(jax default for TPU matmul).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import parse_bool
+
+
+@register("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False, forward_stype=None, **kw):
+    """MXNet dot (reference `src/operator/tensor/dot-inl.h`): contracts the
+    last axis of a with the first axis of b; transpose flags swap which axis
+    is contracted (a: first axis; b: last axis), matrix-transpose semantics.
+    Lowers to one XLA dot_general on the MXU with fp32 accumulation."""
+    ta, tb = parse_bool(transpose_a), parse_bool(transpose_b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    a_axis = 0 if ta else a.ndim - 1
+    b_axis = b.ndim - 1 if tb else 0
+    out = jnp.tensordot(a, b, axes=((a_axis,), (b_axis,)),
+                        preferred_element_type=_acc_type(a))
+    return out.astype(a.dtype)
+
+
+def _acc_type(a):
+    if a.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False, forward_stype=None, **kw):
+    if parse_bool(transpose_a):
+        a = jnp.swapaxes(a, -1, -2)
+    if parse_bool(transpose_b):
+        b = jnp.swapaxes(b, -1, -2)
+    out = jnp.matmul(a, b, preferred_element_type=_acc_type(a))
+    return out.astype(a.dtype)
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats, **kw):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+# -- _linalg_* family (reference la_op.cc) ----------------------------------
+
+
+@register("_linalg_gemm", aliases=["linalg_gemm"])
+def _linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-2, **kw):
+    if parse_bool(transpose_a):
+        a = jnp.swapaxes(a, -1, -2)
+    if parse_bool(transpose_b):
+        b = jnp.swapaxes(b, -1, -2)
+    return float(alpha) * jnp.matmul(a, b) + float(beta) * c
+
+
+@register("_linalg_gemm2", aliases=["linalg_gemm2"])
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2, **kw):
+    if parse_bool(transpose_a):
+        a = jnp.swapaxes(a, -1, -2)
+    if parse_bool(transpose_b):
+        b = jnp.swapaxes(b, -1, -2)
+    return float(alpha) * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=["linalg_potrf"])
+def _linalg_potrf(a, **kw):
+    return jnp.linalg.cholesky(a)
+
+
+@register("_linalg_potri", aliases=["linalg_potri"])
+def _linalg_potri(a, **kw):
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trsm", aliases=["linalg_trsm"])
+def _linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, **kw):
+    tr = parse_bool(transpose)
+    lo = parse_bool(lower)
+    b = float(alpha) * b
+    if parse_bool(rightside):
+        # solve X A = B  ->  A^T X^T = B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2), lower=not lo, trans=1 if tr else 0
+        )
+        return jnp.swapaxes(xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(a, b, lower=lo, trans=1 if tr else 0)
+
+
+@register("_linalg_trmm", aliases=["linalg_trmm"])
+def _linalg_trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, **kw):
+    m = jnp.tril(a) if parse_bool(lower) else jnp.triu(a)
+    if parse_bool(transpose):
+        m = jnp.swapaxes(m, -1, -2)
+    if parse_bool(rightside):
+        return float(alpha) * jnp.matmul(b, m)
+    return float(alpha) * jnp.matmul(m, b)
+
+
+@register("_linalg_syrk", aliases=["linalg_syrk"])
+def _linalg_syrk(a, transpose=False, alpha=1.0, **kw):
+    at = jnp.swapaxes(a, -1, -2)
+    if parse_bool(transpose):
+        return float(alpha) * jnp.matmul(at, a)
+    return float(alpha) * jnp.matmul(a, at)
+
+
+@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def _linalg_sumlogdiag(a, **kw):
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"])
+def _linalg_extractdiag(a, offset=0, **kw):
+    return jnp.diagonal(a, offset=int(offset), axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=["linalg_makediag"])
+def _linalg_makediag(a, offset=0, **kw):
+    k = int(offset)
+    n = a.shape[-1] + abs(k)
+    out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + max(-k, 0)
+    c = idx + max(k, 0)
+    return out.at[..., r, c].set(a)
+
+
+@register("_linalg_extracttrian", aliases=["linalg_extracttrian"])
+def _linalg_extracttrian(a, offset=0, lower=True, **kw):
+    k = int(offset)
+    n = a.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=k) if parse_bool(lower) and k <= 0 else jnp.triu_indices(n, k=k)
+    if not parse_bool(lower):
+        rows, cols = jnp.triu_indices(n, k=k)
+    return a[..., rows, cols]
+
+
+@register("_linalg_maketrian", aliases=["linalg_maketrian"])
+def _linalg_maketrian(a, offset=0, lower=True, **kw):
+    k = int(offset)
+    # infer n from vector length m = n(n+1)/2 (main-diagonal case)
+    m = a.shape[-1]
+    n = int(((8 * m + 1) ** 0.5 - 1) / 2) if k == 0 else m
+    rows, cols = (jnp.tril_indices(n, k=k) if parse_bool(lower) else jnp.triu_indices(n, k=k))
+    out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+@register("_linalg_inverse", aliases=["linalg_inverse"])
+def _linalg_inverse(a, **kw):
+    return jnp.linalg.inv(a)
+
+
+@register("_linalg_det", aliases=["linalg_det"])
+def _linalg_det(a, **kw):
+    return jnp.linalg.det(a)
+
+
+@register("_linalg_slogdet", aliases=["linalg_slogdet"], num_outputs=2)
+def _linalg_slogdet(a, **kw):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
+@register("_linalg_syevd", aliases=["linalg_syevd"], num_outputs=2)
+def _linalg_syevd(a, **kw):
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_gelqf", aliases=["linalg_gelqf"], num_outputs=2)
+def _linalg_gelqf(a, **kw):
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)

@@ -1,0 +1,149 @@
+"""Random samplers.
+
+Parity: `src/operator/random/sample_op.cc` (_random_uniform/_random_normal/
+_random_gamma/_random_exponential/_random_poisson/_random_negative_binomial/
+_random_generalized_negative_binomial/_random_randint),
+`multisample_op.cc` (_sample_* with per-row params), `sample_multinomial_op.cc`,
+`shuffle_op.cc`, `unique_sample_op.cc`.
+All take a jax PRNG key as first array arg (needs_rng=True); the frontend
+threads keys from mxnet_tpu.random's active provider.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import as_tuple
+
+
+def _dt(dtype):
+    from ..base import np_dtype
+
+    return np_dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@register("_random_uniform", aliases=["random_uniform", "uniform"], needs_rng=True)
+def _random_uniform(key, low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, **kw):
+    shape = as_tuple(shape) or ()
+    return jax.random.uniform(key, shape, dtype=_dt(dtype), minval=float(low), maxval=float(high))
+
+
+@register("_random_normal", aliases=["random_normal", "normal"], needs_rng=True)
+def _random_normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, **kw):
+    shape = as_tuple(shape) or ()
+    return jax.random.normal(key, shape, dtype=_dt(dtype)) * float(scale) + float(loc)
+
+
+@register("_random_gamma", aliases=["random_gamma"], needs_rng=True)
+def _random_gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, **kw):
+    shape = as_tuple(shape) or ()
+    return jax.random.gamma(key, float(alpha), shape, dtype=_dt(dtype)) * float(beta)
+
+
+@register("_random_exponential", aliases=["random_exponential"], needs_rng=True)
+def _random_exponential(key, lam=1.0, shape=(), dtype="float32", ctx=None, **kw):
+    shape = as_tuple(shape) or ()
+    return jax.random.exponential(key, shape, dtype=_dt(dtype)) / float(lam)
+
+
+@register("_random_poisson", aliases=["random_poisson"], needs_rng=True)
+def _random_poisson(key, lam=1.0, shape=(), dtype="float32", ctx=None, **kw):
+    shape = as_tuple(shape) or ()
+    return jax.random.poisson(key, float(lam), shape).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", aliases=["random_negative_binomial"], needs_rng=True)
+def _random_negative_binomial(key, k=1, p=1.0, shape=(), dtype="float32", ctx=None, **kw):
+    shape = as_tuple(shape) or ()
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, float(k), shape) * (1.0 - float(p)) / float(p)
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", aliases=["random_generalized_negative_binomial"], needs_rng=True)
+def _random_gnb(key, mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=None, **kw):
+    shape = as_tuple(shape) or ()
+    a = 1.0 / float(alpha)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, a, shape) * (float(mu) * float(alpha))
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=["random_randint", "randint"], needs_rng=True)
+def _random_randint(key, low=0, high=1, shape=(), dtype="int32", ctx=None, **kw):
+    shape = as_tuple(shape) or ()
+    return jax.random.randint(key, shape, int(low), int(high), dtype=_dt(dtype))
+
+
+# -- _sample_* family: per-row distribution params --------------------------
+
+
+def _msample(draw):
+    def impl(key, *params, shape=(), dtype="float32", **kw):
+        shape = as_tuple(shape) or ()
+        out_shape = params[0].shape + shape
+        return draw(key, params, out_shape).astype(_dt(dtype))
+
+    return impl
+
+
+register("_sample_uniform", aliases=["sample_uniform"], needs_rng=True)(
+    _msample(lambda key, p, s: jax.random.uniform(key, s) * (_b(p[1], s) - _b(p[0], s)) + _b(p[0], s))
+)
+register("_sample_normal", aliases=["sample_normal"], needs_rng=True)(
+    _msample(lambda key, p, s: jax.random.normal(key, s) * _b(p[1], s) + _b(p[0], s))
+)
+register("_sample_gamma", aliases=["sample_gamma"], needs_rng=True)(
+    _msample(lambda key, p, s: jax.random.gamma(key, _b(p[0], s), s) * _b(p[1], s))
+)
+register("_sample_exponential", aliases=["sample_exponential"], needs_rng=True)(
+    _msample(lambda key, p, s: jax.random.exponential(key, s) / _b(p[0], s))
+)
+register("_sample_poisson", aliases=["sample_poisson"], needs_rng=True)(
+    _msample(lambda key, p, s: jax.random.poisson(key, _b(p[0], s), s).astype(jnp.float32))
+)
+
+
+def _b(param, shape):
+    """Broadcast per-row params against trailing sample dims."""
+    extra = len(shape) - param.ndim
+    return param.reshape(param.shape + (1,) * extra)
+
+
+@register("_sample_multinomial", aliases=["sample_multinomial"], needs_rng=True)
+def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32", **kw):
+    from ._utils import parse_bool
+
+    shape = as_tuple(shape) or ()
+    n = 1
+    for s in shape:
+        n *= s
+    logits = jnp.log(jnp.clip(data, 1e-30, None))
+    flat_logits = logits.reshape(-1, logits.shape[-1]) if logits.ndim > 1 else logits[None]
+    idx = jax.vmap(lambda k, lg: jax.random.categorical(k, lg, shape=(max(n, 1),)))(
+        jax.random.split(key, flat_logits.shape[0]), flat_logits
+    )
+    out_shape = (data.shape[:-1] + shape) if data.ndim > 1 else shape
+    out = idx.reshape(out_shape or ()).astype(_dt(dtype))
+    if parse_bool(get_prob):
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(flat_logits, axis=-1), idx, axis=-1
+        ).reshape(out_shape or ())
+        return out, lp
+    return out
+
+
+@register("_shuffle", aliases=["shuffle"], needs_rng=True)
+def _shuffle(key, data, **kw):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", needs_rng=True, num_outputs=2)
+def _sample_unique_zipfian(key, range_max=1, shape=(), **kw):
+    shape = as_tuple(shape) or ()
+    u = jax.random.uniform(key, shape)
+    rm = float(range_max)
+    out = (jnp.exp(u * jnp.log(rm + 1.0)) - 1.0).astype(jnp.int32)
+    cnt = jnp.ones(shape, dtype=jnp.int32)
+    return out, cnt

@@ -1,0 +1,157 @@
+"""Reductions, ordering, norms.
+
+Parity: `src/operator/tensor/broadcast_reduce_op_value.cc` (sum/mean/prod/
+nansum/nanprod/max/min/norm), `ordering_op.cc` (topk/sort/argsort),
+`ravel.cc`, `histogram.cc`. Low-precision inputs accumulate in fp32
+(MXNET_SAFE_ACCUMULATION default-on for TPU: bf16 inputs, fp32 partials on
+the MXU is the native pattern).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import reduce_axes, as_tuple, parse_bool, safe_acc_dtype
+
+
+def _reduce(fn_name):
+    jfn = getattr(jnp, fn_name)
+
+    def impl(x, axis=None, keepdims=False, exclude=False, **kw):
+        axes = reduce_axes(as_tuple(axis) if not isinstance(axis, int) else axis, x.ndim, parse_bool(exclude))
+        if axes == () and x.ndim > 0:
+            return x
+        acc = safe_acc_dtype(x.dtype) if fn_name in ("sum", "mean", "prod") else None
+        out = jfn(x, axis=axes if axes else None, keepdims=parse_bool(keepdims), dtype=acc) if acc else jfn(
+            x, axis=axes if axes else None, keepdims=parse_bool(keepdims)
+        )
+        return out.astype(x.dtype)
+
+    return impl
+
+
+register("sum", aliases=["sum_axis"])(_reduce("sum"))
+register("mean")(_reduce("mean"))
+register("prod")(_reduce("prod"))
+register("nansum")(_reduce("nansum"))
+register("nanprod")(_reduce("nanprod"))
+register("max", aliases=["max_axis"])(_reduce("max"))
+register("min", aliases=["min_axis"])(_reduce("min"))
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False, **kw):
+    ord = int(ord)
+    axes = as_tuple(axis)
+    acc = safe_acc_dtype(x.dtype)
+    xx = x.astype(acc) if acc else x
+    if ord == 1:
+        out = jnp.sum(jnp.abs(xx), axis=axes, keepdims=parse_bool(keepdims))
+    else:
+        out = jnp.sqrt(jnp.sum(xx * xx, axis=axes, keepdims=parse_bool(keepdims)))
+    return out.astype(x.dtype)
+
+
+def _arg_reduce(jfn):
+    def impl(x, axis=None, keepdims=False, **kw):
+        if axis is None or axis == "None":
+            res = jfn(x.reshape(-1), axis=0)
+            out = res.astype(jnp.float32)
+            return out.reshape((1,) * x.ndim) if parse_bool(keepdims) else out
+        out = jfn(x, axis=int(axis)).astype(jnp.float32)
+        if parse_bool(keepdims):
+            out = jnp.expand_dims(out, int(axis))
+        return out
+
+    return impl
+
+
+register("argmax")(_arg_reduce(jnp.argmax))
+register("argmin")(_arg_reduce(jnp.argmin))
+
+
+@register("argmax_channel")
+def _argmax_channel(x, **kw):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("topk", num_outputs=lambda attrs: 2 if attrs.get("ret_typ", "indices") == "both" else 1)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **kw):
+    from ..base import np_dtype
+
+    axis = int(axis) if axis is not None else None
+    k = int(k)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if k <= 0:
+        k = x.shape[axis]
+    sortin = x if parse_bool(is_ascend) else -x
+    idx = jnp.argsort(sortin, axis=axis)
+    idx = jax.lax.slice_in_dim(idx, 0, k, axis=axis)
+    vals = jnp.take_along_axis(x, idx, axis=axis)
+    idxf = idx.astype(np_dtype(dtype))
+    if ret_typ == "indices":
+        return idxf
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "mask":
+        axis = axis % x.ndim
+        # one_hot inserts the class dim at `axis`, pushing idx's k-dim to axis+1
+        oh = jax.nn.one_hot(idx, x.shape[axis], axis=axis, dtype=x.dtype)
+        return jnp.sum(oh, axis=axis + 1)  # collapse k dim → 0/1 mask of x.shape
+    return (vals, idxf)  # both
+
+
+@register("sort")
+def _sort(x, axis=-1, is_ascend=True, **kw):
+    if axis is None or axis == "None":
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.sort(x, axis=int(axis))
+    if not parse_bool(is_ascend):
+        out = jnp.flip(out, axis=int(axis))
+    return out
+
+
+@register("argsort")
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32", **kw):
+    from ..base import np_dtype
+
+    if axis is None or axis == "None":
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.argsort(x if parse_bool(is_ascend) else -x, axis=int(axis))
+    return out.astype(np_dtype(dtype))
+
+
+@register("cumsum")
+def _cumsum(x, axis=None, dtype=None, **kw):
+    from ..base import np_dtype
+
+    out = jnp.cumsum(x, axis=None if axis is None else int(axis))
+    if dtype is not None:
+        out = out.astype(np_dtype(dtype))
+    return out if axis is not None else out.reshape(-1)
+
+
+@register("_histogram", num_outputs=2)
+def _histogram(x, bins=10, range=None, **kw):
+    cnt, edges = jnp.histogram(x, bins=int(bins), range=range)
+    return cnt, edges
+
+
+@register("L2Normalization")
+def _l2norm(x, eps=1e-10, mode="instance", **kw):
+    eps = float(eps)
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise ValueError(mode)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / n

@@ -1,0 +1,166 @@
+"""Operator registry — the TPU-native answer to the reference's NNVM op
+registry (`NNVM_REGISTER_OP` + `FCompute` dispatch, reference
+`include/mxnet/op_attr_types.h:207-312`, `src/operator/`).
+
+Every op is a **pure jax function** ``fn(*arrays, **attrs) -> array | tuple``.
+There is no per-op kernel scheduling: invoking an op eagerly compiles (and
+caches) a one-op XLA computation, exactly the "eager-by-compilation" design
+from SURVEY.md §7 stage 2; under graph capture (CachedOp / Symbol executor)
+the same fns are traced into one whole-graph XLA program — the limit case of
+the reference's engine bulking (`threaded_engine.h:413`).
+
+Shape/type inference (the reference's FInferShape/FInferType,
+`infer_graph_attr_pass.cc:94,372`) is obtained for free via
+``jax.eval_shape`` on the same fn — one source of truth.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "alias"]
+
+_OPS: dict[str, "Op"] = {}
+
+
+class Op:
+    """A registered operator."""
+
+    __slots__ = ("name", "fn", "num_outputs", "mutate_aux", "wrap_kwargs", "doc", "needs_rng", "needs_mode")
+
+    def __init__(self, name, fn, num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
+                 needs_mode=False):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs  # int or callable(attrs)->int
+        # RNG-consuming ops (samplers, Dropout): fn takes a jax PRNG key as its
+        # FIRST array argument; the frontend fetches it from the active key
+        # provider (mxnet_tpu.random) — the stateless-TPU-PRNG rendering of the
+        # reference's ResourceRequest::kRandom (`include/mxnet/resource.h:38`).
+        self.needs_rng = needs_rng
+        # Train/predict-polymorphic ops (Dropout, BatchNorm): the frontend
+        # injects `_train=autograd.is_training()` as a static attr so the
+        # compile cache keys on it (reference: OpContext::is_train,
+        # `include/mxnet/op_attr_types.h:67`).
+        self.needs_mode = needs_mode
+        # indices of *inputs* that receive extra outputs written back in-place
+        # (optimizer ops, BatchNorm moving stats) — the functional rendering of
+        # the reference's FMutateInputs (`op_attr_types.h`).
+        self.mutate_aux = mutate_aux
+        self.wrap_kwargs = wrap_kwargs  # canonicalize attrs before hashing/jit
+        self.doc = fn.__doc__
+
+    def n_out(self, attrs):
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+    def __repr__(self):
+        return f"Op({self.name})"
+
+
+def register(name, aliases=(), num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
+             needs_mode=False):
+    """Decorator: register a jax fn as operator ``name`` (+ aliases)."""
+
+    def deco(fn):
+        op = Op(name, fn, num_outputs=num_outputs, mutate_aux=mutate_aux, wrap_kwargs=wrap_kwargs,
+                needs_rng=needs_rng, needs_mode=needs_mode)
+        _OPS[name] = op
+        for a in aliases:
+            _OPS[a] = op
+        return fn
+
+    return deco
+
+
+def alias(name, target):
+    _OPS[name] = _OPS[target]
+
+
+def get_op(name):
+    op = _OPS.get(name)
+    if op is None:
+        raise AttributeError(f"Operator '{name}' is not registered")
+    return op
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(name, frozen_attrs, backend):
+    """One-op XLA computation, cached by (op, attrs); jax caches by shapes.
+    This is the eager compile cache — the role CachedOp's signature check
+    plays in the reference (`cached_op.cc:295`)."""
+    op = _OPS[name]
+    attrs = dict(frozen_attrs)
+    fn = lambda *arrays: op.fn(*arrays, **attrs)
+    return jax.jit(fn)
+
+
+def bound_fn(name, **attrs):
+    """The pure fn of op `name` with attrs closed over (un-jitted) — used by
+    graph capture, autograd vjp, and eval_shape."""
+    op = get_op(name)
+    if op.wrap_kwargs is not None:
+        attrs = op.wrap_kwargs(attrs)
+    fn = op.fn
+    return lambda *arrays: fn(*arrays, **attrs)
+
+
+@functools.lru_cache(maxsize=None)
+def _vjp_fwd_jitted(name, frozen_attrs):
+    """jit-compiled forward-with-residuals: returns (outputs, vjp_partial).
+    jax.vjp's pullback is a `tree_util.Partial` pytree, so it crosses the jit
+    boundary; residuals stay on device. This is how the eager autograd tape
+    avoids re-running forwards at backward time (reference keeps explicit
+    FGradient graphs instead — here linearization is the compiler's job)."""
+    op = _OPS[name]
+    attrs = dict(frozen_attrs)
+    fn = lambda *arrays: op.fn(*arrays, **attrs)
+
+    def fwd(*arrays):
+        out, vjp = jax.vjp(fn, *arrays)
+        return out, vjp
+
+    return jax.jit(fwd)
+
+
+@jax.jit
+def run_vjp(vjp_partial, cts):
+    """Apply a stored pullback (jit-cached by pytree structure)."""
+    return vjp_partial(cts)
+
+
+def invoke_with_vjp(name, *arrays, **attrs):
+    """Invoke returning (outputs, vjp_partial) for tape recording."""
+    op = get_op(name)
+    if op.wrap_kwargs is not None:
+        attrs = op.wrap_kwargs(attrs)
+    jfn = _vjp_fwd_jitted(op.name, _freeze(attrs))
+    return jfn(*arrays)
+
+
+def invoke_raw(name, *arrays, **attrs):
+    """Invoke on raw jax arrays, eager, through the compile cache."""
+    op = get_op(name)
+    if op.wrap_kwargs is not None:
+        attrs = op.wrap_kwargs(attrs)
+    jfn = _jitted(op.name, _freeze(attrs), None)
+    return jfn(*arrays)
+
+
+def invoke(name, *arrays, **attrs):
+    """Alias of invoke_raw (NDArray-level dispatch lives in ndarray.register)."""
+    return invoke_raw(name, *arrays, **attrs)

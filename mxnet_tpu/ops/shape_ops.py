@@ -1,0 +1,234 @@
+"""Shape-manipulation ops.
+
+Parity: `src/operator/tensor/matrix_op.cc` (Reshape incl. special codes
+0/-1/-2/-3/-4, transpose, expand_dims, slice, slice_axis, slice_like, clip,
+repeat, tile, reverse, stack, squeeze, depth_to_space, space_to_depth),
+`concat.cc`, `split.cc` (SliceChannel), `pad.cc`, `flatten`.
+All are metadata ops for XLA — they fuse into neighbors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import as_tuple, parse_bool
+
+
+def infer_reshape(src_shape, target, reverse=False):
+    """MXNet Reshape special codes (reference `matrix_op-inl.h` ReshapeInferShape):
+    0 copy dim; -1 infer; -2 copy all remaining; -3 merge two dims; -4 split dim."""
+    target = list(target)
+    src = list(src_shape)
+    if reverse:
+        src = src[::-1]
+        target = [t if t != -4 else t for t in target][::-1]
+        # reverse mode: handle by flipping, then flipping result
+        out = infer_reshape(src, _reverse_target(target))
+        return tuple(out[::-1])
+    out = []
+    src_i = 0
+    i = 0
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src[src_i]); src_i += 1
+        elif t == -1:
+            out.append(-1); src_i += 1
+        elif t == -2:
+            out.extend(src[src_i:]); src_i = len(src)
+        elif t == -3:
+            out.append(src[src_i] * src[src_i + 1]); src_i += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            if d1 == -1:
+                d1 = src[src_i] // d2
+            if d2 == -1:
+                d2 = src[src_i] // d1
+            out.extend([d1, d2]); src_i += 1; i += 2
+        else:
+            out.append(t); src_i += 1
+        i += 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src_shape:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+def _reverse_target(target):
+    # -4 groups travel together; for simplicity support reverse only without -4
+    return target
+
+
+@register("Reshape", aliases=["reshape"])
+def _reshape(x, shape=None, reverse=False, target_shape=None, keep_highest=False, **kw):
+    if shape is None and target_shape is not None:  # legacy params
+        shape = target_shape
+    shape = as_tuple(shape)
+    new_shape = infer_reshape(x.shape, shape, parse_bool(reverse))
+    return jnp.reshape(x, new_shape)
+
+
+@register("Flatten", aliases=["flatten"])
+def _flatten(x, **kw):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(x, axes=None, **kw):
+    axes = as_tuple(axes)
+    if axes is None or len(axes) == 0:
+        axes = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0, **kw):
+    return jnp.expand_dims(x, int(axis))
+
+
+@register("squeeze")
+def _squeeze(x, axis=None, **kw):
+    axis = as_tuple(axis)
+    return jnp.squeeze(x, axis=axis)
+
+
+@register("Concat", aliases=["concat"])
+def _concat(*xs, dim=1, num_args=None, **kw):
+    return jnp.concatenate(xs, axis=int(dim))
+
+
+@register("stack")
+def _stack(*xs, axis=0, num_args=None, **kw):
+    return jnp.stack(xs, axis=int(axis))
+
+
+def _split_nout(attrs):
+    n = int(attrs.get("num_outputs", 1))
+    return n
+
+
+@register("SliceChannel", aliases=["split"], num_outputs=_split_nout)
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False, **kw):
+    parts = jnp.split(x, int(num_outputs), axis=int(axis))
+    if parse_bool(squeeze_axis):
+        parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("split_v2", num_outputs=lambda attrs: len(as_tuple(attrs.get("indices", ()))) + 1 if not attrs.get("sections") else int(attrs["sections"]))
+def _split_v2(x, indices=(), axis=0, squeeze_axis=False, sections=0, **kw):
+    axis = int(axis)
+    if sections:
+        parts = jnp.split(x, int(sections), axis=axis)
+    else:
+        parts = jnp.split(x, list(as_tuple(indices)), axis=axis)
+    if parse_bool(squeeze_axis):
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("slice", aliases=["crop"])
+def _slice(x, begin=None, end=None, step=None, **kw):
+    begin, end = as_tuple(begin), list(as_tuple(end))
+    step = as_tuple(step) or (1,) * len(begin)
+    slices = []
+    for i in range(x.ndim):
+        if i < len(begin):
+            b = begin[i]
+            e = end[i] if i < len(end) else None
+            s = step[i] if i < len(step) else 1
+            slices.append(slice(None if b is None else int(b),
+                                None if e is None else int(e),
+                                int(s) if s else 1))
+        else:
+            slices.append(slice(None))
+    return x[tuple(slices)]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None, **kw):
+    axis = int(axis) % x.ndim
+    sl = [slice(None)] * x.ndim
+    sl[axis] = slice(int(begin), None if end is None or end == "None" else int(end))
+    return x[tuple(sl)]
+
+
+@register("slice_like")
+def _slice_like(x, like, axes=(), **kw):
+    axes = as_tuple(axes) or tuple(range(min(x.ndim, like.ndim)))
+    sl = [slice(None)] * x.ndim
+    for a in axes:
+        sl[a % x.ndim] = slice(0, like.shape[a % like.ndim])
+    return x[tuple(sl)]
+
+
+@register("reverse", aliases=["flip"])
+def _reverse(x, axis=(), **kw):
+    axis = as_tuple(axis)
+    return jnp.flip(x, axis=axis)
+
+
+@register("tile")
+def _tile(x, reps=(), **kw):
+    return jnp.tile(x, as_tuple(reps))
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None, **kw):
+    return jnp.repeat(x, int(repeats), axis=None if axis is None or axis == "None" else int(axis))
+
+
+@register("Pad", aliases=["pad"])
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0, **kw):
+    pw = as_tuple(pad_width)
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode_map = {"constant": "constant", "edge": "edge", "reflect": "reflect"}
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=float(constant_value))
+    return jnp.pad(x, pairs, mode=mode_map[mode])
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=1, **kw):
+    b = int(block_size)
+    n, c, h, w = x.shape
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=1, **kw):
+    b = int(block_size)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("SwapAxis", aliases=["swapaxes"])
+def _swapaxes(x, dim1=0, dim2=0, **kw):
+    return jnp.swapaxes(x, int(dim1), int(dim2))
+
+
+@register("diag")
+def _diag(x, k=0, axis1=0, axis2=1, **kw):
+    if x.ndim == 1:
+        return jnp.diag(x, k=int(k))
+    return jnp.diagonal(x, offset=int(k), axis1=int(axis1), axis2=int(axis2))
+
+
+@register("_arange_like", aliases=["contrib_arange_like"])
+def _arange_like(x, start=0.0, step=1.0, repeat=1, axis=None, **kw):
+    if axis is None or axis == "None":
+        n = x.size
+        return (jnp.arange(n, dtype=x.dtype) * float(step) + float(start)).reshape(x.shape)
+    n = x.shape[int(axis)]
+    return jnp.arange(n, dtype=x.dtype) * float(step) + float(start)

@@ -1,0 +1,171 @@
+"""Profiler — chrome://tracing JSON output + jax profiler bridge.
+
+Parity: `python/mxnet/profiler.py` (set_config :33, start/stop, dump :122,
+dumps :151, scoped Task/Frame/Event/Counter/Marker) over the reference's
+`src/profiler/profiler.h:256`.
+
+TPU-native: device-side op timing comes from jax's XLA profiler
+(``jax.profiler.start_trace`` → xplane/perfetto, viewable in TensorBoard or
+chrome://tracing); host-side scopes are recorded here and written as chrome
+trace events, matching the reference's output format.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
+           "Task", "Frame", "Event", "Counter", "Marker", "scope"]
+
+_config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
+_events = []
+_lock = threading.Lock()
+_running = False
+_jax_trace_dir = None
+
+
+def set_config(**kwargs):
+    """Parity `profiler.py:33`. Recognized: filename, profile_(all|symbolic|
+    imperative|memory|api), aggregate_stats, continuous_dump."""
+    _config.update(kwargs)
+
+
+def start(profile_process="worker"):
+    global _running, _jax_trace_dir
+    _running = True
+    fname = _config.get("filename", "profile.json")
+    trace_dir = os.path.splitext(fname)[0] + "_xla"
+    try:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        _jax_trace_dir = trace_dir
+    except Exception:
+        _jax_trace_dir = None
+
+
+def stop(profile_process="worker"):
+    global _running
+    _running = False
+    if _jax_trace_dir is not None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+
+def pause(profile_process="worker"):
+    global _running
+    _running = False
+
+
+def resume(profile_process="worker"):
+    global _running
+    _running = True
+
+
+def _emit(name, ph, cat="host", ts=None, args=None, dur=None):
+    if not _running:
+        return
+    ev = {"name": name, "ph": ph, "cat": cat, "pid": os.getpid(),
+          "tid": threading.get_ident(), "ts": ts if ts is not None else time.time() * 1e6}
+    if args:
+        ev["args"] = args
+    if dur is not None:
+        ev["dur"] = dur
+    with _lock:
+        _events.append(ev)
+
+
+def dumps(reset=False):
+    with _lock:
+        out = json.dumps({"traceEvents": list(_events)}, indent=2)
+        if reset:
+            _events.clear()
+    return out
+
+
+def dump(finished=True, profile_process="worker"):
+    fname = _config.get("filename", "profile.json")
+    with open(fname, "w") as f:
+        f.write(dumps())
+
+
+class _Scoped:
+    _cat = "host"
+
+    def __init__(self, name, **kwargs):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.time() * 1e6
+        return self
+
+    def stop(self):
+        if self._t0 is not None:
+            _emit(self.name, "X", self._cat, ts=self._t0, dur=time.time() * 1e6 - self._t0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Scoped):
+    _cat = "task"
+
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name)
+
+
+class Frame(_Scoped):
+    _cat = "frame"
+
+    def __init__(self, domain=None, name="frame"):
+        super().__init__(name)
+
+
+class Event(_Scoped):
+    _cat = "event"
+
+
+class Counter:
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+        _emit(self.name, "C", "counter", args={"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    def __init__(self, domain=None, name="marker"):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _emit(self.name, "i", "marker", args={"scope": scope})
+
+
+def scope(name="<unk>", append_mode=True):
+    return Event(name)

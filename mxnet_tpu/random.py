@@ -1,0 +1,96 @@
+"""Random state management.
+
+Parity: `python/mxnet/random.py` (seed) + the reference's per-context
+`ResourceRequest::kRandom` PRNG resources (`src/resource.cc:174-197`).
+
+TPU-native design: the underlying PRNG is jax's stateless threefry. A
+**key provider** hides the functional key threading behind MXNet's stateful
+API:
+
+- ``EagerKeyProvider`` — process-global state; every sampler call splits a
+  fresh subkey (used in eager mode).
+- ``TraceKeyProvider`` — used while capturing a graph (CachedOp / Symbol
+  executor): the base key is a *traced argument* of the compiled program and
+  samplers derive subkeys with ``fold_in(base, counter)``, so each executable
+  invocation gets fresh randomness with zero recompilation.
+
+Bit-exactness with the reference's MT19937/Philox streams is explicitly not a
+goal (documented divergence, SURVEY.md §7 "RNG parity").
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_provider", "TraceKeyProvider"]
+
+_state = threading.local()
+
+
+class EagerKeyProvider:
+    def __init__(self, seed_=0):
+        self._key = jax.random.PRNGKey(seed_)
+        self._lock = threading.Lock()
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def reseed(self, seed_):
+        with self._lock:
+            self._key = jax.random.PRNGKey(seed_)
+
+
+class TraceKeyProvider:
+    """Derives per-op subkeys from a (possibly traced) base key."""
+
+    def __init__(self, base_key):
+        self.base = base_key
+        self.counter = 0
+
+    def next_key(self):
+        k = jax.random.fold_in(self.base, self.counter)
+        self.counter += 1
+        return k
+
+    def __enter__(self):
+        push_provider(self)
+        return self
+
+    def __exit__(self, *a):
+        pop_provider()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = [EagerKeyProvider(0)]
+    return _state.stack
+
+
+def push_provider(p):
+    _stack().append(p)
+
+
+def pop_provider():
+    _stack().pop()
+
+
+def current_provider():
+    return _stack()[-1]
+
+
+def next_key():
+    return current_provider().next_key()
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global RNG (parity: `python/mxnet/random.py:35`).
+    ``ctx`` is accepted for API compatibility; TPU PRNG state is host-side."""
+    root = _stack()[0]
+    if isinstance(root, EagerKeyProvider):
+        root.reseed(int(seed_state))
+
+
+# nd.random / sym.random namespaces are populated by ndarray/symbol register.

@@ -1,0 +1,70 @@
+"""Runtime feature detection.
+
+Parity: `python/mxnet/runtime.py` + `src/libinfo.cc` (`mx.runtime.Features`).
+"""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _detect():
+    import jax
+
+    feats = {
+        "TPU": any(d.platform != "cpu" for d in jax.devices()),
+        "CPU": True,
+        "XLA": True,
+        "PALLAS": True,
+        "BF16": True,
+        "INT64_TENSOR_SIZE": False,
+        "DIST_KVSTORE": True,
+        "CUDA": False,
+        "CUDNN": False,
+        "MKLDNN": False,
+        "OPENCV": _has("cv2"),
+        "SIGNAL_HANDLER": True,
+        "NATIVE_ENGINE": _native(),
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+def _has(mod):
+    import importlib.util
+
+    return importlib.util.find_spec(mod) is not None
+
+
+def _native():
+    try:
+        from . import lib
+
+        return lib.native_available()
+    except Exception:
+        return False
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"Feature '{feature_name}' is unknown")
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,44 @@
+"""Misc utilities (parity: `python/mxnet/util.py`)."""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["use_np_shape", "is_np_shape", "set_np_shape", "makedirs"]
+
+_np_shape = True  # TPU build is always "numpy shape semantics"
+
+
+def is_np_shape():
+    return _np_shape
+
+
+def set_np_shape(active):
+    global _np_shape
+    prev = _np_shape
+    _np_shape = bool(active)
+    return prev
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+
+    return wrapper
+
+
+def makedirs(d):
+    import os
+
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_tpus
+
+    return num_tpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    return (0, 0)

@@ -1,0 +1,66 @@
+"""Network visualization (parity: `python/mxnet/visualization.py` —
+print_summary + plot_network)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer-by-layer summary table of a Symbol graph."""
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {e[0] for e in conf["heads"]}
+    if shape is not None:
+        _, out_shapes, _ = symbol.infer_shape(**shape)
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(f, pos):
+        line = ""
+        for i, field in enumerate(f):
+            line += str(field)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+    total_params = 0
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        if op == "null" and i not in heads and not node["name"].endswith(("weight", "bias", "gamma", "beta")):
+            continue
+        pre = ",".join(nodes[e[0]]["name"] for e in node.get("inputs", []))
+        print_row([f"{node['name']} ({op})", "", "", pre], positions)
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None, dtype=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot; requires the `graphviz` python package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("plot_network requires graphviz") from e
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title)
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null" and hide_weights and name.endswith(("weight", "bias", "gamma", "beta",
+                                                            "moving_mean", "moving_var")):
+            continue
+        dot.node(name=name, label=f"{name}\n{op}" if op != "null" else name, shape="box")
+        for e in node.get("inputs", []):
+            src = nodes[e[0]]["name"]
+            if hide_weights and nodes[e[0]]["op"] == "null" and src.endswith(
+                    ("weight", "bias", "gamma", "beta", "moving_mean", "moving_var")):
+                continue
+            dot.edge(src, name)
+    return dot

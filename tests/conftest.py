@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Runs the suite on a virtual 8-device CPU mesh so multi-chip sharding code
+paths execute without TPU hardware (SURVEY.md §4: "one test corpus, N
+backends"; XLA host-platform device-count replaces the reference's
+multi-process `tools/launch.py --launcher local` harness for unit scope).
+
+NOTE: this image's sitecustomize imports jax before conftest runs, so
+JAX_PLATFORMS via os.environ is read too late; jax.config.update works as
+long as no backend has been initialized yet. XLA_FLAGS is read at backend
+init, so setting it here is still in time.
+"""
+import os
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    yield

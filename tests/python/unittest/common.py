@@ -1,0 +1,25 @@
+"""Shared test helpers (parity: `tests/python/unittest/common.py` with_seed)."""
+import functools
+import random
+
+import numpy as np
+
+
+def with_seed(seed=None):
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            s = seed if seed is not None else random.randint(0, 2 ** 31)
+            np.random.seed(s)
+            import mxnet_tpu as mx
+
+            mx.random.seed(s)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                print(f"Error seen with seed={s}; reproduce with with_seed({s})")
+                raise
+
+        return wrapper
+
+    return decorator

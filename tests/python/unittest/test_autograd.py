@@ -1,0 +1,149 @@
+"""Autograd tests (modeled on reference `tests/python/unittest/test_autograd.py`)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(
+        a.asnumpy() if hasattr(a, "asnumpy") else a,
+        b.asnumpy() if hasattr(b, "asnumpy") else b, rtol=rtol, atol=atol)
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * 2.0).sum()
+    y.backward()
+    assert_close(x.grad, 4.0 * np.array([1.0, 2.0, 3.0]))
+
+
+def test_chain_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    w = nd.array([[0.5, -0.5], [1.0, 2.0]])
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w)
+        z = (y * y).sum()
+    z.backward()
+    xn, wn = x.asnumpy(), w.asnumpy()
+    y_np = xn @ wn
+    assert_close(x.grad, 2 * y_np @ wn.T, rtol=1e-4)
+    assert_close(w.grad, 2 * xn.T @ y_np, rtol=1e-4)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3.0
+    y.backward(nd.array([10.0, 100.0]))
+    assert_close(x.grad, np.array([30.0, 300.0]))
+
+
+def test_grad_add_req():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_close(x.grad, 3 * 2 * np.array([1.0, 2.0]))
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 3  # not recorded
+        w = y * 5
+    w.backward()
+    assert_close(x.grad, np.array([10.0]))
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    out = nd.Dropout(x, p=0.5)  # predict mode: identity
+    assert_close(out, x.asnumpy())
+    with autograd.record():
+        out = nd.Dropout(x, p=0.5)
+    kept = (out.asnumpy() != 0).mean()
+    assert 0.35 < kept < 0.65
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    gr = autograd.grad([y], x)
+    assert_close(gr, 3 * np.array([1.0, 4.0, 9.0]), rtol=1e-4)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    func = Sigmoid()
+    with autograd.record():
+        y = func(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_close(x.grad, sig * (1 - sig), rtol=1e-5)
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 4
+        z = y.detach() * x
+    z.backward()
+    assert_close(x.grad, np.array([8.0]))
+
+
+def test_retain_graph():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    assert_close(x.grad, np.array([6.0]))
+    y.backward()
+    assert_close(x.grad, np.array([6.0]))
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 5).sum()
+    y.backward()
+    assert_close(g, np.array([5.0, 5.0]))
