@@ -27,6 +27,13 @@ from . import runtime
 from . import initializer
 from . import initializer as init
 from . import lr_scheduler
+from . import optimizer
+from . import kvstore as kv
+from . import kvstore
+from . import model
+from . import recordio
+from . import gluon
+
 from . import metric
 from . import callback
 from . import monitor

@@ -210,6 +210,7 @@ def _deposit(nd_in, grad_map):
         nd_in.grad._data = jnp.asarray(g, nd_in.grad.dtype)
     elif nd_in.grad_req == "add":
         nd_in.grad._data = nd_in.grad._data + jnp.asarray(g, nd_in.grad.dtype)
+    nd_in._fresh_grad = True  # cleared by Trainer._update (stale-grad check)
     grad_map[id(nd_in)] = None  # only deposit once
 
 

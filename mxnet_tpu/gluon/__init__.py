@@ -1,0 +1,20 @@
+"""gluon — the imperative/hybrid neural-network API.
+
+Parity: `python/mxnet/gluon/__init__.py`.
+"""
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+
+from . import nn
+from . import loss
+from . import utils
+from . import trainer
+from .trainer import Trainer
+
+from . import rnn
+from . import data
+from . import model_zoo
+from . import contrib

@@ -1,0 +1,13 @@
+"""gluon.data (parity `python/mxnet/gluon/data/__init__.py`)."""
+from .dataset import *
+from .sampler import *
+from .dataloader import *
+
+from . import dataset
+from . import sampler
+from . import dataloader
+
+try:
+    from . import vision
+except ImportError:  # pragma: no cover - during staged build only
+    vision = None

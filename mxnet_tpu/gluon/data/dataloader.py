@@ -1,0 +1,166 @@
+"""gluon.data.DataLoader.
+
+Parity: `python/mxnet/gluon/data/dataloader.py` — batching, samplers,
+`batchify_fn`, multi-worker loading.
+
+TPU-native redesign of the worker path: the reference forks processes and
+ships NDArrays through POSIX shared memory (`cpu_shared_storage_manager.h`,
+`dataloader.py:55-120`) because its arrays live in worker-process heaps.
+Here workers run in a thread pool by default: batch assembly is
+numpy-bound (releases the GIL) and the device transfer happens once per
+batch on the main thread via a single `jax.device_put` — the host→HBM DMA
+queue replaces the reference's shm+pickle relay. `num_workers>0` uses a
+`multiprocessing.Pool` with numpy (picklable) batches when
+`thread_pool=False`.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import multiprocessing.pool
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack items into a batch (parity dataloader.py:127)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+def _as_numpy_batchify(data):
+    """Worker-process batchify: keep numpy (picklable, no device handles)."""
+    if isinstance(data[0], tuple):
+        return [_as_numpy_batchify(i) for i in zip(*data)]
+    return np.asarray(data)
+
+
+class _WorkerFn:
+    """Top-level callable (picklable) fetching+batchifying one index batch."""
+
+    def __init__(self, dataset, batchify_fn):
+        self._dataset = dataset
+        self._batchify_fn = batchify_fn
+
+    def __call__(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+
+def _to_nd(batch, pin_memory=False):
+    if isinstance(batch, (list, tuple)):
+        return [_to_nd(b) for b in batch]
+    if isinstance(batch, NDArray):
+        return batch
+    return nd.array(batch)
+
+
+class DataLoader:
+    """Loads data from a Dataset, returns mini-batches (parity
+    dataloader.py:422)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None, thread_pool=True):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            def same_process_iter():
+                for batch in self._batch_sampler:
+                    yield _to_nd(self._batchify_fn(
+                        [self._dataset[idx] for idx in batch]), self._pin_memory)
+            return same_process_iter()
+        return _MultiWorkerIter(self)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+
+class _MultiWorkerIter:
+    """Prefetching iterator over worker pool results (parity
+    dataloader.py:326 _MultiWorkerIter)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        bf = loader._batchify_fn
+        if loader._thread_pool:
+            self._pool = ThreadPoolExecutor(max_workers=loader._num_workers)
+            self._fn = _WorkerFn(loader._dataset, bf)
+        else:
+            self._pool = multiprocessing.Pool(loader._num_workers)
+            self._fn = _WorkerFn(
+                loader._dataset,
+                _as_numpy_batchify if bf is default_batchify_fn else bf)
+        self._batch_iter = iter(loader._batch_sampler)
+        self._pending = []
+        self._exhausted = False
+        for _ in range(max(1, loader._prefetch)):
+            self._push_next()
+
+    def _push_next(self):
+        indices = next(self._batch_iter, None)
+        if indices is None:
+            self._exhausted = True
+            return
+        if isinstance(self._pool, ThreadPoolExecutor):
+            self._pending.append(self._pool.submit(self._fn, indices))
+        else:
+            self._pending.append(self._pool.apply_async(self._fn, (indices,)))
+
+    def __next__(self):
+        if not self._pending:
+            self._shutdown()
+            raise StopIteration
+        fut = self._pending.pop(0)
+        self._push_next()
+        batch = fut.result() if hasattr(fut, "result") else fut.get()
+        return _to_nd(batch, self._loader._pin_memory)
+
+    def __iter__(self):
+        return self
+
+    def _shutdown(self):
+        if isinstance(self._pool, ThreadPoolExecutor):
+            self._pool.shutdown(wait=False)
+        else:
+            self._pool.terminate()

@@ -1,0 +1,8 @@
+"""gluon.nn — neural-network layers (parity `python/mxnet/gluon/nn/__init__.py`)."""
+from .activations import *
+from .basic_layers import *
+from .conv_layers import *
+
+from . import activations
+from . import basic_layers
+from . import conv_layers

@@ -1,0 +1,109 @@
+"""gluon utilities (parity: `python/mxnet/gluon/utils.py`)."""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1", "download",
+           "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray into `num_slice` slices along `batch_axis`
+    (parity gluon/utils.py:31 — the Module-era batch slicer,
+    `executor_group.py:65`)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into {num_slice} slices "
+            f"along axis {batch_axis}. Use a batch size that's multiple of {num_slice} "
+            f"or set even_split=False to allow uneven partitioning of data.")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    if even_split:
+        slices = [nd.slice_axis(data, axis=batch_axis, begin=i * step, end=(i + 1) * step)
+                  for i in range(num_slice)]
+    else:
+        slices = [nd.slice_axis(data, axis=batch_axis, begin=i * step,
+                                end=(i + 1) * step if i < num_slice - 1 else size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data along batch_axis and load each slice onto one context
+    (parity gluon/utils.py:81)."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the sum of their 2-norm is smaller than max_norm
+    (parity gluon/utils.py:115)."""
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = nd.add_n(*[(arr.reshape((-1,)) ** 2).sum().as_in_context(ctx)
+                            for arr in arrays])
+    total_norm = nd.sqrt(total_norm)
+    scale = max_norm / (total_norm.asscalar() + 1e-8)
+    if check_isfinite and not _np.isfinite(total_norm.asscalar()):
+        import warnings
+        warnings.warn(UserWarning("nan or inf is detected. Clipping results will be "
+                                  "undefined."), stacklevel=2)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm.asscalar()
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5, verify_ssl=True):
+    """Download a file (parity gluon/utils.py:188). This build runs with zero
+    network egress: if the file is already on disk it is used, otherwise a
+    clear error tells the user to provide it locally."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        f"download('{url}') requires network access, which is unavailable in this "
+        f"environment. Place the file at '{fname}' manually.")
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)
+
+
+def _brief_print_list(lst, limit=7):
+    lst = list(lst)
+    if len(lst) > limit:
+        return ", ".join(map(repr, lst[:limit // 2])) + ", ..., " + \
+            ", ".join(map(repr, lst[-limit // 2:]))
+    return ", ".join(map(repr, lst))

@@ -25,6 +25,14 @@ def register(klass):
     return klass
 
 
+# frontend alias names (reference uses @mx.init.register alias decorators:
+# `initializer.py` registers Zero as 'zeros', One as 'ones')
+def _register_aliases():
+    for alias_, target in (("zeros", "zero"), ("ones", "one")):
+        if target in _INIT_REGISTRY:
+            _INIT_REGISTRY[alias_] = _INIT_REGISTRY[target]
+
+
 def get(name, **kwargs):
     if isinstance(name, Initializer):
         return name
@@ -34,6 +42,20 @@ def get(name, **kwargs):
     if key not in _INIT_REGISTRY:
         raise MXNetError(f"Unknown initializer {name}")
     return _INIT_REGISTRY[key](**kwargs)
+
+
+# `create` is the frontend spelling (accepts instance | name | None);
+# `register_named` lets dynamically-built initializers (gluon Constant
+# parameters) register under an explicit key.
+create = get
+
+
+def register_named(name):
+    def deco(klass):
+        _INIT_REGISTRY[name.lower()] = klass
+        return klass
+
+    return deco
 
 
 class InitDesc(str):
@@ -282,3 +304,6 @@ class Mixed:
                 init(name, arr)
                 return
         raise MXNetError(f"Parameter name {name} did not match any pattern")
+
+
+_register_aliases()

@@ -1,0 +1,210 @@
+"""KVStore — key-value parameter synchronization.
+
+Parity: `python/mxnet/kvstore.py` + `src/kvstore/kvstore_local.h:69` (local
+reduce/broadcast across devices via `CommCPU/CommDevice`, `comm.h:103,451`)
+and the factory `src/kvstore/kvstore.cc:40-77`.
+
+TPU-native design: 'local'/'device' reduce across per-context replicas with
+XLA ops (`add_n` — one fused reduction program per key group; the reference's
+CommDevice merge-buffer trees are XLA's problem now). The 'dist_tpu_sync'
+type (see `mxnet_tpu.parallel`) replaces the entire ps-lite worker/server
+stack (`kvstore_dist.h:44`, `kvstore_dist_server.h:155`) with jax process
+groups + AllReduce over ICI/DCN — push is a reduce-scatter fused into the
+step, pull an all-gather; there are no server processes (SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "KVStoreLocal", "create"]
+
+
+def _ctx_group_sum(arrays):
+    """Sum a list of same-shape NDArrays living on (possibly) different
+    contexts; result on the first array's context."""
+    pivot = arrays[0]
+    if len(arrays) == 1:
+        return pivot.copy()
+    moved = [a.as_in_context(pivot.context) for a in arrays]
+    return nd.add_n(*moved)
+
+
+class KVStoreBase:
+    """Shared interface (parity `include/mxnet/kvstore.h:59`)."""
+
+    def __init__(self):
+        self._updater = None
+        self._updater_func = None
+        self._compression_params = None
+
+    # -- type/rank ----------------------------------------------------------
+
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression (reference `gradient_compression.cc`).
+        On TPU, ICI bandwidth makes compression rarely profitable; accepted
+        and recorded for API parity, applied only by dist kvstores."""
+        self._compression_params = dict(compression_params)
+
+    def set_optimizer(self, optimizer):
+        """Register optimizer so updates run 'on the kvstore' (parity
+        kvstore.py set_optimizer; reference runs it on the server,
+        `kvstore_dist_server.h:346` ApplyUpdates)."""
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    def barrier(self):
+        pass
+
+    def _barrier(self):
+        pass
+
+
+class KVStoreLocal(KVStoreBase):
+    """Single-process multi-device store (parity `kvstore_local.h:69`)."""
+
+    def __init__(self, device=False):
+        super().__init__()
+        self._device = device
+        self._store = {}       # key -> NDArray (the authoritative value)
+        self._str_keys = False
+
+    @property
+    def type(self):
+        return "device" if self._device else "local"
+
+    # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _normalize(key, value):
+        """Accept single key/value or lists; value may be a list of
+        per-device NDArrays per key (grouped)."""
+        if isinstance(key, (str, int)):
+            key = [key]
+            value = [value]
+        out = []
+        for k, v in zip(key, value):
+            if isinstance(v, NDArray):
+                v = [v]
+            out.append((k, list(v)))
+        return out
+
+    # -- API ----------------------------------------------------------------
+
+    def init(self, key, value):
+        """Initialize key-value pairs (parity kvstore.py:140)."""
+        for k, vals in self._normalize(key, value):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            self._store[k] = vals[0].copy()
+
+    def push(self, key, value, priority=0, ignore_sparse=True):
+        """Reduce values across devices into the store; if an optimizer is
+        registered (update_on_kvstore), apply the update immediately
+        (parity kvstore.py:160; reference PushImpl `kvstore_local.h:121`)."""
+        for k, vals in self._normalize(key, value):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized (call init first)")
+            merged = _ctx_group_sum(vals)
+            if self._updater is not None:
+                idx = k if isinstance(k, int) else _str_key_int(k)
+                weight = self._store[k]
+                merged = merged.as_in_context(weight.context)
+                self._updater(idx, merged, weight)
+            else:
+                self._store[k] = merged.as_in_context(self._store[k].context)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast store values into out arrays (parity kvstore.py:240)."""
+        assert out is not None
+        for k, outs in self._normalize(key, out):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized (call init first)")
+            src = self._store[k]
+            for o in outs:
+                o[:] = src.as_in_context(o.context)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (allreduce semantics)."""
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only selected rows (reference PullRowSparseImpl
+        `kvstore_dist.h:271`). Dense TPU rendering: gather the rows."""
+        assert out is not None and row_ids is not None
+        if isinstance(out, NDArray):
+            out = [out]
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(out)
+        key_list = [key] if isinstance(key, (str, int)) else key
+        for k, o, rid in zip(key_list * len(out), out, row_ids):
+            src = self._store[k]
+            rows = nd.take(src, rid.as_in_context(src.context))
+            o[:] = rows.as_in_context(o.context)
+
+
+def _str_key_int(k):
+    """Deterministic int for string keys (updater state indexing) — must be
+    stable across processes so saved optimizer states resume correctly
+    (python hash() is per-process randomized)."""
+    import zlib
+    return zlib.crc32(str(k).encode("utf-8")) & 0x7FFFFFFF
+
+
+class KVStore(KVStoreLocal):
+    """Alias of the concrete store for isinstance checks (parity
+    python/mxnet/kvstore.py class KVStore)."""
+
+
+def create(name="local"):
+    """Create a KVStore (parity kvstore.py:236 / factory kvstore.cc:40).
+
+    Supported: 'local', 'device' (XLA-fused local reduce);
+    'dist_sync'/'dist_device_sync'/'dist_tpu_sync' map to the SPMD
+    collective store in `mxnet_tpu.parallel` (multi-host jax runtime);
+    'dist_async' is intentionally unsupported on TPU (documented divergence
+    — SURVEY.md §2.4)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu"):
+        return KVStoreLocal(device=False)
+    if name in ("device", "local_allreduce_device", "nccl"):
+        return KVStoreLocal(device=True)
+    if name.startswith("dist"):
+        if "async" in name:
+            raise MXNetError("dist_async is not supported by the TPU build: "
+                             "synchronous SPMD collectives replace parameter servers "
+                             "(SURVEY.md §5). Use dist_sync / dist_tpu_sync.")
+        from .parallel.dist_kvstore import DistTPUSyncKVStore
+        return DistTPUSyncKVStore()
+    raise MXNetError(f"unknown kvstore type {name}")

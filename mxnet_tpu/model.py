@@ -1,0 +1,117 @@
+"""Model-level helpers: kvstore wiring + checkpointing.
+
+Parity: `python/mxnet/model.py` — `_create_kvstore`:82,
+`_update_params_on_kvstore`:150, `_update_params`:162,
+`save_checkpoint`:394, `load_checkpoint`:424. (The deprecated FeedForward
+class is intentionally not reproduced; `Module` is the supported symbolic
+trainer.)
+"""
+from __future__ import annotations
+
+import os
+
+from . import ndarray as nd
+from . import kvstore as kvs
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+from collections import namedtuple
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Create kvstore from --kv-store style input (parity model.py:82)."""
+    update_on_kvstore = bool(int(os.getenv("MXNET_UPDATE_ON_KVSTORE", "1")))
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStoreBase):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            # one device: updates happen inline; no kvstore needed
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(npy.size for npy in arg_params.values()) if arg_params else 0
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    """Push grads, pull updated weights (parity model.py:150)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
+                   param_names=None):
+    """Local updater path (parity model.py:162)."""
+    updates = [[] for _ in range(num_device)]
+    for i, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        index = i
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updates[k].append((index * num_device + k, g, w))
+    for dev_updates in updates:
+        if dev_updates:
+            i, w, g = zip(*dev_updates)
+            updater(i, w, g)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Checkpoint: `prefix-symbol.json` + `prefix-####.params`
+    (parity model.py:394)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json", remove_amp_cast=remove_amp_cast)
+    save_dict = {f"arg:{k}": v.as_in_context(_cpu()) for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v.as_in_context(_cpu()) for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load a checkpoint (parity model.py:424). Returns (symbol, arg_params,
+    aux_params)."""
+    from . import symbol as sym
+    symbol = None
+    json_path = f"{prefix}-symbol.json"
+    if os.path.exists(json_path):
+        symbol = sym.load(json_path)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params
+
+
+def _cpu():
+    from .context import cpu
+    return cpu()

@@ -37,7 +37,7 @@ def _dtype_name(dt):
 class NDArray:
     __slots__ = (
         "_data", "_ctx", "grad", "grad_req", "_ag_marked", "_stype",
-        "__weakref__",
+        "_fresh_grad", "__weakref__",
     )
 
     def __init__(self, data, ctx=None, stype="default"):
@@ -47,6 +47,9 @@ class NDArray:
         self.grad_req = "null"
         self._ag_marked = False
         self._stype = stype
+        # True once backward() has written this array's grad; cleared by
+        # Trainer._update (reference NDArray::fresh_out_grad, trainer.py:401)
+        self._fresh_grad = False
 
     # -- basic properties ---------------------------------------------------
 

@@ -23,6 +23,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
 
 from .registry import register
@@ -171,19 +172,21 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
         pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max, window, strides, pads)
+        # numpy scalar init keeps the monoid concrete under an outer trace so
+        # jax lowers to reduce_window_max (differentiable), not generic reduce_window
+        return lax.reduce_window(data, _np.asarray(init, data.dtype)[()], lax.max, window, strides, pads)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add, window, strides, pads)
+        s = lax.reduce_window(data, _np.asarray(0, data.dtype)[()], lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
         if parse_bool(count_include_pad):
             return s / math.prod(kernel)
         ones = jnp.ones(data.shape, data.dtype)
-        cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add, window, strides, pads)
+        cnt = lax.reduce_window(ones, _np.asarray(0, data.dtype)[()], lax.add, window, strides, pads)
         return s / cnt
     if pool_type == "lp":
         p = float(p_value)
-        s = lax.reduce_window(jnp.power(jnp.abs(data), p), jnp.asarray(0, data.dtype), lax.add,
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p), _np.asarray(0, data.dtype)[()], lax.add,
                               window, strides, pads)
         return jnp.power(s, 1.0 / p)
     raise ValueError(f"unknown pool_type {pool_type}")

@@ -143,11 +143,24 @@ def run_vjp(vjp_partial, cts):
     return vjp_partial(cts)
 
 
+def _in_trace(arrays):
+    """True when any input is a tracer — i.e. we are being captured into an
+    outer program (CachedOp / shape inference / user jit). In that case the
+    per-op jit wrapper must be skipped: the outer jit compiles the whole
+    graph anyway, and differentiating THROUGH a nested pjit boundary breaks
+    primitives without transpose rules (reduce_window), while inlining keeps
+    XLA free to fuse across ops (the whole point of capture)."""
+    return any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
 def invoke_with_vjp(name, *arrays, **attrs):
     """Invoke returning (outputs, vjp_partial) for tape recording."""
     op = get_op(name)
     if op.wrap_kwargs is not None:
         attrs = op.wrap_kwargs(attrs)
+    if _in_trace(arrays):
+        fn = op.fn
+        return jax.vjp(lambda *a: fn(*a, **attrs), *arrays)
     jfn = _vjp_fwd_jitted(op.name, _freeze(attrs))
     return jfn(*arrays)
 
@@ -157,6 +170,8 @@ def invoke_raw(name, *arrays, **attrs):
     op = get_op(name)
     if op.wrap_kwargs is not None:
         attrs = op.wrap_kwargs(attrs)
+    if _in_trace(arrays):
+        return op.fn(*arrays, **attrs)
     jfn = _jitted(op.name, _freeze(attrs), None)
     return jfn(*arrays)
 

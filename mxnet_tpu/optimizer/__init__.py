@@ -1,0 +1,5 @@
+"""Optimizer API (parity: `python/mxnet/optimizer/__init__.py`)."""
+from . import optimizer
+from .optimizer import *  # noqa: F401,F403
+
+__all__ = optimizer.__all__

@@ -1,0 +1,271 @@
+"""RecordIO read/write.
+
+Parity: `python/mxnet/recordio.py` (`MXRecordIO`, `MXIndexedRecordIO`,
+`IRHeader` pack/unpack) over the dmlc-core RecordIO stream format the
+reference consumes via `dmlc::RecordIOWriter/Reader` (SURVEY.md §2.2).
+
+Byte-compatible with the reference format so `.rec` datasets produced by
+the reference's `tools/im2rec` load unchanged:
+  each record = [kMagic:u32][lrec:u32][data][pad to 4B]
+  where lrec's upper 3 bits encode cflag (continue-flag for records split
+  around the magic word; we write simple records, cflag=0) and lower 29
+  bits the length.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (parity recordio.py:35)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Override pickling behavior (DataLoader worker processes)."""
+        d = dict(self.__dict__)
+        d["record"] = None
+        d["pid"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        self.open()
+
+    def _check_pid(self, allow_reset=False):
+        """Reopen after fork (reference resets handles in worker procs)."""
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError("Forbidden operation in multiple processes")
+
+    def close(self):
+        if self.record is not None and not self.record.closed:
+            self.record.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        """Insert a string buffer as a record."""
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        data = bytes(buf)
+        self.record.write(struct.pack("<II", _kMagic, len(data) & 0x1FFFFFFF))
+        self.record.write(data)
+        pad = (4 - (len(data) % 4)) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def tell(self):
+        assert self.writable
+        return self.record.tell()
+
+    def read(self):
+        """Read a record as bytes, or None at EOF."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        header = self.record.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        assert magic == _kMagic, "Invalid record magic"
+        length = lrec & 0x1FFFFFFF
+        cflag = lrec >> 29
+        data = self.record.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.record.read(pad)
+        if cflag != 0:
+            # multi-part record: keep reading continuation parts
+            parts = [data]
+            while cflag in (1, 2):
+                header = self.record.read(8)
+                magic, lrec = struct.unpack("<II", header)
+                assert magic == _kMagic
+                length = lrec & 0x1FFFFFFF
+                cflag = lrec >> 29
+                part = self.record.read(length)
+                pad = (4 - (length % 4)) % 4
+                if pad:
+                    self.record.read(pad)
+                parts.append(part)
+                if cflag == 3:
+                    break
+            data = b"".join(parts)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO via a .idx file of `key\\tposition` lines
+    (parity recordio.py:160)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        super().close()
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["fidx"] = None
+        return d
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        pos = self.idx[idx]
+        self.record.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# header for images in the .rec files produced by tools/im2rec
+# (parity recordio.py IRHeader :215)
+IRHeader = __import__("collections").namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack (header, payload bytes) into a record string (parity :239)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record string into (header, payload) (parity :268)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into header + decoded image ndarray (parity :291).
+    Needs cv2 or PIL available; raises otherwise."""
+    header, s = unpack(s)
+    img = _imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image ndarray into a record (parity :316)."""
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def _imdecode(buf, iscolor):
+    try:
+        import cv2
+        return cv2.imdecode(buf, iscolor)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+        img = np.asarray(Image.open(_io.BytesIO(buf.tobytes())))
+        if img.ndim == 3:
+            img = img[:, :, ::-1]  # RGB->BGR to match cv2 convention
+        return img
+    except ImportError as e:
+        raise ImportError("unpack_img requires cv2 or PIL") from e
+
+
+def _imencode(img, quality, img_fmt):
+    try:
+        import cv2
+        jpg_formats = [".JPG", ".JPEG"]
+        png_formats = [".PNG"]
+        encode_params = None
+        if img_fmt.upper() in jpg_formats:
+            encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+        elif img_fmt.upper() in png_formats:
+            encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+        ret, buf = cv2.imencode(img_fmt, img, encode_params)
+        assert ret, "failed to encode image"
+        return buf.tobytes()
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+        arr = img[:, :, ::-1] if img.ndim == 3 else img  # BGR->RGB
+        bio = _io.BytesIO()
+        Image.fromarray(arr).save(bio, format=img_fmt.strip(".").upper().replace("JPG", "JPEG"),
+                                  quality=quality)
+        return bio.getvalue()
+    except ImportError as e:
+        raise ImportError("pack_img requires cv2 or PIL") from e

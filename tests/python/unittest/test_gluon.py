@@ -1,0 +1,293 @@
+"""gluon Block/HybridBlock/Parameter/Trainer tests.
+
+Modeled on the reference's `tests/python/unittest/test_gluon.py` (2,731 LoC):
+parameter sharing, deferred init, hybridize correctness, layer shapes,
+save/load roundtrips, trainer semantics.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data(mx.cpu(0)).shape == (10, 10)
+    assert p.grad(mx.cpu(0)).shape == (10, 10)
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+    with pytest.raises(RuntimeError):
+        p.list_data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "test.params")
+        params.save(fname)
+        params.load(fname, mx.cpu())
+
+
+def test_parameter_sharing():
+    class Net(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    net1 = Net(prefix="net1_")
+    net2 = Net(prefix="net2_", params=net1.collect_params())
+    net1.collect_params().initialize()
+    net2(mx.nd.zeros((3, 5)))
+    net1.save_parameters("/tmp/net1.params")
+    net3 = Net(prefix="net3_")
+    net3.load_parameters("/tmp/net1.params", mx.cpu())
+    # shared params give identical outputs
+    x = mx.nd.array(np.random.rand(3, 5).astype("float32"))
+    assert np.allclose(net1(x).asnumpy(), net2(x).asnumpy())
+    assert np.allclose(net1(x).asnumpy(), net3(x).asnumpy())
+
+
+def test_basic_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False)
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    output = model(inputs)
+    assert output.shape == (2, 3, 128)
+
+
+def test_dense_flatten():
+    model = nn.Dense(128, activation="relu", in_units=30)
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    assert model(inputs).shape == (2, 128)
+
+
+def test_hybrid_matches_eager():
+    def make():
+        net = nn.HybridSequential(prefix="n_")
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        return net
+
+    net = make()
+    net.initialize()
+    x = mx.nd.array(np.random.rand(5, 8).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert np.allclose(eager, hybrid, atol=1e-6), np.abs(eager - hybrid).max()
+
+
+def test_hybrid_deferred_init():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(), nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    out = net(mx.nd.zeros((2, 3, 8, 8)))
+    assert out.shape == (2, 3)
+    assert net[0].weight.shape == (4, 3, 3, 3)
+
+
+def test_conv_layers():
+    for layer, shape, oshape in [
+        (nn.Conv1D(16, 3, in_channels=4), (1, 4, 10), (1, 16, 8)),
+        (nn.Conv2D(16, 3, in_channels=4), (1, 4, 10, 10), (1, 16, 8, 8)),
+        (nn.Conv2D(16, 3, groups=2, in_channels=4), (1, 4, 10, 10), (1, 16, 8, 8)),
+        (nn.Conv3D(16, 3, in_channels=4), (1, 4, 8, 8, 8), (1, 16, 6, 6, 6)),
+        (nn.Conv2DTranspose(16, 3, in_channels=4), (1, 4, 8, 8), (1, 16, 10, 10)),
+    ]:
+        layer.initialize()
+        out = layer(mx.nd.zeros(shape))
+        assert out.shape == oshape, (type(layer).__name__, out.shape, oshape)
+
+
+def test_pool_layers():
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8).astype("float32"))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+    # value checks
+    np_x = x.asnumpy()
+    gmax = nn.GlobalMaxPool2D()(x).asnumpy()
+    assert np.allclose(gmax[:, :, 0, 0], np_x.max(axis=(2, 3)), atol=1e-6)
+
+
+def test_batchnorm_moving_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.nd.array(np.random.rand(4, 3, 2, 2).astype("float32") * 5)
+    with autograd.record():
+        y = bn(x)
+    # moving stats must move away from init after a training-mode pass
+    assert not np.allclose(bn.running_mean.data().asnumpy(), np.zeros(3))
+    # inference path uses running stats (different result from training)
+    y2 = bn(x)
+    assert not np.allclose(y.asnumpy(), y2.asnumpy())
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(in_channels=10)
+    ln.initialize()
+    x = mx.nd.array(np.random.rand(2, 10).astype("float32"))
+    out = ln(x).asnumpy()
+    ref = (x.asnumpy() - x.asnumpy().mean(-1, keepdims=True)) / \
+        np.sqrt(x.asnumpy().var(-1, keepdims=True) + 1e-5)
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 100)
+    layer.initialize()
+    x = mx.nd.array(np.array([3, 4, 2]))
+    with autograd.record():
+        y = layer(x)
+        y.backward()
+    assert (layer.weight.grad().asnumpy()[:5] != 0).sum() == 300
+    assert (layer.weight.grad().asnumpy()[5:] == 0).all()
+
+
+def test_losses():
+    pred = mx.nd.array(np.random.rand(10, 5).astype("float32"))
+    label = mx.nd.array(np.random.randint(0, 5, 10).astype("float32"))
+    dense_label = mx.nd.one_hot(label, 5)
+    for loss_fn, lab in [
+        (gluon.loss.SoftmaxCrossEntropyLoss(), label),
+        (gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False), dense_label),
+        (gluon.loss.L2Loss(), dense_label),
+        (gluon.loss.L1Loss(), dense_label),
+        (gluon.loss.SigmoidBinaryCrossEntropyLoss(), dense_label),
+        (gluon.loss.HuberLoss(), dense_label),
+        (gluon.loss.HingeLoss(), dense_label),
+        (gluon.loss.LogisticLoss(), dense_label),
+    ]:
+        out = loss_fn(pred, lab)
+        assert out.shape == (10,), type(loss_fn).__name__
+        assert np.isfinite(out.asnumpy()).all()
+
+
+def test_sce_loss_value():
+    pred = mx.nd.array(np.array([[1.0, 2.0, 3.0]], dtype="float32"))
+    label = mx.nd.array(np.array([2], dtype="float32"))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).asscalar()
+    p = np.exp(3) / (np.exp(1) + np.exp(2) + np.exp(3))
+    assert np.allclose(loss, -np.log(p), atol=1e-5)
+
+
+def test_trainer_sgd_matches_manual():
+    w = gluon.Parameter("test_weight", shape=(4,))
+    w.initialize(init="ones", ctx=[mx.cpu()])
+    trainer = gluon.Trainer([w], "sgd", {"learning_rate": 0.5})
+    with autograd.record():
+        loss = (w.data() * 2.0).sum()
+    loss.backward()
+    trainer.step(1)
+    # dL/dw = 2 ⇒ w = 1 - 0.5*2 = 0
+    assert np.allclose(w.data().asnumpy(), np.zeros(4), atol=1e-6)
+
+
+def test_trainer_save_load_states():
+    w = gluon.Parameter("w_weight", shape=(3,))
+    w.initialize(init="ones", ctx=[mx.cpu()])
+    tr = gluon.Trainer([w], "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    with autograd.record():
+        ((w.data() ** 2).sum()).backward()
+    tr.step(1)
+    with tempfile.TemporaryDirectory() as d:
+        f = os.path.join(d, "tr.states")
+        tr.save_states(f)
+        tr2 = gluon.Trainer([w], "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+        tr2.load_states(f)
+
+
+def test_sequential_getitem_len():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(5), nn.Dense(6))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_block_attr_registration():
+    class Model(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.layers = []  # unregistered container: warning path
+                self.dense0 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense0(x)
+
+    model = Model()
+    assert "dense0" in model._children
+    params = model.collect_params()
+    assert any("dense0" in k for k in params.keys())
+
+
+def test_mlp_training_converges():
+    """Accuracy-threshold smoke in the spirit of tests/python/train/test_mlp.py."""
+    np.random.seed(0)
+    n = 256
+    X = np.random.randn(n, 10).astype("float32")
+    w_true = np.random.randn(10, 1).astype("float32")
+    yv = (X @ w_true > 0).astype("float32").ravel()
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    Xn, yn = mx.nd.array(X), mx.nd.array(yv)
+    for _ in range(60):
+        with autograd.record():
+            loss = loss_fn(net(Xn), yn)
+        loss.backward()
+        trainer.step(n)
+    preds = net(Xn).asnumpy().argmax(1)
+    acc = (preds == yv).mean()
+    assert acc > 0.95, acc
+
+
+def test_constant_parameter():
+    const = mx.nd.array(np.arange(4, dtype="float32"))
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.c = self.params.get_constant("const", const)
+
+        def hybrid_forward(self, F, x, c):
+            return x + c
+
+    net = Net()
+    net.initialize()
+    out = net(mx.nd.zeros((2, 4)))
+    assert np.allclose(out.asnumpy(), np.stack([np.arange(4)] * 2))
+    with autograd.record():
+        out = net(mx.nd.zeros((2, 4)))
+    out.backward()  # constant gets no grad; must not raise

@@ -1,0 +1,65 @@
+"""KVStore tests (modeled on reference `tests/python/unittest/test_kvstore.py`)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+
+
+def test_single_kv_pair():
+    kv = kvstore.create("local")
+    kv.init(3, mx.nd.ones((2, 3)))
+    out = mx.nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 1)
+
+
+def test_push_aggregate():
+    kv = kvstore.create("local")
+    kv.init("a", mx.nd.zeros((2, 2)))
+    vals = [mx.nd.ones((2, 2)) * i for i in range(1, 4)]
+    kv.push("a", vals)
+    out = mx.nd.zeros((2, 2))
+    kv.pull("a", out=out)
+    assert np.allclose(out.asnumpy(), 6)  # 1+2+3
+
+
+def test_list_kv_pairs():
+    kv = kvstore.create("device")
+    keys = [5, 7, 9]
+    kv.init(keys, [mx.nd.ones((2, 2))] * 3)
+    outs = [mx.nd.zeros((2, 2)) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        assert np.allclose(o.asnumpy(), 1)
+
+
+def test_updater_on_kvstore():
+    kv = kvstore.create("local")
+    from mxnet_tpu import optimizer as opt
+    kv.set_optimizer(opt.SGD(learning_rate=0.1))
+    kv.init(0, mx.nd.ones((3,)))
+    kv.push(0, mx.nd.ones((3,)))
+    out = mx.nd.zeros((3,))
+    kv.pull(0, out=out)
+    assert np.allclose(out.asnumpy(), 1 - 0.1 * 1)
+
+
+def test_row_sparse_pull():
+    kv = kvstore.create("local")
+    w = np.random.rand(6, 4).astype("float32")
+    kv.init("emb", mx.nd.array(w))
+    out = mx.nd.zeros((3, 4))
+    rid = mx.nd.array(np.array([0, 2, 5], dtype="float32"))
+    kv.row_sparse_pull("emb", out=out, row_ids=rid)
+    assert np.allclose(out.asnumpy(), w[[0, 2, 5]])
+
+
+def test_dist_async_rejected():
+    with pytest.raises(mx.MXNetError):
+        kvstore.create("dist_async")
+
+
+def test_type_property():
+    assert kvstore.create("local").type == "local"
+    assert kvstore.create("device").type == "device"

@@ -1,0 +1,145 @@
+"""Optimizer tests — fused update ops vs numpy reference math.
+
+Modeled on the reference `tests/python/unittest/test_optimizer.py` pattern:
+each optimizer's update is checked against a pure-numpy implementation.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _setup(shape=(4, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.rand(*shape).astype("float32")
+    g = rng.rand(*shape).astype("float32")
+    return w, g
+
+
+def test_sgd_basic():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.SGD(learning_rate=0.1, wd=0.0, rescale_grad=1.0)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    assert np.allclose(weight.asnumpy(), w - 0.1 * g, atol=1e-6)
+
+
+def test_sgd_momentum():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    mom = -0.1 * g
+    assert np.allclose(weight.asnumpy(), w + mom, atol=1e-6)
+    o.update(0, weight, grad, state)
+    mom2 = 0.9 * mom - 0.1 * g
+    assert np.allclose(weight.asnumpy(), w + mom + mom2, atol=1e-6)
+
+
+def test_sgd_wd():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.SGD(learning_rate=0.1, wd=0.01)
+    o.update(0, weight, grad, o.create_state(0, weight))
+    assert np.allclose(weight.asnumpy(), w - 0.1 * (g + 0.01 * w), atol=1e-6)
+
+
+def test_adam():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.Adam(learning_rate=0.01)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    # numpy reference (bias-corrected lr as in reference optimizer.py:1120)
+    m = 0.1 * g
+    v = 0.001 * g * g
+    lr = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    ref = w - lr * m / (np.sqrt(v) + 1e-8)
+    assert np.allclose(weight.asnumpy(), ref, atol=1e-6)
+
+
+def test_rmsprop():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.RMSProp(learning_rate=0.01, gamma1=0.9)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    n = 0.1 * g * g
+    ref = w - 0.01 * g / np.sqrt(n + 1e-8)
+    assert np.allclose(weight.asnumpy(), ref, atol=1e-5)
+
+
+def test_adagrad():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.AdaGrad(learning_rate=0.1, eps=1e-7)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    hist = g * g
+    ref = w - 0.1 * (g / np.sqrt(hist + 1e-7))
+    assert np.allclose(weight.asnumpy(), ref, atol=1e-5)
+
+
+def test_signum():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.Signum(learning_rate=0.1, momentum=0.0)
+    o.update(0, weight, grad, o.create_state(0, weight))
+    assert np.allclose(weight.asnumpy(), w - 0.1 * np.sign(g), atol=1e-6)
+
+
+def test_clip_gradient():
+    w, g = _setup()
+    g = g * 100
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.SGD(learning_rate=0.1, clip_gradient=1.0)
+    o.update(0, weight, grad, o.create_state(0, weight))
+    assert np.allclose(weight.asnumpy(), w - 0.1 * np.clip(g, -1, 1), atol=1e-6)
+
+
+def test_lr_scheduling_mult():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.SGD(learning_rate=0.1, param_idx2name={0: "w"})
+    o.set_lr_mult({"w": 0.5})
+    o.update(0, weight, grad, o.create_state(0, weight))
+    assert np.allclose(weight.asnumpy(), w - 0.05 * g, atol=1e-6)
+
+
+def test_create_by_name():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
+                 "adamax", "nadam", "signum", "nag", "ftml", "sgld", "dcasgd"]:
+        o = opt.create(name)
+        assert isinstance(o, opt.Optimizer), name
+
+
+def test_updater_serialization():
+    w, g = _setup()
+    weight, grad = mx.nd.array(w), mx.nd.array(g)
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    upd(0, grad, weight)
+    states = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(states)
+    upd(0, grad, weight)
+    upd2_weight = mx.nd.array(weight.asnumpy())
+    # states must match after roundtrip (same momentum continuation)
+    assert 0 in upd2.states
+
+
+def test_multi_precision_sgd():
+    w = np.random.rand(4, 3).astype("float16")
+    g = np.random.rand(4, 3).astype("float16")
+    weight, grad = mx.nd.array(w, dtype="float16"), mx.nd.array(g, dtype="float16")
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    state = o.create_state_multi_precision(0, weight)
+    # state = (momentum, fp32 master)
+    assert state[1].dtype == np.float32
+    o.update_multi_precision(0, weight, grad, state)
+    ref = w.astype("float32") - 0.1 * g.astype("float32")
+    assert np.allclose(weight.asnumpy().astype("float32"), ref.astype("float16").astype("float32"),
+                       atol=1e-3)
