@@ -1,0 +1,8 @@
+"""gluon.contrib.nn — experimental layer containers.
+
+Parity: `python/mxnet/gluon/contrib/nn/basic_layers.py` (Concurrent,
+HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+PixelShuffle1D/2D/3D).
+"""
+from .basic_layers import *
+from . import basic_layers

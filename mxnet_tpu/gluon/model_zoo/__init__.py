@@ -1,9 +1,4 @@
-"""gluon.model_zoo (parity `python/mxnet/gluon/model_zoo/__init__.py`).
-
-Populated by `vision` (resnet/vgg/densenet/... — SURVEY.md §2.3) as the
-model families land.
-"""
-try:
-    from . import vision  # noqa: F401
-except ImportError:  # pragma: no cover - during staged build only
-    pass
+"""gluon.model_zoo (parity `python/mxnet/gluon/model_zoo/__init__.py`)."""
+from . import model_store
+from . import vision
+from .vision import get_model

@@ -1,0 +1,37 @@
+"""Pretrained-weight store (parity `python/mxnet/gluon/model_zoo/model_store.py`).
+
+The reference downloads `.params` files from an S3 repo. This environment
+has no network egress, so `get_model_file` only resolves files already
+present under `root` (drop pretrained checkpoints there manually); a
+missing file raises with instructions rather than attempting a download.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+_paths_checked = ("{root}/{name}.params",)
+
+
+def get_model_file(name, root="~/.mxnet/models"):
+    """Return the path of a locally stored pretrained model file."""
+    root = os.path.expanduser(root)
+    for fmt in _paths_checked:
+        path = fmt.format(root=root, name=name)
+        if os.path.exists(path):
+            return path
+    raise FileNotFoundError(
+        f"Pretrained weights for '{name}' not found under {root}. "
+        "This environment has no network access; place the parameter file "
+        f"at {root}/{name}.params to use pretrained=True.")
+
+
+def purge(root="~/.mxnet/models"):
+    """Remove all cached model files."""
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -1,4 +1,5 @@
 """gluon.nn — neural-network layers (parity `python/mxnet/gluon/nn/__init__.py`)."""
+from ..block import Block, HybridBlock, SymbolBlock
 from .activations import *
 from .basic_layers import *
 from .conv_layers import *

@@ -31,3 +31,7 @@ def _seed():
 
     mx.random.seed(0)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")

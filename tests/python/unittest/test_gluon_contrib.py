@@ -1,0 +1,89 @@
+"""gluon.contrib layer tests (parity `tests/python/unittest/test_gluon_contrib.py`)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.nn import (
+    Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+    PixelShuffle1D, PixelShuffle2D, PixelShuffle3D)
+
+
+def test_concurrent():
+    model = HybridConcurrent(axis=1)
+    model.add(nn.Dense(128, activation="tanh", in_units=10))
+    model.add(nn.Dense(64, activation="tanh", in_units=10))
+    model.add(Identity())
+    model2 = Concurrent(axis=1)
+    model2.add(nn.Dense(128, activation="tanh", in_units=10))
+    model2.add(nn.Dense(64, activation="tanh", in_units=10))
+    model2.add(Identity())
+    model.initialize()
+    model2.initialize()
+    x = nd.random.uniform(shape=(32, 10))
+    out = model(x)
+    assert out.shape == (32, 128 + 64 + 10)
+    assert model2(x).shape == out.shape
+
+
+def test_identity():
+    model = Identity()
+    x = nd.random.uniform(shape=(128, 33, 64))
+    np.testing.assert_allclose(model(x).asnumpy(), x.asnumpy())
+
+
+def test_sparse_embedding():
+    layer = SparseEmbedding(10, 5)
+    layer.initialize()
+    x = nd.array([3, 4, 2])
+    out = layer(x)
+    assert out.shape == (3, 5)
+
+
+def test_sync_batchnorm():
+    layer = SyncBatchNorm(in_channels=4)
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 4, 3, 3))
+    out = layer(x)
+    assert out.shape == x.shape
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def _pixelshuffle_ref(x, factors):
+    """numpy reference: out[n,c,(s_i*f_i+r_i)...] = in[n, c*prod(f)+flat(r), s...]."""
+    n, cf = x.shape[:2]
+    spatial = x.shape[2:]
+    c = cf // int(np.prod(factors))
+    x = x.reshape((n, c) + tuple(factors) + spatial)
+    ndim = len(spatial)
+    # interleave: (N, C, f1..fk, s1..sk) -> (N, C, s1, f1, s2, f2, ...)
+    perm = [0, 1]
+    for i in range(ndim):
+        perm.extend([2 + ndim + i, 2 + i])
+    x = x.transpose(perm)
+    out_shape = (n, c) + tuple(s * f for s, f in zip(spatial, factors))
+    return x.reshape(out_shape)
+
+
+def test_pixelshuffle1d():
+    x = nd.arange(0, 3 * 4 * 5).reshape((1, 12, 5))
+    layer = PixelShuffle1D(4)
+    out = layer(x)
+    assert out.shape == (1, 3, 20)
+    np.testing.assert_allclose(out.asnumpy(), _pixelshuffle_ref(x.asnumpy(), (4,)))
+
+
+def test_pixelshuffle2d():
+    x = nd.arange(0, 2 * 12 * 3 * 4).reshape((2, 12, 3, 4))
+    layer = PixelShuffle2D((2, 3))
+    out = layer(x)
+    assert out.shape == (2, 2, 6, 12)
+    np.testing.assert_allclose(out.asnumpy(), _pixelshuffle_ref(x.asnumpy(), (2, 3)))
+
+
+def test_pixelshuffle3d():
+    x = nd.arange(0, 1 * 30 * 2 * 3 * 4).reshape((1, 30, 2, 3, 4))
+    layer = PixelShuffle3D((5, 3, 2))
+    out = layer(x)
+    assert out.shape == (1, 1, 10, 9, 8)
+    np.testing.assert_allclose(out.asnumpy(), _pixelshuffle_ref(x.asnumpy(), (5, 3, 2)))

@@ -1,0 +1,71 @@
+"""Model-zoo smoke tests (parity `tests/python/unittest/test_gluon_model_zoo.py`).
+
+Each model runs a tiny-batch forward at its native input size; hybridized
+so the whole network lowers to one XLA program.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def _check(name, size, classes=1000):
+    net = get_model(name, classes=classes)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random.uniform(shape=(1, 3, size, size))
+    out = net(x)
+    assert out.shape == (1, classes)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18_v1", "resnet18_v2", "resnet50_v1", "resnet50_v2"])
+def test_resnet(name):
+    _check(name, 224, classes=10)
+
+
+@pytest.mark.parametrize("name", ["vgg11", "vgg11_bn"])
+def test_vgg(name):
+    _check(name, 224, classes=10)
+
+
+def test_alexnet():
+    _check("alexnet", 224, classes=10)
+
+
+def test_densenet():
+    _check("densenet121", 224, classes=10)
+
+
+def test_squeezenet():
+    _check("squeezenet1.1", 224, classes=10)
+
+
+def test_mobilenet():
+    _check("mobilenet0.25", 224, classes=10)
+    _check("mobilenetv2_0.25", 224, classes=10)
+
+
+@pytest.mark.slow
+def test_inception():
+    _check("inceptionv3", 299, classes=10)
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        get_model("no_such_model")
+
+
+def test_all_models_constructible():
+    # every name in the registry constructs without forward
+    names = ["resnet34_v1", "resnet101_v1", "resnet152_v1", "resnet34_v2",
+             "resnet101_v2", "resnet152_v2", "vgg13", "vgg16", "vgg19",
+             "vgg13_bn", "vgg16_bn", "vgg19_bn", "densenet161", "densenet169",
+             "densenet201", "squeezenet1.0", "mobilenet1.0", "mobilenet0.75",
+             "mobilenet0.5", "mobilenetv2_1.0", "mobilenetv2_0.75",
+             "mobilenetv2_0.5", "inceptionv3"]
+    for name in names:
+        net = get_model(name, classes=10)
+        assert net is not None
