@@ -1,0 +1,85 @@
+"""Benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Mirrors the reference's headline single-device number: ResNet-50 training,
+batch 32, fp32 — 298.51 img/s on 1x V100 (`docs/faq/perf.md:227-237`,
+BASELINE.md). Prints ONE JSON line.
+"""
+import json
+import os
+import time
+
+# honour an explicit cpu request (virtual-device/test mode) before any
+# backend initialises; on the real chip JAX_PLATFORMS=axon and this no-ops
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+BASELINE_IMG_S = 298.51  # V100 fp32 b=32 training (BASELINE.md)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    import __graft_entry__ as g
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    batch = 32 if on_tpu else 8
+    size = 224 if on_tpu else 32
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.zeros((batch, 3, size, size))
+    fwd, key, params = g._pure_forward(net, x, train=True)
+
+    lr, momentum, wd = 0.1, 0.9, 1e-4
+    momenta = [jnp.zeros_like(p) for p in params]
+
+    def loss_fn(params, key, xb, yb):
+        logits = fwd(key, *params, xb).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, yb[:, None], axis=-1).mean()
+
+    @jax.jit
+    def train_step(params, momenta, key, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(params, key, xb, yb)
+        new_p, new_m = [], []
+        for p, gr, m in zip(params, grads, momenta):
+            gr = gr + wd * p
+            m = momentum * m + gr
+            new_p.append(p - lr * m)
+            new_m.append(m)
+        return new_p, new_m, loss
+
+    rng = np.random.RandomState(0)
+    xb = jnp.asarray(rng.uniform(-1, 1, (batch, 3, size, size)).astype(np.float32))
+    yb = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
+
+    # warmup (compile)
+    for _ in range(2):
+        params, momenta, loss = train_step(params, momenta, key, xb, yb)
+    jax.block_until_ready(loss)
+
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, momenta, loss = train_step(params, momenta, key, xb, yb)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

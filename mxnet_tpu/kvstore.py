@@ -205,6 +205,6 @@ def create(name="local"):
             raise MXNetError("dist_async is not supported by the TPU build: "
                              "synchronous SPMD collectives replace parameter servers "
                              "(SURVEY.md §5). Use dist_sync / dist_tpu_sync.")
-        from .parallel.dist_kvstore import DistTPUSyncKVStore
-        return DistTPUSyncKVStore()
+        from .parallel.dist import KVStoreDistTPUSync
+        return KVStoreDistTPUSync()
     raise MXNetError(f"unknown kvstore type {name}")

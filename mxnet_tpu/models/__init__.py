@@ -1,0 +1,12 @@
+"""First-class SPMD model definitions (beyond the gluon model_zoo).
+
+The gluon `model_zoo.vision` covers the reference's CNN zoo
+(`python/mxnet/gluon/model_zoo/`); this package holds TPU-first model
+families built directly on `mxnet_tpu.parallel` — sharded transformers with
+ring attention, the long-context/distributed flagships the mesh design
+exists for.
+"""
+from . import transformer
+from .transformer import TransformerLMConfig, TransformerLM
+
+__all__ = ["transformer", "TransformerLMConfig", "TransformerLM"]

@@ -1,0 +1,236 @@
+"""SPMD Transformer language model — the distributed/long-context flagship.
+
+Mapping to the reference: its sequence-model story is the fused cuDNN RNN +
+BucketingModule (`src/operator/rnn-inl.h`, `module/bucketing_module.py:36`;
+SURVEY.md §5 "long-context: none"). The TPU-native replacement is a
+transformer whose training step is ONE jitted SPMD program over a
+dp×sp×tp(+fsdp) mesh:
+
+* batch over 'dp', sequence over 'sp' (ring attention — exact attention
+  with K/V circulating the ICI ring, `parallel/ring_attention.py`),
+* Megatron-style tensor parallelism over 'tp' expressed as GSPMD sharding
+  annotations (column-parallel in-proj, row-parallel out-proj — XLA inserts
+  the psum),
+* optional 'fsdp' parameter sharding.
+
+Everything is bfloat16 on the MXU with fp32 master params and fp32 softmax.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import default_mesh
+from ..parallel.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerLMConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_layers: int = 4
+    max_len: int = 2048
+    dtype: str = "bfloat16"
+    causal: bool = True
+    tie_embeddings: bool = True
+
+
+def _spec(mesh, *axes):
+    return NamedSharding(mesh, P(*[a if (a in mesh.shape and mesh.shape[a] > 1) else None
+                                   for a in axes]))
+
+
+class TransformerLM:
+    """Functional transformer LM bound to a mesh.
+
+    params is a flat dict name -> jax.Array (sharded). All methods are
+    pure; `init_params` places every weight with its partition spec.
+    """
+
+    def __init__(self, config, mesh=None):
+        self.cfg = config
+        self.mesh = mesh or default_mesh()
+
+    # -- parameters ---------------------------------------------------------
+
+    def param_specs(self):
+        c, mesh = self.cfg, self.mesh
+        specs = {
+            "embed": _spec(mesh, "tp", None),            # [V, D] vocab-sharded
+            "pos_embed": _spec(mesh, None, None),        # [max_len, D]
+            "ln_f_scale": _spec(mesh, None),
+            "ln_f_bias": _spec(mesh, None),
+        }
+        for i in range(c.n_layers):
+            specs.update({
+                f"l{i}.ln1_scale": _spec(mesh, None),
+                f"l{i}.ln1_bias": _spec(mesh, None),
+                f"l{i}.wqkv": _spec(mesh, None, "tp"),   # [D, 3D] col-parallel
+                f"l{i}.wo": _spec(mesh, "tp", None),     # [D, D] row-parallel
+                f"l{i}.ln2_scale": _spec(mesh, None),
+                f"l{i}.ln2_bias": _spec(mesh, None),
+                f"l{i}.w1": _spec(mesh, None, "tp"),     # [D, F] col-parallel
+                f"l{i}.b1": _spec(mesh, "tp"),
+                f"l{i}.w2": _spec(mesh, "tp", None),     # [F, D] row-parallel
+                f"l{i}.b2": _spec(mesh, None),
+            })
+        if not c.tie_embeddings:
+            specs["lm_head"] = _spec(mesh, None, "tp")
+        return specs
+
+    def init_params(self, key):
+        c = self.cfg
+        dt = jnp.dtype(c.dtype)
+        shapes = {
+            "embed": (c.vocab_size, c.d_model),
+            "pos_embed": (c.max_len, c.d_model),
+            "ln_f_scale": (c.d_model,),
+            "ln_f_bias": (c.d_model,),
+        }
+        for i in range(c.n_layers):
+            shapes.update({
+                f"l{i}.ln1_scale": (c.d_model,), f"l{i}.ln1_bias": (c.d_model,),
+                f"l{i}.wqkv": (c.d_model, 3 * c.d_model),
+                f"l{i}.wo": (c.d_model, c.d_model),
+                f"l{i}.ln2_scale": (c.d_model,), f"l{i}.ln2_bias": (c.d_model,),
+                f"l{i}.w1": (c.d_model, c.d_ff), f"l{i}.b1": (c.d_ff,),
+                f"l{i}.w2": (c.d_ff, c.d_model), f"l{i}.b2": (c.d_model,),
+            })
+        if not c.tie_embeddings:
+            shapes["lm_head"] = (c.d_model, c.vocab_size)
+
+        specs = self.param_specs()
+        params = {}
+        keys = jax.random.split(key, len(shapes))
+        for (name, shape), k in zip(sorted(shapes.items()), keys):
+            if name.endswith(("_scale",)):
+                val = jnp.ones(shape, dt)
+            elif name.endswith(("_bias", ".b1", ".b2")):
+                val = jnp.zeros(shape, dt)
+            else:
+                fan_in = shape[0]
+                val = (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dt)
+            params[name] = jax.device_put(val, specs[name])
+        return params
+
+    # -- forward ------------------------------------------------------------
+
+    def _ln(self, x, scale, bias):
+        x32 = x.astype(jnp.float32)
+        mu = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        out = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+        return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+    def _attention(self, q, k, v):
+        """Dispatch: ring attention if 'sp' is a real mesh axis, else local
+        blockwise attention (same math, zero hops)."""
+        mesh, c = self.mesh, self.cfg
+        sp = mesh.shape.get("sp", 1)
+        if sp > 1:
+            from jax import shard_map
+            spec = P(("dp", "fsdp") if "fsdp" in mesh.shape else "dp", "sp", "tp", None)
+            spec = P(*[a if (isinstance(a, tuple) or (a in mesh.shape and mesh.shape[a] > 1)) else None
+                       for a in spec])
+
+            def body(q, k, v):
+                return ring_attention(q, k, v, "sp", sp, causal=c.causal)
+
+            fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+            return fn(q, k, v)
+        from ..parallel.ring_attention import _block_attn, _bhql_to_bqhl, _full_causal_bias
+        bias = _full_causal_bias(q.shape[1], k.shape[1]) if c.causal else None
+        o, m, l = _block_attn(q, k, v, bias)
+        return o / _bhql_to_bqhl(l)
+
+    def forward(self, params, tokens):
+        """tokens [B, L] int32 → logits [B, L, V] (compute dtype, fp32 at loss)."""
+        c, mesh = self.cfg, self.mesh
+        dt = jnp.dtype(c.dtype)
+        B, L = tokens.shape
+        act = P(*[a if (a in mesh.shape and mesh.shape[a] > 1) else None
+                  for a in ("dp", "sp", None)])
+
+        h = jnp.take(params["embed"], tokens, axis=0).astype(dt)
+        h = h + params["pos_embed"][None, :L].astype(dt)
+        h = lax.with_sharding_constraint(h, NamedSharding(mesh, act))
+
+        for i in range(c.n_layers):
+            ln1 = self._ln(h, params[f"l{i}.ln1_scale"], params[f"l{i}.ln1_bias"])
+            qkv = ln1 @ params[f"l{i}.wqkv"]              # [B,L,3D] heads on tp
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            hd = c.d_model // c.n_heads
+            q = q.reshape(B, L, c.n_heads, hd)
+            k = k.reshape(B, L, c.n_heads, hd)
+            v = v.reshape(B, L, c.n_heads, hd)
+            attn = self._attention(q, k, v).reshape(B, L, c.d_model)
+            h = h + attn @ params[f"l{i}.wo"]              # row-parallel: XLA psums over tp
+            h = lax.with_sharding_constraint(h, NamedSharding(mesh, act))
+            ln2 = self._ln(h, params[f"l{i}.ln2_scale"], params[f"l{i}.ln2_bias"])
+            ff = jax.nn.gelu(ln2 @ params[f"l{i}.w1"] + params[f"l{i}.b1"].astype(dt))
+            h = h + ff @ params[f"l{i}.w2"] + params[f"l{i}.b2"].astype(dt)
+            h = lax.with_sharding_constraint(h, NamedSharding(mesh, act))
+
+        h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
+        head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+        return h @ head.astype(dt)
+
+    # -- training -----------------------------------------------------------
+
+    def loss(self, params, tokens, targets):
+        logits = self.forward(params, tokens).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return nll.mean()
+
+    def make_train_step(self, optimizer=None, lr=1e-3):
+        """Return jitted (params, opt_state, tokens, targets) -> (params,
+        opt_state, loss): Adam in fp32 master precision."""
+        mesh = self.mesh
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def init_opt(params):
+            return {k: (jnp.zeros(v.shape, jnp.float32),
+                        jnp.zeros(v.shape, jnp.float32)) for k, v in params.items()}
+
+        def step(params, opt_state, tokens, targets, step_no):
+            loss, grads = jax.value_and_grad(self.loss)(params, tokens, targets)
+            new_p, new_s = {}, {}
+            t = step_no.astype(jnp.float32) + 1
+            for name, p in params.items():
+                g = grads[name].astype(jnp.float32)
+                m, v = opt_state[name]
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * g * g
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+                new_p[name] = (p.astype(jnp.float32) -
+                               lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+                new_s[name] = (m, v)
+            return new_p, new_s, loss
+
+        specs = self.param_specs()
+        state_specs = {k: (s, s) for k, s in specs.items()}
+        data_spec = NamedSharding(mesh, P(*[a if (a in mesh.shape and mesh.shape[a] > 1) else None
+                                            for a in ("dp", "sp")]))
+        repl = NamedSharding(mesh, P())
+        fn = jax.jit(step,
+                     in_shardings=(specs, state_specs, data_spec, data_spec, repl),
+                     out_shardings=(specs, state_specs, repl))
+        return fn, init_opt
+
+    def shard_tokens(self, tokens):
+        mesh = self.mesh
+        spec = NamedSharding(mesh, P(*[a if (a in mesh.shape and mesh.shape[a] > 1) else None
+                                       for a in ("dp", "sp")]))
+        return jax.device_put(jnp.asarray(tokens, jnp.int32), spec)

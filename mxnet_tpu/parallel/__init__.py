@@ -1,0 +1,47 @@
+"""Parallelism & distributed communication over TPU meshes.
+
+This package is the TPU-native answer to the reference's entire distributed
+stack (SURVEY.md §2.4): `src/kvstore/` (local/device/NCCL/ps-lite),
+`comm.h`/`comm_tree.h` device reduce trees, and `tools/launch.py` cluster
+bootstrap. Design: one `jax.sharding.Mesh` with named axes, sharding
+annotations on a single jitted SPMD program, XLA collectives over ICI/DCN.
+
+Axes convention (any subset may be present, size 1 axes are free):
+  dp    data parallelism (batch dimension)
+  fsdp  parameter sharding on the data axis (ZeRO-style)
+  tp    tensor (model) parallelism
+  sp    sequence/context parallelism (ring attention)
+  pp    pipeline stages
+  ep    expert parallelism (MoE)
+"""
+from .mesh import (
+    MeshSpec, create_mesh, default_mesh, current_mesh, use_mesh, local_mesh,
+    AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_PP, AXIS_EP,
+)
+from .collectives import (
+    all_reduce, all_gather, reduce_scatter, ppermute, barrier, psum_scatter,
+)
+from .dist import (
+    init_process_group, process_rank, process_count, device_count,
+    KVStoreDistTPUSync,
+)
+from .data_parallel import ShardedTrainer, shard_batch, replicate
+from .partition import PartitionRules, infer_param_sharding
+from .ring_attention import ring_attention, ring_self_attention
+from .pipeline import pipeline_step
+from .launcher import initialize_from_env
+
+__all__ = [
+    "MeshSpec", "create_mesh", "default_mesh", "current_mesh", "use_mesh",
+    "local_mesh",
+    "AXIS_DP", "AXIS_FSDP", "AXIS_TP", "AXIS_SP", "AXIS_PP", "AXIS_EP",
+    "all_reduce", "all_gather", "reduce_scatter", "ppermute", "barrier",
+    "psum_scatter",
+    "init_process_group", "process_rank", "process_count", "device_count",
+    "KVStoreDistTPUSync",
+    "ShardedTrainer", "shard_batch", "replicate",
+    "PartitionRules", "infer_param_sharding",
+    "ring_attention", "ring_self_attention",
+    "pipeline_step",
+    "initialize_from_env",
+]

@@ -1,0 +1,94 @@
+"""Collective communication primitives.
+
+The reference's collectives are NCCL calls (`kvstore_nccl.h`), hand-built
+reduce trees (`comm.h:451`, `comm_tree.h:50`), and ps-lite RPC
+(`kvstore_dist.h`). Here each primitive has two faces:
+
+* **in-program** (inside `shard_map`/`jit`): thin wrappers over
+  `jax.lax` collectives — XLA schedules them onto ICI.
+* **eager** (NDArray level, outside jit): a tiny jitted program built on
+  demand — the analogue of the reference pushing a reduction lambda onto
+  the engine (`comm.h Reduce`).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import default_mesh
+
+
+# -- in-program (use inside shard_map) --------------------------------------
+
+def all_reduce(x, axis_name, op="sum"):
+    """AllReduce along a mesh axis (NCCL allreduce / `comm.h` Reduce+Bcast)."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+psum_scatter = reduce_scatter
+
+
+def ppermute(x, axis_name, perm):
+    """Point-to-point ring shift; the building block of ring attention."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_shift(x, axis_name, axis_size, shift=1):
+    """Send this shard to rank+shift (mod n) — one ICI hop on a torus."""
+    perm = [(i, (i + shift) % axis_size) for i in range(axis_size)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+# -- eager (NDArray / host level) -------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _eager_allreduce_fn(mesh, axis, op):
+    spec = P(axis)
+
+    def body(x):
+        return all_reduce(x, axis, op)
+
+    from jax import shard_map
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec))
+
+
+def eager_all_reduce(value, axis=None, op="sum", mesh=None):
+    """AllReduce a replicated-per-device stacked value eagerly.
+
+    ``value``: array whose leading dim is the mesh-axis size (one slice per
+    device). Returns the same shape with every slice = the reduction.
+    """
+    mesh = mesh or default_mesh()
+    axis = axis or mesh.axis_names[0]
+    return _eager_allreduce_fn(mesh, axis, op)(value)
+
+
+def barrier(mesh=None):
+    """Block until all devices reach this point (reference
+    `KVStore::Barrier`, `kvstore_dist.h:105`): a tiny psum over the mesh."""
+    mesh = mesh or default_mesh()
+    axis = mesh.axis_names[0]
+    n = mesh.shape[axis]
+    out = eager_all_reduce(jnp.ones((n,), jnp.int32), axis=axis, mesh=mesh)
+    jax.block_until_ready(out)
+    return int(out[0])

@@ -1,0 +1,238 @@
+"""Multi-host process group + the `dist_tpu_sync` KVStore.
+
+Replaces ps-lite entirely (SURVEY.md §5): the reference runs a scheduler +
+N server processes + M workers over ZMQ (`kvstore_dist.h:44`,
+`kvstore_dist_server.h:155`), shards big keys across servers
+(`EncodeDefaultKey:533`), and applies the optimizer server-side
+(`ApplyUpdates:346`). On TPU there are no servers: every host joins one
+SPMD process group (`jax.distributed`), arrays are global, and a push is an
+AllReduce over ICI (DCN across slices) inside a tiny jitted program.
+update_on_kvstore maps to False — allreduce + local (replicated) update —
+the Horovod-style flow the reference itself uses at `gluon/trainer.py:327`.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import default_mesh, create_mesh
+from . import collectives as coll
+
+_initialized = False
+
+
+def init_process_group(coordinator=None, num_processes=None, process_id=None):
+    """Initialise jax.distributed from args or env (no-op single process).
+
+    Env rendezvous keeps the reference's names working where they map:
+    `DMLC_PS_ROOT_URI`/`DMLC_PS_ROOT_PORT` → coordinator address,
+    `DMLC_NUM_WORKER` → process count, `DMLC_WORKER_ID` → process id
+    (ps-lite's scheduler rendezvous, minus the scheduler).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or _env_coordinator()
+    if coordinator is None:
+        _initialized = True  # single-process
+        return
+    num_processes = num_processes or int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    process_id = process_id if process_id is not None else int(os.environ.get("DMLC_WORKER_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def _env_coordinator():
+    uri = os.environ.get("DMLC_PS_ROOT_URI")
+    if not uri:
+        return None
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+    return f"{uri}:{port}"
+
+
+def process_rank():
+    return jax.process_index()
+
+
+def process_count():
+    return jax.process_count()
+
+
+def device_count():
+    return len(jax.devices())
+
+
+class KVStoreDistTPUSync:
+    """`kv.create('dist_tpu_sync')` / `'dist_sync'` / `'dist'`.
+
+    Keeps the KVStore front API (init/push/pull/pushpull, `kvstore.py`) so
+    Trainer/Module code is unchanged, but push+pull together are ONE
+    AllReduce over every device in the mesh — per-key programs are compile-
+    cached by shape. Keys live replicated on the mesh.
+
+    Semantics vs reference (`kvstore_dist_server.h` sync mode): the server
+    aggregated exactly num_workers pushes then answered pulls; here the
+    collective IS the aggregation+broadcast, so a push must be made by all
+    workers collectively (SPMD) — same contract sync training already obeys.
+    """
+
+    def __init__(self, mesh=None):
+        init_process_group()
+        self.mesh = mesh or default_mesh()
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def type(self):
+        return "dist_tpu_sync"
+
+    @property
+    def rank(self):
+        return process_rank()
+
+    @property
+    def num_workers(self):
+        return process_count()
+
+    # -- data plane ----------------------------------------------------------
+
+    def _key_list(self, key, value):
+        if isinstance(key, (list, tuple)):
+            assert len(key) == len(value)
+            return list(key), list(value)
+        return [key], [value]
+
+    def init(self, key, value):
+        from ..ndarray import NDArray
+
+        keys, vals = self._key_list(key, value)
+        repl = NamedSharding(self.mesh, P())
+        for k, v in zip(keys, vals):
+            arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            self._store[k] = jax.device_put(arr, repl)
+
+    def push(self, key, value, priority=0, ignore_sparse=True):
+        """Accumulate grads: AllReduce(value) into the pending buffer."""
+        from ..ndarray import NDArray
+
+        keys, vals = self._key_list(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):  # per-device list → local sum first
+                arr = _local_sum([x._data if isinstance(x, NDArray) else x for x in v])
+            else:
+                arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            reduced = self._allreduce(arr)
+            pend = self._store.get(("pending", k))
+            self._store[("pending", k)] = reduced if pend is None else pend + reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from ..ndarray import NDArray
+
+        keys, outs = self._key_list(key, out)
+        for k, o in zip(keys, outs):
+            pend = self._store.pop(("pending", k), None)
+            if pend is not None:
+                if self._updater is not None:
+                    # update_on_kvstore=True path: run optimizer on the
+                    # aggregated grad, replicated everywhere (the TPU
+                    # version of server-side ApplyUpdates)
+                    stored = NDArray(self._store[k])
+                    kk = k if isinstance(k, int) else abs(hash(k)) % (1 << 30)
+                    self._updater(kk, NDArray(pend), stored)
+                    self._store[k] = stored._data
+                else:
+                    self._store[k] = pend
+            val = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = jnp.asarray(val, t.dtype)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull: gather the requested rows from the replicated value
+        (reference `PullRowSparseImpl`, `kvstore_dist.h:271`)."""
+        from ..ndarray import NDArray
+
+        keys, outs = self._key_list(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(keys)
+        for k, o, r in zip(keys, outs, rids):
+            val = self._store[k]
+            idx = r._data.astype(jnp.int32) if isinstance(r, NDArray) else jnp.asarray(r, jnp.int32)
+            rows = jnp.take(val, idx, axis=0)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = rows
+
+    # -- control plane -------------------------------------------------------
+
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt_mod
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    def barrier(self):
+        coll.barrier(self.mesh)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- internals -----------------------------------------------------------
+
+    def _allreduce(self, arr):
+        """Sum this key's contribution over all WORKER PROCESSES, result
+        replicated (the server-side aggregation of `kvstore_dist_server.h`
+        sync mode, minus the server).
+
+        Every device on this process holds an identical copy of the local
+        grad, so mean-over-all-devices × process_count = sum over distinct
+        process contributions — one ICI/DCN AllReduce, no ZMQ.
+        """
+        arr = jnp.asarray(arr)
+        n_proc = self.num_workers
+        if n_proc == 1:
+            return arr
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        from jax.experimental import multihost_utils
+        local = np.stack([np.asarray(arr)] * jax.local_device_count())
+        global_arr = multihost_utils.host_local_array_to_global_array(
+            local, mesh, P(axis))
+        reduced = coll.eager_all_reduce(global_arr, axis=axis, op="mean", mesh=mesh)
+        # result is replicated per device along the stacked axis; local
+        # shard 0 is addressable on every process
+        local_out = [s.data for s in reduced.addressable_shards][0]
+        return jnp.asarray(local_out[0] if local_out.ndim == arr.ndim + 1 else local_out) * n_proc
+
+
+def _local_sum(arrs):
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = out + jnp.asarray(a, out.dtype)
+    return out

@@ -1,0 +1,45 @@
+"""Multi-host bootstrap — the `tools/launch.py` / dmlc_tracker replacement.
+
+The reference launches a scheduler + servers + workers over ssh/mpi/yarn
+(`tools/launch.py:71-73`). TPU pods need none of that: every host runs the
+same SPMD program and rendezvous goes through the TPU runtime (or an
+explicit coordinator for CPU/multi-process testing). This module reads the
+environment and initialises the process group once.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def initialize_from_env():
+    """Initialise jax.distributed if env describes a multi-process job.
+
+    Recognised (first match wins):
+      * TPU pod runtime env (JAX auto-detects) — nothing to do.
+      * MXNET_COORDINATOR / MXNET_NUM_PROCESSES / MXNET_PROCESS_ID
+      * DMLC_PS_ROOT_URI / DMLC_NUM_WORKER / DMLC_WORKER_ID (reference
+        ps-lite names, minus servers+scheduler)
+      * OMPI_COMM_WORLD_* (mpirun)
+    """
+    from .dist import init_process_group
+
+    if os.environ.get("MXNET_COORDINATOR"):
+        init_process_group(
+            coordinator=os.environ["MXNET_COORDINATOR"],
+            num_processes=int(os.environ.get("MXNET_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("MXNET_PROCESS_ID", "0")),
+        )
+        return True
+    if os.environ.get("DMLC_PS_ROOT_URI"):
+        init_process_group()
+        return True
+    if os.environ.get("OMPI_COMM_WORLD_SIZE"):
+        init_process_group(
+            coordinator=os.environ.get("MXNET_COORDINATOR", "127.0.0.1:9091"),
+            num_processes=int(os.environ["OMPI_COMM_WORLD_SIZE"]),
+            process_id=int(os.environ["OMPI_COMM_WORLD_RANK"]),
+        )
+        return True
+    return False

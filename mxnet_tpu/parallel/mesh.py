@@ -1,0 +1,111 @@
+"""Device mesh management.
+
+The reference assigns work to explicit device lists (`executor_group.py:65`
+slices the batch over `ctx` lists; `comm.h` builds reduce trees over them;
+`gpu_topology.h` solves the link topology). On TPU the topology is a given:
+devices form an ICI torus, and XLA lays collectives onto it from a
+`jax.sharding.Mesh` — so the mesh IS the context list, and axis names are
+the parallelism declaration.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_PP = "pp"
+AXIS_EP = "ep"
+
+_STANDARD_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_PP, AXIS_EP)
+
+_state = threading.local()
+
+
+class MeshSpec:
+    """Declarative mesh shape: ordered {axis: size}; -1 once to absorb the
+    remaining devices (like a reshape)."""
+
+    def __init__(self, **axes):
+        if not axes:
+            axes = {AXIS_DP: -1}
+        self.axes = dict(axes)
+
+    def resolve(self, n_devices):
+        sizes = dict(self.axes)
+        wild = [k for k, v in sizes.items() if v == -1]
+        assert len(wild) <= 1, f"at most one -1 axis, got {wild}"
+        fixed = int(np.prod([v for v in sizes.values() if v != -1])) if sizes else 1
+        if wild:
+            assert n_devices % fixed == 0, \
+                f"{n_devices} devices not divisible by fixed axes product {fixed}"
+            sizes[wild[0]] = n_devices // fixed
+        total = int(np.prod(list(sizes.values())))
+        assert total == n_devices, \
+            f"mesh {sizes} covers {total} devices but {n_devices} are available"
+        return sizes
+
+
+def create_mesh(spec=None, devices=None, **axes):
+    """Create a Mesh. ``create_mesh(dp=2, tp=4)`` or ``create_mesh(dp=-1)``.
+
+    Device order follows ``jax.devices()`` — on TPU that enumeration is
+    torus-contiguous, so trailing (fastest-varying) axes get the
+    shortest ICI hops; put tp/sp innermost, dp outermost.
+    """
+    if spec is None:
+        spec = MeshSpec(**axes)
+    elif axes:
+        raise ValueError("pass either a MeshSpec or axis kwargs, not both")
+    if devices is None:
+        devices = jax.devices()
+    sizes = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(*sizes.values())
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def local_mesh(**axes):
+    """Mesh over this process's addressable devices only."""
+    return create_mesh(devices=jax.local_devices(), **(axes or {"dp": -1}))
+
+
+def default_mesh():
+    """The ambient mesh: the entered one, else a 1-D dp mesh over all
+    devices (cached)."""
+    m = current_mesh()
+    if m is not None:
+        return m
+    cached = getattr(_state, "default", None)
+    if cached is None or set(cached.devices.flat) != set(jax.devices()):
+        cached = create_mesh(dp=-1)
+        _state.default = cached
+    return cached
+
+
+def current_mesh():
+    """The innermost mesh entered via ``use_mesh`` (or None)."""
+    stack = getattr(_state, "stack", None)
+    if stack:
+        return stack[-1]
+    return None
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    """Make ``mesh`` the ambient mesh (and enter it for jax)."""
+    stack = getattr(_state, "stack", None)
+    if stack is None:
+        stack = _state.stack = []
+    stack.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        stack.pop()

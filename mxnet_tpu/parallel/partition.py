@@ -1,0 +1,82 @@
+"""Parameter partition rules → NamedSharding.
+
+Replaces the reference's manual model parallelism (`group2ctx` ctx-groups,
+`symbol.py:1376`, `AssignContext` `graph_executor.cc:920`) with GSPMD
+sharding annotations: a small rule table maps parameter names/shapes to
+`PartitionSpec`s; XLA propagates the rest.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class PartitionRules:
+    """Ordered (regex, spec_fn) rules; first match wins.
+
+    ``spec_fn(name, shape) -> PartitionSpec``; plain PartitionSpecs allowed.
+    """
+
+    def __init__(self, rules=(), default=P()):
+        self._rules = [(re.compile(pat), fn) for pat, fn in rules]
+        self._default = default
+
+    def spec_for(self, name, shape):
+        for pat, fn in self._rules:
+            if pat.search(name):
+                spec = fn(name, shape) if callable(fn) else fn
+                return _drop_unsized(spec, shape)
+        return self._default
+
+    def sharding_for(self, mesh, name, shape):
+        return NamedSharding(mesh, _prune_axes(self.spec_for(name, shape), mesh))
+
+
+def _drop_unsized(spec, shape):
+    """Clip the spec to the array's rank."""
+    parts = tuple(spec)
+    if len(parts) > len(shape):
+        parts = parts[:len(shape)]
+    return P(*parts)
+
+
+def _prune_axes(spec, mesh):
+    """Remove axes the mesh doesn't have (or that have size 1)."""
+    def keep(axis):
+        if axis is None:
+            return None
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        kept = tuple(a for a in axes if a in mesh.shape and mesh.shape[a] > 1)
+        if not kept:
+            return None
+        return kept if len(kept) > 1 else kept[0]
+    return P(*[keep(a) for a in tuple(spec)])
+
+
+def infer_param_sharding(mesh, name, shape, fsdp_min_size=2 ** 16):
+    """Default sharding policy for a parameter:
+
+    * 'tp' in mesh: matmul weights (2-D) split on the output dim for
+      column-parallel layers (Megatron-style; rule tables override for
+      row-parallel second matmuls).
+    * 'fsdp' in mesh: shard the largest divisible dim of big params
+      (ZeRO-3 / "How to Scale Your Model" fully-sharded recipe).
+    * else replicate — exactly the reference's data-parallel layout
+      (weights replicated per device, `kvstore_local.h`).
+    """
+    parts = [None] * len(shape)
+    if "tp" in mesh.shape and mesh.shape["tp"] > 1 and len(shape) >= 2:
+        tp = mesh.shape["tp"]
+        if shape[0] % tp == 0:
+            parts[0] = "tp"
+    if "fsdp" in mesh.shape and mesh.shape["fsdp"] > 1 and \
+            int(np.prod(shape)) >= fsdp_min_size:
+        fsdp = mesh.shape["fsdp"]
+        for i in range(len(shape)):
+            if parts[i] is None and shape[i] % fsdp == 0:
+                parts[i] = "fsdp"
+                break
+    return NamedSharding(mesh, P(*parts))

@@ -1,0 +1,58 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+The reference has none (SURVEY.md §2.4 row "Pipeline parallelism: ❌").
+TPU-native GPipe-style schedule: stages live on 'pp' shards, microbatches
+stream through with `ppermute` handoffs inside one SPMD program — XLA
+overlaps the per-stage compute with the boundary transfer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_step(stage_fn, params_stack, x_microbatches, axis_name, axis_size):
+    """Run a GPipe forward inside `shard_map`.
+
+    stage_fn(stage_params, h) -> h, applied by every device to the
+    microbatch currently resident on it; `params_stack` is this device's
+    stage parameters; `x_microbatches` [M, ...] local input microbatches
+    (only stage 0's are consumed). Returns [M, ...] outputs valid on the
+    LAST stage. M must be >= axis_size for full utilisation.
+    """
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    n_ticks = m + axis_size - 1
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    h_shape = x_microbatches.shape[1:]
+    # initial carry must carry the full varying-axes set up front (it picks
+    # up pp-varying params and x's data-axes on the first tick; fori_loop
+    # needs a fixed carry type): inherit x's axes via a zero of x, then add pp
+    zero = x_microbatches[0] * 0
+    state = lax.pvary(zero, (axis_name,))
+    outputs = lax.pvary(jnp.broadcast_to(zero, (m,) + h_shape), (axis_name,))
+
+    def tick(t, carry):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (when available)
+        feed = jnp.where(t < m, 1, 0)
+        mb = x_microbatches[jnp.minimum(t, m - 1)]
+        state = jnp.where((idx == 0) & (feed == 1), mb, state)
+        state = stage_fn(params_stack, state)
+        # last stage emits result for microbatch t - (axis_size - 1)
+        out_t = t - (axis_size - 1)
+        valid = (idx == axis_size - 1) & (out_t >= 0)
+        updated = outputs.at[jnp.maximum(out_t, 0)].set(state)
+        outputs = jnp.where(valid, updated, outputs)
+        # hand off to next stage
+        state = lax.ppermute(state, axis_name, perm)
+        return state, outputs
+
+    _, outputs = lax.fori_loop(0, n_ticks, tick, (state, outputs))
+    # results live on the last stage only; broadcast to every stage so the
+    # output is replicated over 'pp' (a masked psum = one-to-all over ICI)
+    outputs = lax.psum(jnp.where(idx == axis_size - 1, outputs, 0 * outputs),
+                       axis_name)
+    return outputs
