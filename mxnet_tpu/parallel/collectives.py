@@ -72,23 +72,47 @@ def _eager_allreduce_fn(mesh, axis, op):
     return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec))
 
 
+def _flat_collective_mesh(mesh):
+    """1-D view of `mesh` for eager collectives (a multi-axis mesh would
+    otherwise mis-shape the stacked leading dim)."""
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    if len(mesh.axis_names) == 1:
+        return mesh, mesh.axis_names[0]
+    flat = Mesh(_np.asarray(mesh.devices).reshape(-1), ("_all",))
+    return flat, "_all"
+
+
 def eager_all_reduce(value, axis=None, op="sum", mesh=None):
     """AllReduce a replicated-per-device stacked value eagerly.
 
     ``value``: array whose leading dim is the mesh-axis size (one slice per
-    device). Returns the same shape with every slice = the reduction.
+    device) — HOST-LOCAL slices in a multi-process job. Returns the same
+    (global) shape with every slice = the reduction.
     """
     mesh = mesh or default_mesh()
-    axis = axis or mesh.axis_names[0]
+    if axis is None or axis not in mesh.axis_names:
+        mesh, axis = _flat_collective_mesh(mesh)
+    if jax.process_count() > 1 and not isinstance(value, jax.Array):
+        # host-local stacked slices → global array (non-addressable shards
+        # can't be fed from a host-local jnp array)
+        from jax.experimental import multihost_utils
+
+        value = multihost_utils.host_local_array_to_global_array(
+            value, mesh, P(axis))
     return _eager_allreduce_fn(mesh, axis, op)(value)
 
 
 def barrier(mesh=None):
     """Block until all devices reach this point (reference
     `KVStore::Barrier`, `kvstore_dist.h:105`): a tiny psum over the mesh."""
+    import numpy as _np
+
     mesh = mesh or default_mesh()
-    axis = mesh.axis_names[0]
-    n = mesh.shape[axis]
-    out = eager_all_reduce(jnp.ones((n,), jnp.int32), axis=axis, mesh=mesh)
+    mesh, axis = _flat_collective_mesh(mesh)
+    local = _np.ones((jax.local_device_count() if jax.process_count() > 1
+                      else mesh.shape[axis],), _np.int32)
+    out = eager_all_reduce(local, axis=axis, mesh=mesh)
     jax.block_until_ready(out)
-    return int(out[0])
+    return int(out.addressable_shards[0].data[0]) if jax.process_count() > 1 else int(out[0])

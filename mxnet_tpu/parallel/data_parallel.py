@@ -101,11 +101,11 @@ class ShardedTrainer:
             out = out[0] if isinstance(out, tuple) else out
             return loss_fn(out, y)
 
-        def step(params, opt_state, key, x, y, lr):
+        def step(params, opt_state, key, x, y, lr, t):
             loss, grads = jax.value_and_grad(compute_loss)(params, key, x, y)
             new_params, new_state = [], []
             for p, g, s in zip(params, grads, opt_state):
-                np_, ns = _apply_opt(opt, p, g, s, lr)
+                np_, ns = _apply_opt(opt, p, g, s, lr, t)
                 new_params.append(np_)
                 new_state.append(ns)
             return new_params, new_state, loss
@@ -119,7 +119,7 @@ class ShardedTrainer:
                            for st, s in zip(self.opt_state, shardings)]
         self._step_fn = jax.jit(
             step,
-            in_shardings=(shardings, state_shardings, repl, data_sh, data_sh, repl),
+            in_shardings=(shardings, state_shardings, repl, data_sh, data_sh, repl, repl),
             out_shardings=(shardings, state_shardings, repl),
         )
 
@@ -140,9 +140,10 @@ class ShardedTrainer:
         opt.num_update += 1
         lr_val = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler is not None else opt.lr
         lr = jnp.asarray(lr_val, jnp.float32)
+        t = jnp.asarray(opt.num_update, jnp.int32)
         with self.mesh:
             self.params, self.opt_state, loss = self._step_fn(
-                self.params, self.opt_state, key, x, y, lr)
+                self.params, self.opt_state, key, x, y, lr, t)
         return loss
 
     def sync_to_net(self):
@@ -164,7 +165,7 @@ def _n_slots(opt):
     return 1 if name not in ("sgd",) else 0
 
 
-def _apply_opt(opt, p, g, state, lr):
+def _apply_opt(opt, p, g, state, lr, t=None):
     """Functional optimizer update on raw jax arrays.
 
     Mirrors the fused update ops of `src/operator/optimizer_op.cc` for the
@@ -193,11 +194,18 @@ def _apply_opt(opt, p, g, state, lr):
         return p - lr.astype(p.dtype) * upd, (m,)
     if "adam" in name:
         m, v = state
-        b1 = jnp.asarray(getattr(opt, "beta1", 0.9), p.dtype)
-        b2 = jnp.asarray(getattr(opt, "beta2", 0.999), p.dtype)
+        # bias correction in float32 from the raw Python floats — routing the
+        # betas through p.dtype first would round 0.999 to 1.0 in bfloat16
+        # and freeze the update entirely
+        b1f = jnp.asarray(getattr(opt, "beta1", 0.9), jnp.float32)
+        b2f = jnp.asarray(getattr(opt, "beta2", 0.999), jnp.float32)
+        b1 = b1f.astype(p.dtype)
+        b2 = b2f.astype(p.dtype)
         eps = jnp.asarray(getattr(opt, "epsilon", 1e-8), p.dtype)
+        tt = jnp.asarray(1 if t is None else t, jnp.float32)
+        lr_t = lr * jnp.sqrt(1.0 - jnp.power(b2f, tt)) / (1.0 - jnp.power(b1f, tt))
         m = b1 * m + (1 - b1) * g
         v = b2 * v + (1 - b2) * g * g
-        return p - lr.astype(p.dtype) * m / (jnp.sqrt(v) + eps), (m, v)
+        return p - lr_t.astype(p.dtype) * m / (jnp.sqrt(v) + eps), (m, v)
     # generic fallback: plain SGD on the rescaled grad
     return p - lr.astype(p.dtype) * g, state

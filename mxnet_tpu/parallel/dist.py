@@ -148,7 +148,7 @@ class KVStoreDistTPUSync:
                     # aggregated grad, replicated everywhere (the TPU
                     # version of server-side ApplyUpdates)
                     stored = NDArray(self._store[k])
-                    kk = k if isinstance(k, int) else abs(hash(k)) % (1 << 30)
+                    kk = k if isinstance(k, int) else _stable_key_index(k)
                     self._updater(kk, NDArray(pend), stored)
                     self._store[k] = stored._data
                 else:
@@ -218,8 +218,10 @@ class KVStoreDistTPUSync:
         n_proc = self.num_workers
         if n_proc == 1:
             return arr
-        mesh = self.mesh
-        axis = mesh.axis_names[0]
+        # conversion and reduction must agree on one (flattened) mesh: a
+        # multi-axis self.mesh would shard the stacked dim on axis 0 only
+        # while the reduce runs over a different mesh
+        mesh, axis = coll._flat_collective_mesh(self.mesh)
         from jax.experimental import multihost_utils
         local = np.stack([np.asarray(arr)] * jax.local_device_count())
         global_arr = multihost_utils.host_local_array_to_global_array(
@@ -229,6 +231,16 @@ class KVStoreDistTPUSync:
         # shard 0 is addressable on every process
         local_out = [s.data for s in reduced.addressable_shards][0]
         return jnp.asarray(local_out[0] if local_out.ndim == arr.ndim + 1 else local_out) * n_proc
+
+
+def _stable_key_index(key):
+    """Deterministic int index for a string key — identical across worker
+    processes and restarts (Python's str hash is salted per process, which
+    would break idx2name-keyed lr/wd multipliers and optimizer-state
+    save/load)."""
+    import zlib
+
+    return zlib.crc32(str(key).encode("utf-8")) & 0x3FFFFFFF
 
 
 def _local_sum(arrs):

@@ -31,8 +31,10 @@ def pipeline_step(stage_fn, params_stack, x_microbatches, axis_name, axis_size):
     # up pp-varying params and x's data-axes on the first tick; fori_loop
     # needs a fixed carry type): inherit x's axes via a zero of x, then add pp
     zero = x_microbatches[0] * 0
-    state = lax.pvary(zero, (axis_name,))
-    outputs = lax.pvary(jnp.broadcast_to(zero, (m,) + h_shape), (axis_name,))
+    _pvary = (lambda x, axes: lax.pcast(x, axes, to="varying")) if hasattr(lax, "pcast") \
+        else lax.pvary
+    state = _pvary(zero, (axis_name,))
+    outputs = _pvary(jnp.broadcast_to(zero, (m,) + h_shape), (axis_name,))
 
     def tick(t, carry):
         state, outputs = carry

@@ -33,23 +33,34 @@ def _block_attn(q, k, v, bias=None, scale=None):
     q: [B, Lq, H, D], k/v: [B, Lk, H, D].
     """
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # fp32 softmax: scores, max and sum-exp accumulate in float32 even when
+    # q/k/v are bfloat16 (matches the module's stated design; avoids
+    # precision loss accumulating l over many K blocks)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias
     m = jnp.max(s, axis=-1, keepdims=True)                    # [B,H,Lq,1]
     p = jnp.exp(s - lax.stop_gradient(m))
     l = jnp.sum(p, axis=-1, keepdims=True)                    # [B,H,Lq,1]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)                   # [B,Lq,H,D]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)   # [B,Lq,H,D]
     return o, m, l
 
 
 def _combine(o1, m1, l1, o2, m2, l2):
-    """Merge two streaming-softmax partials (flash-attention rescale)."""
+    """Merge two streaming-softmax partials (flash-attention rescale).
+
+    The max-shift must be gradient-inert everywhere: _block_attn computes
+    p = exp(s - stop_gradient(m)), so the rescale factors here must also be
+    stop-gradiented or spurious gradients flow through each block's argmax
+    (the shift cancels exactly in the true softmax, so killing its gradient
+    is exact, same as standard flash/ring attention backward).
+    """
     m = jnp.maximum(m1, m2)
-    a1 = jnp.exp(m1 - m)
-    a2 = jnp.exp(m2 - m)
+    a1 = jnp.exp(lax.stop_gradient(m1) - lax.stop_gradient(m))
+    a2 = jnp.exp(lax.stop_gradient(m2) - lax.stop_gradient(m))
     l = l1 * a1 + l2 * a2
-    o = o1 * _bhql_to_bqhl(a1) + o2 * _bhql_to_bqhl(a2)
+    o = o1 * _bhql_to_bqhl(a1).astype(o1.dtype) + o2 * _bhql_to_bqhl(a2).astype(o2.dtype)
     return o, m, l
 
 
@@ -98,7 +109,7 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None,
         return o, m, l, k, v
 
     o, m, l, _, _ = lax.fori_loop(0, axis_size - 1, body, (o, m, l, k, v))
-    return o / _bhql_to_bqhl(l)
+    return (o / _bhql_to_bqhl(l).astype(o.dtype)).astype(q.dtype)
 
 
 def ring_self_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
@@ -111,10 +122,11 @@ def ring_self_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     mesh = mesh or default_mesh()
     if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
         # no sequence axis — plain attention
-        o, m, l = _block_attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        qj = jnp.asarray(q)
+        o, m, l = _block_attn(qj, jnp.asarray(k), jnp.asarray(v),
                               _full_causal_bias(q.shape[1], k.shape[1]) if causal else None,
                               scale)
-        return o / _bhql_to_bqhl(l)
+        return (o / _bhql_to_bqhl(l).astype(o.dtype)).astype(qj.dtype)
     n = mesh.shape[axis_name]
 
     fn = _sharded_ring_fn(mesh, axis_name, n, causal, scale)

