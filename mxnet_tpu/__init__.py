@@ -17,6 +17,14 @@ from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
 
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+
+from . import io
+from . import module
+from . import module as mod
+
 from . import autograd
 from . import random
 from .random import seed

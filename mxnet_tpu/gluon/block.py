@@ -530,7 +530,16 @@ class HybridBlock(Block):
 
     def forward(self, x, *args):
         """Defines the forward computation; calls hybrid_forward with
-        ``F = mxnet_tpu.ndarray`` and this block's parameter arrays."""
+        ``F = mxnet_tpu.ndarray`` (NDArray inputs) or ``F =
+        mxnet_tpu.symbol`` (Symbol inputs — the reference's symbolic
+        hybridization path, used by `export`)."""
+        from ..symbol.symbol import Symbol as _Sym
+
+        if isinstance(x, _Sym):
+            from .. import symbol as _sym_api
+
+            params = {k: v.var() for k, v in self._reg_params.items()}
+            return self.hybrid_forward(_sym_api, x, *args, **params)
         if self._active and _PARAM_OVERRIDE.get() is None:
             return self._call_cached_op(x, *args)
         try:
@@ -549,15 +558,37 @@ class HybridBlock(Block):
         """Override to implement forward computation using NDArray ops via F."""
         raise NotImplementedError
 
-    def export(self, path, epoch=0):
-        """Export model parameters for deployment (reference exports
-        symbol.json + params; here params only — the program is re-traced
-        at load by SymbolBlock/load_parameters)."""
-        params = self._collect_params_with_prefix()
-        arg_dict = {f"arg:{name}": val.data(val.list_ctx()[0]).copyto(cpu())
-                    for name, val in params.items()}
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Export `path-symbol.json` + `path-####.params` for deployment
+        (reference block.py HybridBlock.export): the forward is re-traced
+        SYMBOLICALLY (F=symbol) so the emitted json round-trips through
+        `SymbolBlock.imports` and the Module checkpoint loader."""
+        from .. import symbol as _sym_api
+
+        n_in = len(self._in_fmt) if isinstance(getattr(self, "_in_fmt", None),
+                                               (list, tuple)) else 1
+        if n_in == 1:
+            data_syms = [_sym_api.var("data")]
+        else:
+            data_syms = [_sym_api.var(f"data{i}") for i in range(n_in)]
+        out = self(*data_syms)
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        sym = _sym_api.Group(list(out)) if len(out) > 1 else out[0]
+        sym.save(f"{path}-symbol.json", remove_amp_cast=remove_amp_cast)
+
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        arg_dict = {}
+        for name, param in self.collect_params().items():
+            if name in arg_names:
+                arg_dict[f"arg:{name}"] = param._reduce() if hasattr(param, "_reduce") \
+                    else param.data(param.list_ctx()[0]).copyto(cpu())
+            elif name in aux_names:
+                arg_dict[f"aux:{name}"] = param.data(param.list_ctx()[0]).copyto(cpu())
         fname = f"{path}-{epoch:04d}.params"
         nd.save(fname, arg_dict)
+        return sym
         return fname
 
 
@@ -586,10 +617,112 @@ def _param_value(p):
 
 
 class SymbolBlock(HybridBlock):
-    """Construct a block from a Symbol (reference `block.py:952`). Implemented
-    in `mxnet_tpu.symbol` terms once the symbolic API lands; placeholder here
-    raising with guidance."""
+    """A Block wrapping a pre-built Symbol graph (reference `block.py:952`):
+    the deserialization target of `HybridBlock.export` /
+    `model.save_checkpoint`. Parameters are the symbol's non-input
+    arguments; the graph executes as one jitted program through the same
+    machinery as the symbolic Executor."""
 
     def __init__(self, outputs, inputs, params=None):
-        raise NotImplementedError("SymbolBlock arrives with the symbolic API "
-                                  "(mxnet_tpu.symbol); use HybridBlock directly.")
+        from ..symbol.symbol import Symbol, Group
+
+        # bypass HybridBlock prefix machinery: param names must match the
+        # symbol's argument names exactly
+        super().__init__(prefix="", params=None)
+        self._params = ParameterDict("", shared=params)
+
+        if isinstance(inputs, Symbol):
+            inputs = list(inputs) if len(inputs) > 1 else [inputs]
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs)) if len(outputs) > 1 else outputs[0]
+        self._sym = outputs
+        self._input_names = [i.name for i in inputs]
+
+        arg_names = self._sym.list_arguments()
+        aux_names = set(self._sym.list_auxiliary_states())
+        self._param_order = []
+        for name in arg_names + sorted(aux_names):
+            if name in self._input_names:
+                continue
+            grad_req = "null" if name in aux_names else "write"
+            p = self.params.get(name, grad_req=grad_req,
+                                allow_deferred_init=True)
+            self._reg_params[name] = p
+            self._param_order.append(name)
+        self._graph_fns = {}
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None,
+                allow_missing=False, ignore_extra=False):
+        """Load an exported model: `SymbolBlock.imports('m-symbol.json',
+        ['data'], 'm-0000.params')` (reference block.py SymbolBlock.imports)."""
+        from .. import symbol as _sym_api
+
+        sym = _sym_api.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_sym_api.var(n) for n in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.collect_params().load(param_file, ctx=ctx,
+                                      allow_missing=allow_missing,
+                                      ignore_extra=ignore_extra)
+        return ret
+
+    def _sb_fn(self, train):
+        fn = self._graph_fns.get(train)
+        if fn is None:
+            from ..symbol.executor import _graph_fn
+
+            aux = self._sym.list_auxiliary_states()
+            args = [n for n in self._input_names +
+                    [p for p in self._param_order if p not in aux]]
+            # _graph_fn wants arg order = the order we pass arrays in
+            fn = _graph_fn(self._sym, args, aux, train)
+            self._graph_fns[train] = fn
+        return fn
+
+    def forward(self, x, *args):
+        from ..symbol.symbol import Symbol as _Sym
+        from .. import random as _random
+        from .. import autograd as _ag
+
+        if isinstance(x, _Sym):
+            raise MXNetError("SymbolBlock cannot be re-traced symbolically")
+        inputs = [x] + [a for a in args if a is not None]
+        if len(inputs) != len(self._input_names):
+            raise MXNetError(f"SymbolBlock expects {len(self._input_names)} "
+                             f"inputs {self._input_names}, got {len(inputs)}")
+        # finish deferred param init from input shapes
+        try:
+            for name in self._param_order:
+                self._reg_params[name].data()
+        except DeferredInitializationError:
+            shapes = {n: tuple(i.shape) for n, i in zip(self._input_names, inputs)}
+            arg_shapes, _, aux_shapes = self._sym.infer_shape_partial(**shapes)
+            arg_names = self._sym.list_arguments()
+            aux_names = self._sym.list_auxiliary_states()
+            for n, s in list(zip(arg_names, arg_shapes)) + list(zip(aux_names, aux_shapes)):
+                if n in self._reg_params and s is not None:
+                    p = self._reg_params[n]
+                    if p._data is None:
+                        p.shape = s
+                        if p._deferred_init:
+                            p._finish_deferred_init()
+                        else:
+                            p.initialize()
+        aux_set = set(self._sym.list_auxiliary_states())
+        train = bool(_ag.is_training())
+        fn = self._sb_fn(train)
+        key = _random.next_key()
+        arg_arrays = tuple(i._data for i in inputs) + tuple(
+            self._reg_params[n].data()._data for n in self._param_order
+            if n not in aux_set)
+        aux_arrays = tuple(self._reg_params[n].data()._data
+                           for n in self._sym.list_auxiliary_states())
+        outs, aux_new = fn(key, arg_arrays, aux_arrays)
+        if train:
+            for n, a in zip(self._sym.list_auxiliary_states(), aux_new):
+                self._reg_params[n].data()._data = a
+        out_nds = [NDArray(o) for o in outs]
+        return out_nds[0] if len(out_nds) == 1 else out_nds

@@ -1,0 +1,6 @@
+"""Data iterators (parity: `python/mxnet/io/`)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, MNISTIter, LibSVMIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "LibSVMIter"]

@@ -1,0 +1,419 @@
+"""Data iterators — the python I/O layer.
+
+Parity: `python/mxnet/io/io.py` (`DataIter`, `DataBatch`, `DataDesc`,
+`NDArrayIter`, `ResizeIter`, `PrefetchingIter`) plus python-native
+renderings of the C++ registered iterators the reference implements in
+`src/io/` (`MNISTIter` `iter_mnist.cc:260`, `CSVIter` `iter_csv.cc:218`,
+`LibSVMIter` `iter_libsvm.cc:200`).
+
+TPU-native notes: batches are host numpy until they reach an executor —
+the device transfer happens once per batch at the jit boundary, matching
+the reference's copy-to-ctx in `BatchLoader`/`PrefetcherIter`. The
+prefetcher here is a background thread pipelining host-side batch prep
+against device compute (the role of `iter_prefetcher.h`).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import queue as _queue
+
+import numpy as _np
+
+from ..base import MXNetError
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
+    """Name+shape (+dtype/layout) of one input stream."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, tuple(shape))
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types=None):
+        types = dict(types) if types else {}
+        return [DataDesc(n, s, types.get(n, _np.float32)) for n, s in shapes]
+
+
+class DataBatch:
+    """One minibatch: lists of data/label NDArrays + padding metadata."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            raise TypeError("Data must be list of NDArrays")
+        if label is not None and not isinstance(label, (list, tuple)):
+            raise TypeError("Label must be list of NDArrays")
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        dshapes = [d.shape for d in self.data] if self.data else []
+        lshapes = [l.shape for l in self.label] if self.label else []
+        return f"{type(self).__name__}: data shapes: {dshapes} label shapes: {lshapes}"
+
+
+class DataIter:
+    """Iterator base (reference io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize array/list/dict input to a list of (name, numpy) pairs."""
+    from ..ndarray import NDArray
+
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise ValueError(f"{default_name} must be non-empty")
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError(f"Input must be NDArray, numpy.ndarray, list or dict")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference io.py NDArrayIter): supports
+    dict/list/single data+label, shuffling, and last-batch handling
+    ('pad' | 'discard' | 'roll_over')."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        for k, v in self.data + self.label:
+            if v.shape[0] != self.num_data:
+                raise ValueError(f"{k} has {v.shape[0]} rows, expected {self.num_data}")
+        if last_batch_handle == "discard":
+            if self.num_data < batch_size:
+                raise MXNetError("not enough data for even one batch")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = _np.arange(self.num_data)
+        self.cursor = -batch_size
+        self._cache_data = None
+        self._cache_label = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = self.cursor - self.num_data - self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        from ..ndarray import array as nd_array
+
+        start = self.cursor
+        end = min(start + self.batch_size, self.num_data)
+        out = []
+        for k, v in arrays:
+            if start >= 0:
+                chunk = v[self.idx[start:end]]
+            else:  # roll_over wrapped batch
+                chunk = v[self.idx[start:]] if start < 0 else v[0:0]
+                chunk = _np.concatenate([chunk, v[self.idx[:end]]]) if end > 0 else chunk
+            if chunk.shape[0] < self.batch_size:  # pad from the front
+                pad = self.batch_size - chunk.shape[0]
+                chunk = _np.concatenate([chunk, v[self.idx[:pad]]])
+            out.append(nd_array(chunk))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch (reference ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators (the role of
+    the reference's `PrefetcherIter`, `src/io/iter_prefetcher.h`)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._depth = prefetch_depth
+        self._queue = None
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     for d in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     for d in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _start(self):
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+
+        def worker():
+            try:
+                while not self._stop.is_set():
+                    batches = []
+                    try:
+                        for it in self.iters:
+                            batches.append(it.next())
+                    except StopIteration:
+                        self._queue.put(None)
+                        return
+                    data = sum([b.data for b in batches], [])
+                    label = sum([(b.label or []) for b in batches], [])
+                    self._queue.put(DataBatch(data=data, label=label,
+                                              pad=batches[0].pad,
+                                              index=batches[0].index))
+            except Exception as e:  # surface worker errors to the consumer
+                self._queue.put(e)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (reference `iter_csv.cc:218`), python-native:
+    loads the csv once and batches in memory."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=dtype, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        super().__init__(data, label, batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch else "discard",
+                         label_name="label")
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format iterator (reference `iter_mnist.cc:260`)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 seed=0, silent=False, input_shape=None, **kwargs):
+        import gzip
+        import struct
+
+        def read_idx(path):
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                magic = struct.unpack(">I", f.read(4))[0]
+                ndim = magic & 0xFF
+                dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+                return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(dims)
+
+        images = read_idx(image).astype("float32") / 255.0
+        labels = read_idx(label).astype("float32")
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1,
+                                    images.shape[1], images.shape[2])
+        super().__init__(images, labels, batch_size=batch_size,
+                         shuffle=shuffle, label_name="label")
+
+
+class LibSVMIter(NDArrayIter):
+    """LibSVM-format iterator (reference `iter_libsvm.cc:200`), dense-backed:
+    rows parse to dense feature vectors of `data_shape`."""
+
+    def __init__(self, data_libsvm, data_shape, label_shape=None,
+                 batch_size=1, **kwargs):
+        dim = int(_np.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                vec = _np.zeros(dim, dtype=_np.float32)
+                for tok in parts[1:]:
+                    i, _, v = tok.partition(":")
+                    vec[int(i)] = float(v)
+                rows.append(vec)
+        data = _np.stack(rows).reshape((-1,) + tuple(data_shape))
+        super().__init__(data, _np.asarray(labels, dtype=_np.float32),
+                         batch_size=batch_size, label_name="label")

@@ -429,3 +429,14 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
 
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+# short aliases matching the reference registry (`metric.py` @alias decorators)
+for _alias, _cls_name in (
+    ("acc", "accuracy"), ("top_k_accuracy", "topkaccuracy"),
+    ("top_k_acc", "topkaccuracy"), ("ce", "crossentropy"),
+    ("nll_loss", "negativeloglikelihood"), ("pearsonr", "pearsoncorrelation"),
+    ("composite", "compositeevalmetric"),
+):
+    if _cls_name in _METRIC_REGISTRY:
+        _METRIC_REGISTRY[_alias] = _METRIC_REGISTRY[_cls_name]

@@ -1,0 +1,342 @@
+"""Module — the symbolic trainer.
+
+Parity: `python/mxnet/module/module.py` (`bind`:422 creating the executor
+group, `init_params`, `init_optimizer`:503, `forward`/`backward`,
+`update`:664) and `executor_group.py` (`DataParallelExecutorGroup`:143).
+
+TPU-native redesign: the reference binds one executor PER DEVICE and
+slices each batch across them (`executor_group.py:65`), reducing grads
+through KVStore. Here a single bound executor is one XLA program for the
+whole batch; multi-chip data parallelism is GSPMD sharding of that same
+program (`parallel.ShardedTrainer`), so there is no per-device executor
+list to manage — ctx lists are accepted for API parity.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..model import _create_kvstore
+from ..initializer import Uniform, InitDesc
+from ..io.io import DataDesc
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    """Bind a Symbol + data/label names into a trainable module."""
+
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._context = context if context is not None else ctx_mod.current_context()
+        if isinstance(self._context, (list, tuple)):
+            self._context = list(self._context)
+        else:
+            self._context = [self._context]
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._exec = None
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._grad_req = "write"
+
+    # -- properties ----------------------------------------------------------
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, tuple(o.shape))
+                for n, o in zip(self._output_names, self._exec.outputs)] \
+            if self._exec.outputs else None
+
+    # -- bind ----------------------------------------------------------------
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+
+        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                             for d in data_shapes]
+        self._label_shapes = [l if isinstance(l, DataDesc) else DataDesc(*l)
+                              for l in (label_shapes or [])]
+
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        shape_kwargs.update({l.name: l.shape for l in self._label_shapes})
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
+        arg_names = self._symbol.list_arguments()
+
+        type_dict = {d.name: getattr(d, "dtype", _np.float32)
+                     for d in self._data_shapes + self._label_shapes}
+        args = {n: nd.zeros(s, dtype=type_dict.get(n, "float32"))
+                for n, s in zip(arg_names, arg_shapes)}
+        auxs = {n: nd.zeros(s)
+                for n, s in zip(self._aux_names, aux_shapes)}
+
+        req = {}
+        for n in arg_names:
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+
+        from ..symbol.executor import Executor
+
+        self._exec = Executor(self._symbol, self._context[0], args=args,
+                              grad_req=req, aux_states=auxs)
+        self.binded = True
+
+        if shared_module is not None and shared_module.params_initialized:
+            arg_p, aux_p = shared_module.get_params()
+            self._exec.copy_params_from(arg_p, aux_p, allow_extra_params=True)
+            self._arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+            self._aux_params = dict(self._exec.aux_dict)
+            self.params_initialized = True
+
+    # -- params --------------------------------------------------------------
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing parameters"
+        if initializer is None and not (arg_params or aux_params):
+            initializer = Uniform(0.01)
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr[:] = arg_params[name].asnumpy() if isinstance(arg_params[name], nd.NDArray) \
+                    else arg_params[name]
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+            elif not allow_missing:
+                raise MXNetError(f"no initializer and no value for param {name}")
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr[:] = aux_params[name].asnumpy() if isinstance(aux_params[name], nd.NDArray) \
+                    else aux_params[name]
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+        self._arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        self._aux_params = dict(self._exec.aux_dict)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        return ({n: self._exec.arg_dict[n].copy() for n in self._param_names},
+                {n: v.copy() for n, v in self._exec.aux_dict.items()})
+
+    # -- optimizer -----------------------------------------------------------
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+
+        if isinstance(optimizer, str):
+            # default rescale_grad = 1/batch_size (reference module.py:503ff:
+            # SoftmaxOutput-style heads emit per-example grads summed over
+            # the batch; the optimizer normalizes)
+            batch_size = self._data_shapes[0].shape[0] if self._data_shapes else 1
+            params = dict(optimizer_params or ())
+            params.setdefault("rescale_grad", 1.0 / max(batch_size, 1))
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer = opt.create(optimizer, param_idx2name=idx2name, **params)
+        self._optimizer = optimizer
+
+        arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        kv, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), arg_params)
+        self._kvstore = kv
+        self._update_on_kvstore = update_on_kvstore
+        if kv is not None:
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            for i, name in enumerate(self._param_names):
+                kv.init(name, self._exec.arg_dict[name])
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(self._optimizer)
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # -- compute -------------------------------------------------------------
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None and self._label_names:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        # shape change (last partial batch / bucketing) → rebind cheaply
+        cur = self._exec.arg_dict
+        for name, arr in feed.items():
+            if name in cur and tuple(cur[name].shape) != tuple(arr.shape):
+                self._exec = self._exec.reshape(**{n: tuple(a.shape)
+                                                  for n, a in feed.items()})
+                break
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply gradients (reference module.py:664 → model.py:150/162)."""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        if self._kvstore is not None:
+            for i, name in enumerate(self._param_names):
+                if self._exec.grad_dict.get(name) is None:
+                    continue
+                w = self._exec.arg_dict[name]
+                g = self._exec.grad_dict[name]
+                if self._update_on_kvstore:
+                    self._kvstore.push(name, g, priority=-i)
+                    self._kvstore.pull(name, out=w, priority=-i)
+                else:
+                    self._kvstore.push(name, g, priority=-i)
+                    self._kvstore.pull(name, out=g, priority=-i)
+                    self._updater(i, g, w)
+        else:
+            for i, name in enumerate(self._param_names):
+                g = self._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                self._updater(i, g, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels)),
+            dict(zip(self._output_names, self._exec.outputs)))
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        from ..model import save_checkpoint
+
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self.symbol, arg_params, aux_params,
+                        remove_amp_cast=remove_amp_cast)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        mod._preloaded_params = (args, auxs)
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        # params are applied at bind time
+        orig_bind = mod.bind
+
+        def bind_and_set(*a, **kw):
+            orig_bind(*a, **kw)
+            mod.init_params(arg_params=args, aux_params=auxs, force_init=True)
+
+        mod.bind = bind_and_set
+        return mod
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                             for d in data_shapes]
+        self._label_shapes = [l if isinstance(l, DataDesc) else DataDesc(*l)
+                              for l in (label_shapes or [])]
+        kwargs = {d.name: d.shape for d in self._data_shapes + self._label_shapes}
+        self._exec = self._exec.reshape(**kwargs)

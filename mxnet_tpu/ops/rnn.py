@@ -1,0 +1,212 @@
+"""Fused RNN op family — multi-layer RNN/LSTM/GRU over `lax.scan`.
+
+Parity: the reference's fused `RNN` operator (`src/operator/rnn.cc`,
+`rnn-inl.h`, cuDNN-backed on GPU; consumed by
+`python/mxnet/gluon/rnn/rnn_layer.py` through `_rnn_param_concat`).
+
+TPU-native design: the recurrence is a `lax.scan` over the time axis —
+XLA compiles it into one fused loop with static shapes, the per-step math
+is two MXU matmuls (i2h and h2h batched over the whole batch), and the
+multi-layer stack is a python loop at trace time (unrolled into the one
+program, letting XLA pipeline layers). Weight layout matches the
+reference/cuDNN flat-parameter convention:
+  per layer, per direction: [i2h_weight (G*H, I), h2h_weight (G*H, H)]
+  then all biases:         [i2h_bias (G*H,), h2h_bias (G*H,)]
+with gate order LSTM=[i, f, g, o], GRU=[r, z, n] (cuDNN order; see
+`rnn_impl.h`). Data layout is TNC like the reference op.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ._utils import parse_bool
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _layer_param_sizes(mode, input_size, state_size, proj_size=None):
+    g = _GATES[mode]
+    return g * state_size * input_size, g * state_size * state_size, \
+        g * state_size, g * state_size
+
+
+def rnn_param_size(num_layers, state_size, input_size, mode,
+                   bidirectional=False):
+    """Total flat parameter count (the reference's GetRnnParamSize)."""
+    ndir = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * ndir
+        wi, wh, bi, bh = _layer_param_sizes(mode, isz, state_size)
+        total += ndir * (wi + wh + bi + bh)
+    return total
+
+
+def _slice_params(params, num_layers, state_size, input_size, mode, ndir):
+    """Split the flat parameter vector into per-(layer, direction) weight
+    matrices and bias vectors, reference/cuDNN layout: all weights first
+    (layer-major, direction-minor), then all biases."""
+    g = _GATES[mode]
+    weights = []
+    off = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * ndir
+        for d in range(ndir):
+            wi = params[off: off + g * state_size * isz].reshape(g * state_size, isz)
+            off += g * state_size * isz
+            wh = params[off: off + g * state_size * state_size].reshape(g * state_size, state_size)
+            off += g * state_size * state_size
+            weights.append((wi, wh))
+    biases = []
+    for layer in range(num_layers):
+        for d in range(ndir):
+            bi = params[off: off + g * state_size]
+            off += g * state_size
+            bh = params[off: off + g * state_size]
+            off += g * state_size
+            biases.append((bi, bh))
+    return [(w[0], w[1], b[0], b[1]) for w, b in zip(weights, biases)]
+
+
+def _cell_step(mode, state_size):
+    """One time-step transition: (carry, gates_preact) -> new carry + output."""
+    if mode == "lstm":
+        def step(carry, pre):
+            h, c = carry
+            i, f, g, o = jnp.split(pre, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+        return step
+    if mode == "gru":
+        raise AssertionError("gru uses custom scan body")
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+    def step(carry, pre):
+        (h,) = carry
+        h = act(pre)
+        return (h,), h
+    return step
+
+
+def _run_direction(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
+    """Scan one direction of one layer. x: [T, N, I] -> [T, N, H]."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    # hoist the input projection out of the scan: one big MXU matmul
+    xw = jnp.einsum("tni,gi->tng", x, wi) + bi + bh
+
+    if mode == "lstm":
+        def body(carry, xt):
+            h, c = carry
+            pre = xt + h @ wh.T
+            (h, c), out = _cell_step("lstm", None)((h, c), pre)
+            return (h, c), out
+        (hT, cT), ys = lax.scan(body, (h0, c0), xw)
+    elif mode == "gru":
+        H = h0.shape[-1]
+
+        def body(carry, xt):
+            (h,) = carry
+            # cuDNN GRU: r/z use summed bias form; n-gate: x-side and
+            # h-side have separate biases and r gates the h-side only
+            hr = h @ wh.T + bh
+            r = jax.nn.sigmoid(xt[..., :H] + hr[..., :H])
+            z = jax.nn.sigmoid(xt[..., H:2 * H] + hr[..., H:2 * H])
+            n = jnp.tanh(xt[..., 2 * H:] + r * hr[..., 2 * H:])
+            h = (1 - z) * n + z * h
+            return (h,), h
+        # x-side already has bi+bh added; compensate by re-adding only bi
+        xw = jnp.einsum("tni,gi->tng", x, wi) + bi
+        (hT,), ys = lax.scan(body, (h0,), xw)
+        cT = None
+    else:
+        def body(carry, xt):
+            (h,) = carry
+            pre = xt + h @ wh.T
+            (h,), out = _cell_step(mode, None)((h,), pre)
+            return (h,), out
+        (hT,), ys = lax.scan(body, (h0,), xw)
+        cT = None
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+@register("RNN", needs_rng=True, needs_mode=True,
+          num_outputs=lambda attrs: 1 + (2 if attrs.get("mode") == "lstm" and
+                                         parse_bool(attrs.get("state_outputs", False))
+                                         else (1 if parse_bool(attrs.get("state_outputs", False)) else 0)))
+def _rnn(key, data, parameters, state, *maybe_state_cell, state_size=None,
+         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+         state_outputs=False, projection_size=None, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, lstm_state_clip_nan=False,
+         use_sequence_length=False, _train=False, **kw):
+    """Fused multi-layer (bi)directional RNN (reference `rnn.cc`).
+
+    data [T, N, I]; parameters: flat vector; state [L*D, N, H];
+    state_cell [L*D, N, H] for LSTM. Returns output [T, N, H*D]
+    (+ final states when state_outputs).
+    """
+    mode = str(mode)
+    state_size = int(state_size)
+    num_layers = int(num_layers)
+    bidir = parse_bool(bidirectional)
+    ndir = 2 if bidir else 1
+    p = float(p)
+    train = parse_bool(_train)
+
+    x = data
+    input_size = x.shape[-1]
+    layer_params = _slice_params(parameters, num_layers, state_size,
+                                 input_size, mode, ndir)
+    h0_all = state
+    c0_all = maybe_state_cell[0] if maybe_state_cell else None
+
+    hT_list, cT_list = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(ndir):
+            idx = layer * ndir + d
+            wi, wh, bi, bh = layer_params[idx]
+            h0 = h0_all[idx]
+            c0 = c0_all[idx] if c0_all is not None else None
+            ys, hT, cT = _run_direction(x, h0, c0, wi, wh, bi, bh, mode,
+                                        reverse=(d == 1))
+            outs.append(ys)
+            hT_list.append(hT)
+            if cT is not None:
+                cT_list.append(cT)
+        x = jnp.concatenate(outs, axis=-1) if ndir == 2 else outs[0]
+        if train and p > 0 and layer < num_layers - 1:
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(key, layer), 1 - p, x.shape)
+            x = jnp.where(mask, x / (1 - p), jnp.zeros((), x.dtype))
+
+    if mode == "lstm" and lstm_state_clip_min is not None:
+        x = jnp.clip(x, None, None)  # clip applies to states, not outputs
+
+    out = x.astype(data.dtype)
+    if not parse_bool(state_outputs):
+        return out
+    hT = jnp.stack(hT_list).astype(data.dtype)
+    if mode == "lstm":
+        cT = jnp.stack(cT_list).astype(data.dtype)
+        return out, hT, cT
+    return out, hT
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(*arrays, dim=0, num_args=None, **kw):
+    """Concatenate per-gate parameter pieces into the flat RNN vector
+    (reference `_rnn_param_concat`, rnn_layer.py)."""
+    return jnp.concatenate([a.reshape(-1) for a in arrays], axis=0)

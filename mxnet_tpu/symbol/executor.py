@@ -1,0 +1,316 @@
+"""Symbolic executor — bind a Symbol into a compiled XLA program.
+
+Parity: `include/mxnet/executor.h` / `src/executor/graph_executor.cc`
+(`GraphExecutor::Init`:309, `RunOps`:1302, `Forward`:65, `Backward`:78,
+`SimpleBind`:1704) and the python wrapper `python/mxnet/executor.py`.
+
+TPU-native redesign: the reference walks the bound graph node-by-node,
+pushing each kernel onto the dependency engine (with bulked segments as an
+optimization). Here the WHOLE graph is one pure jax function — built once
+from the Symbol DAG over the shared op registry — and `jax.jit` compiles it
+per (train-flag, shape signature); XLA owns memory planning (`MXPlanMemory`'s
+role) and scheduling. Backward is `jax.vjp` over the same function (the
+`MXGradient` pass's role), with the pullback captured during `forward(
+is_train=True)` so backward never re-runs the forward.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+
+__all__ = ["Executor"]
+
+
+def _graph_fn(sym, arg_names, aux_names, train):
+    """Build the pure function of a Symbol graph:
+    fn(key, args_tuple, auxs_tuple) -> (outputs_tuple, aux_updates_tuple)."""
+    from .symbol import _topo_order
+
+    nodes = _topo_order([n for n, _ in sym._outputs])
+    arg_pos = {n: i for i, n in enumerate(arg_names)}
+    aux_pos = {n: i for i, n in enumerate(aux_names)}
+
+    # aux write-back map: aux var node id -> (producer node, output index)
+    aux_writer = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        maux = node.aux_input_indices()
+        if not maux:
+            continue
+        n_user = node.num_outputs() - len(maux)
+        for j, in_idx in enumerate(maux):
+            if in_idx < len(node.inputs):
+                child, _ = node.inputs[in_idx]
+                if child.is_variable:
+                    aux_writer[id(child)] = (node, n_user + j)
+
+    def fn(key, args, auxs):
+        env = {}
+        for node in nodes:
+            if not node.is_variable:
+                continue
+            if node.name in arg_pos:
+                env[(id(node), 0)] = args[arg_pos[node.name]]
+            elif node.name in aux_pos:
+                env[(id(node), 0)] = auxs[aux_pos[node.name]]
+            else:  # unbound variable — an error caught at bind time
+                raise MXNetError(f"variable {node.name} is not bound")
+        for nidx, node in enumerate(nodes):
+            if node.is_variable:
+                continue
+            op = _reg.get_op(node.op)
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            if op.needs_mode:
+                attrs["_train"] = train
+            f = _reg.bound_fn(node.op, **attrs)
+            ins = [env[(id(c), oi)] for c, oi in node.inputs]
+            if op.needs_rng:
+                out = f(jax.random.fold_in(key, nidx), *ins)
+            else:
+                out = f(*ins)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+        outputs = tuple(env[(id(n), oi)] for n, oi in sym._outputs)
+        aux_new = []
+        for node in nodes:
+            if node.is_variable and node.name in aux_pos:
+                w = aux_writer.get(id(node))
+                if w is not None and (id(w[0]), w[1]) in env:
+                    aux_new.append(env[(id(w[0]), w[1])])
+                else:
+                    aux_new.append(env[(id(node), 0)])
+        return outputs, tuple(aux_new)
+
+    return fn
+
+
+class Executor:
+    """A bound, compiled Symbol (reference `Executor::Forward/Backward`)."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        from ..ndarray import NDArray, zeros
+
+        self._symbol = symbol
+        self._ctx = ctx
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+
+        self.arg_dict = self._normalize(args, self._arg_names, "args")
+        self.aux_dict = self._normalize(aux_states, self._aux_names, "aux_states",
+                                        allow_missing=True)
+
+        # grad_req per argument
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in self._arg_names}
+
+        if args_grad is None:
+            self.grad_dict = {}
+        else:
+            self.grad_dict = self._normalize(args_grad, self._arg_names,
+                                             "args_grad", allow_missing=True)
+        for n in self._arg_names:
+            if self._grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                a = self.arg_dict[n]
+                self.grad_dict[n] = zeros(a.shape, dtype=a.dtype)
+
+        self.outputs = []
+        self._vjp = None
+        self._monitor_callback = None
+
+        self._fns = {}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _normalize(self, values, names, what, allow_missing=False):
+        from ..ndarray import NDArray, array as nd_array
+
+        out = {}
+        if values is None:
+            values = {}
+        if isinstance(values, (list, tuple)):
+            if len(values) != len(names):
+                raise MXNetError(f"{what}: expected {len(names)} entries "
+                                 f"({names}), got {len(values)}")
+            values = dict(zip(names, values))
+        for n in names:
+            v = values.get(n)
+            if v is None:
+                if allow_missing:
+                    continue
+                raise MXNetError(f"{what}: missing value for {n}")
+            out[n] = v if isinstance(v, NDArray) else nd_array(v)
+        return out
+
+    def _fn(self, train):
+        fn = self._fns.get(train)
+        if fn is None:
+            fn = _graph_fn(self._symbol, self._arg_names, self._aux_names, train)
+            self._fns[train] = fn
+        return fn
+
+    @functools.lru_cache(maxsize=4)
+    def _jit_fwd(self, train):
+        return jax.jit(self._fn(train))
+
+    @functools.lru_cache(maxsize=4)
+    def _jit_fwd_vjp(self, train):
+        base = self._fn(train)
+        diff = tuple(i for i, n in enumerate(self._arg_names)
+                     if self._grad_req.get(n, "null") != "null")
+
+        def fwd(key, args, auxs):
+            args = list(args)
+
+            def f(*darrs):
+                full = list(args)
+                for i, a in zip(diff, darrs):
+                    full[i] = a
+                outputs, aux_new = base(key, tuple(full), auxs)
+                return outputs, aux_new
+
+            outputs, vjp, aux_new = jax.vjp(
+                f, *[args[i] for i in diff], has_aux=True)
+            return outputs, aux_new, vjp
+
+        return jax.jit(fwd)
+
+    # -- API -----------------------------------------------------------------
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def forward(self, is_train=False, **kwargs):
+        from .. import random as _random
+        from ..ndarray import NDArray, array as nd_array
+
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown argument {k}")
+            tgt = self.arg_dict[k]
+            src = v if isinstance(v, NDArray) else nd_array(v)
+            tgt._data = jnp.asarray(src._data, tgt.dtype)
+
+        key = _random.next_key()
+        args = tuple(self.arg_dict[n]._data for n in self._arg_names)
+        auxs = tuple(self.aux_dict[n]._data for n in self._aux_names)
+
+        if is_train and any(r != "null" for r in self._grad_req.values()):
+            outputs, aux_new, vjp = self._jit_fwd_vjp(True)(key, args, auxs)
+            self._vjp = vjp
+        else:
+            outputs, aux_new = self._jit_fwd(bool(is_train))(key, args, auxs)
+            self._vjp = None
+
+        if is_train:
+            # aux write-back (moving stats) — reference mutable aux NDArrays
+            for n, a in zip(self._aux_names, aux_new):
+                self.aux_dict[n]._data = a
+
+        self.outputs = [NDArray(o) for o in outputs]
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        from ..ndarray import NDArray
+
+        if self._vjp is None:
+            raise MXNetError("backward requires forward(is_train=True) first "
+                             "(and at least one grad_req != 'null')")
+        if out_grads is None:
+            cts = tuple(jnp.ones(o.shape, o.dtype) for o in self.outputs)
+        else:
+            if isinstance(out_grads, (NDArray, _np.ndarray)):
+                out_grads = [out_grads]
+            cts = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                        for g in out_grads)
+        grads = _reg.run_vjp(self._vjp, cts)
+        diff_names = [n for n in self._arg_names
+                      if self._grad_req.get(n, "null") != "null"]
+        for n, g in zip(diff_names, grads):
+            req = self._grad_req[n]
+            tgt = self.grad_dict[n]
+            if req == "write":
+                tgt._data = g.astype(tgt.dtype)
+            elif req == "add":
+                tgt._data = tgt._data + g.astype(tgt.dtype)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        from ..ndarray import NDArray
+
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = jnp.asarray(
+                    v._data if isinstance(v, NDArray) else v,
+                    self.arg_dict[k].dtype)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown arg {k}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._data = jnp.asarray(
+                    v._data if isinstance(v, NDArray) else v,
+                    self.aux_dict[k].dtype)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux {k}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes (cheap — jit re-specializes)."""
+        from ..ndarray import zeros
+
+        new_shapes = dict(kwargs)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**{
+            k: v for k, v in new_shapes.items() if k in self._arg_names})
+        args = {}
+        for n, s in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if s is not None and tuple(cur.shape) != tuple(s):
+                args[n] = zeros(s, dtype=cur.dtype)
+            else:
+                args[n] = cur
+        auxs = {}
+        for n, s in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[n]
+            if s is not None and tuple(cur.shape) != tuple(s):
+                auxs[n] = zeros(s, dtype=cur.dtype)
+            else:
+                auxs[n] = cur
+        return Executor(self._symbol, self._ctx, args=args,
+                        grad_req=self._grad_req, aux_states=auxs)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-output monitor (reference
+        `MXExecutorSetMonitorCallbackEX`, `graph_executor.cc:115`)."""
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        return self._symbol.debug_str()

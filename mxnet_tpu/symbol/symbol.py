@@ -1,0 +1,736 @@
+"""Symbol — the declarative graph IR (reference L5a frontend half).
+
+Parity: `python/mxnet/symbol/symbol.py` (composition, `infer_shape`,
+`tojson`/`load`, `simple_bind`:1376) over the C++ nnvm Symbol
+(`3rdparty/tvm/nnvm`, `src/c_api/c_api_symbolic.cc`).
+
+TPU-native redesign: the reference lowers Symbol → nnvm Graph → GraphExecutor
+(`src/executor/graph_executor.cc:309`) which replays node kernels through the
+dependency engine. Here a Symbol is a lightweight python DAG over the SAME op
+registry the imperative path uses (`ops/registry.py`); binding compiles the
+whole graph into ONE cached XLA executable (`executor.py`) — graph passes,
+memory planning and scheduling all belong to XLA. The JSON wire format is
+kept MXNet-compatible (`nodes`/`arg_nodes`/`heads`) so checkpoints
+(`model.save_checkpoint` → `prefix-symbol.json`) and `HybridBlock.export` /
+`SymbolBlock.imports` round-trip.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import name as _name_mod
+from .. import attribute as _attribute
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+_MXNET_VERSION = 10500  # wire-format version stamp (reference libinfo 1.5.0)
+
+
+def _op_input_spec(op):
+    """(required_names, optional_name, varargs, aux_indices) for an op fn.
+
+    Tensor inputs are the fn's positional-no-default params (minus the rng
+    key); a `*maybe_x` varargs declares ONE optional trailing input named x
+    (the reference's no_bias-style optionals); a varargs named `args`
+    accepts any number of inputs (UpSampling/Concat style).
+    """
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return ["data"], None, True, ()
+    required, optional, open_varargs = [], None, False
+    for p in sig.parameters.values():
+        if p.kind is inspect.Parameter.POSITIONAL_OR_KEYWORD and \
+                p.default is inspect.Parameter.empty:
+            required.append(p.name)
+        elif p.kind is inspect.Parameter.VAR_POSITIONAL:
+            if p.name.startswith("maybe_"):
+                optional = p.name[len("maybe_"):]
+            else:
+                open_varargs = True
+    if op.needs_rng and required and required[0] == "key":
+        required = required[1:]
+    aux = tuple(op.mutate_aux or ())
+    return required, optional, open_varargs, aux
+
+
+class _Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "_id")
+
+    def __init__(self, op, name, attrs=None, inputs=None):
+        self.op = op                      # op name string or None (variable)
+        self.name = name
+        self.attrs = dict(attrs or {})    # python-typed values
+        self.inputs = list(inputs or ())  # [(node, out_index)]
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def num_outputs(self):
+        if self.is_variable:
+            return 1
+        op = _reg.get_op(self.op)
+        return op.n_out({k: v for k, v in self.attrs.items()})
+
+    def aux_input_indices(self):
+        if self.is_variable:
+            return ()
+        return tuple(_reg.get_op(self.op).mutate_aux or ())
+
+
+def _topo_order(head_nodes):
+    """Post-order DFS (stable, iterative) over the DAG."""
+    order, seen = [], set()
+    stack = [(n, False) for n in reversed(head_nodes)]
+    while stack:
+        node, expanded = stack.pop()
+        if id(node) in seen:
+            continue
+        if expanded:
+            seen.add(id(node))
+            order.append(node)
+        else:
+            stack.append((node, True))
+            for child, _ in reversed(node.inputs):
+                if id(child) not in seen:
+                    stack.append((child, False))
+    return order
+
+
+class Symbol:
+    """An immutable multi-output handle into the graph."""
+
+    def __init__(self, outputs):
+        # list of (node, out_index)
+        self._outputs = list(outputs)
+
+    # -- identity ------------------------------------------------------------
+
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        n = self.name
+        return f"<Symbol {n if n else 'Grouped'}>"
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError(f"no output named {index}; outputs: {names}")
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def get_internals(self):
+        """Symbol whose outputs are EVERY internal node output
+        (reference symbol.py get_internals)."""
+        outs = []
+        for node in _topo_order([n for n, _ in self._outputs]):
+            outs.extend((node, i) for i in range(node.num_outputs()))
+        return Symbol(outs)
+
+    def get_children(self):
+        nodes = {id(n): n for n, _ in self._outputs}
+        children = []
+        for n in nodes.values():
+            children.extend(n.inputs)
+        return Symbol(children) if children else None
+
+    # -- attrs ---------------------------------------------------------------
+
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            v = self._outputs[0][0].attrs.get(key)
+            return None if v is None else str(v)
+        return None
+
+    def list_attr(self):
+        if len(self._outputs) != 1:
+            return {}
+        return {k: str(v) for k, v in self._outputs[0][0].attrs.items()
+                if k.startswith("__") or not _is_op_param(self._outputs[0][0], k)}
+
+    def attr_dict(self):
+        out = {}
+        for node in _topo_order([n for n, _ in self._outputs]):
+            if node.attrs:
+                out[node.name] = {k: str(v) for k, v in node.attrs.items()}
+        return out
+
+    def _set_attr(self, **kwargs):
+        for n, _ in self._outputs:
+            n.attrs.update(kwargs)
+
+    # -- listing -------------------------------------------------------------
+
+    def _nodes(self):
+        return _topo_order([n for n, _ in self._outputs])
+
+    def _arg_aux_split(self):
+        """Variables in graph order, split into (args, auxs) by whether any
+        consumer uses them in an aux slot (reference FMutateInputs rule,
+        `imperative.cc` ndinputs vs auxs)."""
+        aux_ids = set()
+        nodes = self._nodes()
+        for node in nodes:
+            for ai in node.aux_input_indices():
+                if ai < len(node.inputs):
+                    child, _ = node.inputs[ai]
+                    if child.is_variable:
+                        aux_ids.add(id(child))
+        args = [n for n in nodes if n.is_variable and id(n) not in aux_ids]
+        auxs = [n for n in nodes if n.is_variable and id(n) in aux_ids]
+        return args, auxs
+
+    def list_arguments(self):
+        return [n.name for n in self._arg_aux_split()[0]]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._arg_aux_split()[1]]
+
+    def list_inputs(self):
+        return [n.name for n in self._nodes() if n.is_variable]
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.num_outputs() == 1:
+                names.append(node.name + "_output" if not node.is_variable
+                             else node.name)
+            else:
+                names.append(f"{node.name}_output{idx}")
+        return names
+
+    # -- composition sugar ---------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        raise NotImplementedError("symbol re-composition via __call__ is not "
+                                  "supported; build the graph with op calls")
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # arithmetic — lowered to the registered broadcast/scalar ops so the
+    # symbolic and imperative paths share kernels
+    def __add__(self, other):
+        return _binary(self, other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _binary(self, other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _binary(self, other, "broadcast_sub", "_rminus_scalar", swap=True)
+
+    def __mul__(self, other):
+        return _binary(self, other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _binary(self, other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _binary(self, other, "broadcast_div", "_rdiv_scalar", swap=True)
+
+    def __pow__(self, other):
+        return _binary(self, other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return _binary(self, -1.0, None, "_mul_scalar")
+
+    def __eq__(self, other):  # noqa: PLR0124 — symbolic eq builds a node
+        return _binary(self, other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        return _binary(self, other, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return _binary(self, other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return _binary(self, other, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return _binary(self, other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return _binary(self, other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def reshape(self, shape, **kwargs):
+        from . import op as _op
+        return _op.reshape(self, shape=shape, **kwargs)
+
+    def astype(self, dtype):
+        from . import op as _op
+        return _op.cast(self, dtype=dtype)
+
+    # -- serialization -------------------------------------------------------
+
+    def tojson(self, remove_amp_cast=True):
+        nodes = self._nodes()
+        node_index = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes):
+            entry = {
+                "op": "null" if n.is_variable else n.op,
+                "name": n.name,
+                "inputs": [[node_index[id(c)], oi, 0] for c, oi in n.inputs],
+            }
+            if n.attrs:
+                entry["attrs"] = {k: _attr_to_str(v) for k, v in n.attrs.items()}
+            if n.is_variable:
+                arg_nodes.append(i)
+            out_nodes.append(entry)
+        heads = [[node_index[id(n)], oi, 0] for n, oi in self._outputs]
+        graph = {
+            "nodes": out_nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", _MXNET_VERSION]},
+        }
+        return json.dumps(graph, indent=2)
+
+    def save(self, fname, remove_amp_cast=True):
+        with open(fname, "w") as f:
+            f.write(self.tojson(remove_amp_cast=remove_amp_cast))
+
+    # -- shape/type inference ------------------------------------------------
+
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes, unknown = self._infer_shape_impl(*args, **kwargs)
+        if unknown:
+            raise MXNetError(f"cannot fully infer shapes; unknown: {unknown}")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes, _ = self._infer_shape_impl(*args, **kwargs)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def _infer_shape_impl(self, *args, **kwargs):
+        import jax
+
+        if args:
+            names = self.list_arguments()
+            for n, s in zip(names, args):
+                if s is not None:
+                    kwargs.setdefault(n, s)
+        known = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+        dtypes = {}
+        shapes = _infer_graph_shapes(self, known, dtypes)
+        arg_nodes, aux_nodes = self._arg_aux_split()
+        arg_shapes = [shapes.get((id(n), 0)) for n in arg_nodes]
+        aux_shapes = [shapes.get((id(n), 0)) for n in aux_nodes]
+        out_shapes = [shapes.get((id(n), oi)) for n, oi in self._outputs]
+        unknown = [n.name for n, s in zip(arg_nodes, arg_shapes) if s is None]
+        unknown += [n.name for n, s in zip(aux_nodes, aux_shapes) if s is None]
+        return arg_shapes, out_shapes, aux_shapes, unknown
+
+    def infer_type(self, *args, **kwargs):
+        """Returns (arg_types, out_types, aux_types); defaults float32
+        (the reference's type inference with default_dtype)."""
+        names = self.list_arguments()
+        given = dict(zip(names, args)) if args else dict(kwargs)
+        arg_types = [_np.dtype(given.get(n, "float32")) for n in names]
+        aux_types = [_np.dtype("float32")] * len(self.list_auxiliary_states())
+        out_types = [_np.dtype(given.get(names[0], "float32")) if names
+                     else _np.dtype("float32")] * len(self._outputs)
+        return arg_types, out_types, aux_types
+
+    # -- binding -------------------------------------------------------------
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Infer every argument shape from the given input shapes, allocate
+        (zero-filled) arrays and bind (reference symbol.py:1376)."""
+        from .executor import Executor
+        from ..ndarray import zeros
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {n: zeros(s, dtype=type_dict.get(n, "float32"))
+                for n, s in zip(arg_names, arg_shapes)}
+        auxs = {n: zeros(s, dtype=type_dict.get(n, "float32"))
+                for n, s in zip(aux_names, aux_shapes)}
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {n: zeros(s) for n, s in zip(arg_names, arg_shapes)}
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=auxs)
+
+    # -- eval ----------------------------------------------------------------
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, args=kwargs)
+        return ex.forward()
+
+    def debug_str(self):
+        lines = []
+        for n in self._nodes():
+            kind = "Variable" if n.is_variable else n.op
+            ins = ", ".join(f"{c.name}[{oi}]" for c, oi in n.inputs)
+            lines.append(f"{kind} {n.name} <- [{ins}]")
+        return "\n".join(lines)
+
+
+def _is_op_param(node, key):
+    if node.is_variable:
+        return False
+    return True  # op attrs are op params unless double-underscored
+
+
+def _attr_to_str(v):
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    return str(v)
+
+
+def _attr_from_str(s):
+    if not isinstance(s, str):
+        return s
+    low = s.strip()
+    if low in ("True", "true"):
+        return True
+    if low in ("False", "false"):
+        return False
+    try:
+        return ast.literal_eval(low)
+    except (ValueError, SyntaxError):
+        return s
+
+
+# -- construction -----------------------------------------------------------
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference symbol.py var/Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attrs = dict(_attribute.current().get(attr) or {}) if hasattr(_attribute, "current") else dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if dtype is not None:
+        attrs["__dtype__"] = str(_np.dtype(dtype))
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    if stype is not None:
+        attrs["__storage_type__"] = stype
+    attrs.update({k: v for k, v in kwargs.items()})
+    return Symbol([(_Node(None, name, attrs), 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        if not isinstance(s, Symbol):
+            raise TypeError("Expected a list of symbols")
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    graph = json.loads(json_str)
+    raw_nodes = graph["nodes"]
+    nodes = []
+    for entry in raw_nodes:
+        op = entry["op"]
+        attrs_raw = entry.get("attrs", entry.get("param", {})) or {}
+        attrs = {k: _attr_from_str(v) for k, v in attrs_raw.items()}
+        node = _Node(None if op == "null" else op, entry["name"], attrs)
+        node.inputs = [(nodes[i], oi) for i, oi, *_ in entry["inputs"]]
+        nodes.append(node)
+    heads = [(nodes[i], oi) for i, oi, *_ in graph["heads"]]
+    return Symbol(heads)
+
+
+# -- op application (called by the generated namespace) ----------------------
+
+def _apply_op(op_name, *args, name=None, attr=None, **kwargs):
+    """Create a graph node for `op_name`, auto-creating missing parameter
+    variables the MXNet way (`fc1` → `fc1_weight`, `fc1_bias`)."""
+    op = _reg.get_op(op_name)
+    required, optional, open_varargs, aux_idx = _op_input_spec(op)
+
+    hint = op_name.lstrip("_").lower()
+    name = _name_mod.current().get(name, hint)
+
+    sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+    attrs = {k: v for k, v in kwargs.items()
+             if not isinstance(v, Symbol) and v is not None}
+    if attr:
+        attrs.update(attr)
+
+    pos_syms = [a for a in args if isinstance(a, Symbol)]
+    # None positionals are skipped (gluon passes bias=None for no_bias
+    # layers); other non-symbol positionals are rejected
+    extra_pos = [a for a in args if not isinstance(a, Symbol) and a is not None]
+    if extra_pos:
+        raise MXNetError(f"{op_name}: positional non-symbol args not "
+                         f"supported in symbol API; pass as keywords")
+
+    inputs = []
+    if open_varargs:
+        inputs = [(s._outputs[0][0], s._outputs[0][1]) for s in pos_syms]
+        for k, v in sym_kwargs.items():
+            inputs.append((v._outputs[0][0], v._outputs[0][1]))
+    else:
+        pos_iter = iter(pos_syms)
+        no_bias = bool(attrs.get("no_bias", False))
+        for in_name in required:
+            s = sym_kwargs.pop(in_name, None)
+            if s is None:
+                s = next(pos_iter, None)
+            if s is None:
+                s = var(f"{name}_{in_name}")
+            if len(s._outputs) != 1:
+                raise MXNetError(f"{op_name} input {in_name}: grouped symbol "
+                                 f"cannot be an op input")
+            inputs.append(s._outputs[0])
+        if optional is not None and not no_bias:
+            s = sym_kwargs.pop(optional, None)
+            if s is None:
+                s = next(pos_iter, None)
+            if s is None:
+                s = var(f"{name}_{optional}")
+            inputs.append(s._outputs[0])
+        leftover = list(pos_iter)
+        if leftover or sym_kwargs:
+            raise MXNetError(f"{op_name}: too many symbol inputs "
+                             f"(leftover={len(leftover)}, kw={list(sym_kwargs)})")
+
+    node = _Node(op_name, name, attrs, inputs)
+    n_out = node.num_outputs()
+    sym = Symbol([(node, i) for i in range(n_out)])
+    # multi-output stateful ops (BatchNorm) expose only the primary output
+    # for composition; extra outputs are the aux write-backs
+    if aux_idx and n_out > 1:
+        return Symbol([(node, 0)])
+    return sym
+
+
+# -- shape inference over the graph ------------------------------------------
+
+def _infer_graph_shapes(sym, known, dtypes):
+    """Forward shape propagation with per-op parameter back-fill rules.
+
+    Walks topo order; a node whose data-input shape is known back-fills its
+    parameter variables' shapes via `_PARAM_SHAPE_RULES` (the role of the
+    reference's bidirectional FInferShape, `infer_graph_attr_pass.cc:94` —
+    full bidirectional fixpoint isn't needed for the practical graphs the
+    Module API sees).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    shapes: dict = {}
+
+    def set_var(node, shape):
+        shapes[(id(node), 0)] = tuple(int(x) for x in shape)
+
+    nodes = _topo_order([n for n, _ in sym._outputs])
+    for node in nodes:
+        if node.is_variable:
+            if node.name in known:
+                set_var(node, known[node.name])
+            elif "__shape__" in node.attrs:
+                set_var(node, node.attrs["__shape__"])
+
+    progress = True
+    while progress:
+        progress = False
+        for node in nodes:
+            if node.is_variable:
+                continue
+            if all((id(c), oi) in shapes for c, oi in node.inputs):
+                if (id(node), 0) in shapes:
+                    continue
+                in_shapes = [shapes[(id(c), oi)] for c, oi in node.inputs]
+                out_sh = _eval_node_shapes(node, in_shapes)
+                for i, s in enumerate(out_sh):
+                    shapes[(id(node), i)] = s
+                progress = True
+            else:
+                rule = _PARAM_SHAPE_RULES.get(node.op)
+                if rule is None:
+                    continue
+                filled = rule(node, shapes)
+                if filled:
+                    progress = True
+    return shapes
+
+
+def _eval_node_shapes(node, in_shapes):
+    import jax
+    import jax.numpy as jnp
+
+    attrs = dict(node.attrs)
+    attrs.pop("__shape__", None)
+    op = _reg.get_op(node.op)
+    if op.needs_mode:
+        attrs.setdefault("_train", False)
+    fn = _reg.bound_fn(node.op, **{k: v for k, v in attrs.items()
+                                   if not k.startswith("__")})
+    specs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+    if op.needs_rng:
+        key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        out = jax.eval_shape(fn, key_spec, *specs)
+    else:
+        out = jax.eval_shape(fn, *specs)
+    if isinstance(out, (list, tuple)):
+        return [tuple(o.shape) for o in out]
+    return [tuple(out.shape)]
+
+
+def _rule(required_idx_shapes):
+    """Helper producing a back-fill rule from {input_index: shape_fn}."""
+
+    def apply(node, shapes):
+        data = node.inputs[0]
+        if (id(data[0]), data[1]) not in shapes:
+            return False
+        data_shape = shapes[(id(data[0]), data[1])]
+        filled = False
+        for idx, shape_fn in required_idx_shapes(node, data_shape).items():
+            if idx >= len(node.inputs):
+                continue
+            child, oi = node.inputs[idx]
+            if child.is_variable and (id(child), oi) not in shapes:
+                shapes[(id(child), oi)] = tuple(int(x) for x in shape_fn)
+                filled = True
+        return filled
+
+    return apply
+
+
+def _fc_rule(node, dsh):
+    nh = int(node.attrs.get("num_hidden"))
+    flatten = node.attrs.get("flatten", True)
+    in_dim = int(_np.prod(dsh[1:])) if flatten in (True, "True", 1) else int(dsh[-1])
+    return {1: (nh, in_dim), 2: (nh,)}
+
+
+def _conv_rule(node, dsh):
+    kernel = _as_shape(node.attrs.get("kernel"))
+    nf = int(node.attrs.get("num_filter"))
+    ng = int(node.attrs.get("num_group", 1))
+    return {1: (nf, dsh[1] // ng) + kernel, 2: (nf,)}
+
+
+def _deconv_rule(node, dsh):
+    kernel = _as_shape(node.attrs.get("kernel"))
+    nf = int(node.attrs.get("num_filter"))
+    ng = int(node.attrs.get("num_group", 1))
+    return {1: (dsh[1], nf // ng) + kernel, 2: (nf,)}
+
+
+def _bn_rule(node, dsh):
+    axis = int(node.attrs.get("axis", 1))
+    c = dsh[axis % len(dsh)]
+    return {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
+
+
+def _ln_rule(node, dsh):
+    axis = int(node.attrs.get("axis", -1))
+    c = dsh[axis % len(dsh)]
+    return {1: (c,), 2: (c,)}
+
+
+def _in_rule(node, dsh):
+    return {1: (dsh[1],), 2: (dsh[1],)}
+
+
+def _embed_rule(node, dsh):
+    return {1: (int(node.attrs["input_dim"]), int(node.attrs["output_dim"]))}
+
+
+def _prelu_rule(node, dsh):
+    if node.attrs.get("act_type", "leaky") in ("prelu",):
+        return {1: (dsh[1] if len(dsh) > 1 else 1,)}
+    return {}
+
+
+def _as_shape(v):
+    if v is None:
+        return ()
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _rule(_fc_rule),
+    "Convolution": _rule(_conv_rule),
+    "Deconvolution": _rule(_deconv_rule),
+    "BatchNorm": _rule(_bn_rule),
+    "BatchNorm_v1": _rule(_bn_rule),
+    "_contrib_SyncBatchNorm": _rule(_bn_rule),
+    "LayerNorm": _rule(_ln_rule),
+    "InstanceNorm": _rule(_in_rule),
+    "Embedding": _rule(_embed_rule),
+    "LeakyReLU": _rule(_prelu_rule),
+}
+
+
+def _binary(lhs, rhs, broadcast_op, scalar_op, swap=False):
+    from . import op as _op
+
+    if isinstance(rhs, Symbol):
+        if broadcast_op is None:
+            raise MXNetError("unsupported symbol-symbol operation")
+        return _apply_op(broadcast_op, lhs, rhs)
+    if isinstance(rhs, (int, float, bool, _np.number)):
+        return _apply_op(scalar_op, lhs, scalar=float(rhs))
+    raise TypeError(f"unsupported operand type {type(rhs)}")

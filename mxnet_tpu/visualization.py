@@ -8,12 +8,25 @@ __all__ = ["print_summary", "plot_network"]
 
 
 def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """Print a layer-by-layer summary table of a Symbol graph."""
+    """Print a layer-by-layer summary table of a Symbol graph, with output
+    shapes and parameter counts when input `shape`s are given (reference
+    visualization.py print_summary)."""
+    import numpy as _np
+
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
     heads = {e[0] for e in conf["heads"]}
+    arg_shapes = {}
+    out_shape_of = {}
     if shape is not None:
-        _, out_shapes, _ = symbol.infer_shape(**shape)
+        internals = symbol.get_internals()
+        a_sh, o_sh, x_sh = internals.infer_shape_partial(**shape)
+        arg_names = internals.list_arguments()
+        aux_names = internals.list_auxiliary_states()
+        arg_shapes = {n: s for n, s in zip(arg_names, a_sh)}
+        arg_shapes.update({n: s for n, s in zip(aux_names, x_sh)})
+        for name, s in zip(internals.list_outputs(), o_sh):
+            out_shape_of[name] = s
     positions = [int(line_length * p) for p in positions]
     fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
 
@@ -25,19 +38,44 @@ def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.
             line += " " * (pos[i] - len(line))
         print(line)
 
+    def node_out_shape(node):
+        for suffix in ("_output", ""):
+            s = out_shape_of.get(node["name"] + suffix)
+            if s is not None:
+                return s
+        return out_shape_of.get(node["name"] + "_output0")
+
     print("_" * line_length)
     print_row(fields, positions)
     print("=" * line_length)
     total_params = 0
+    seen_params = set()
     for i, node in enumerate(nodes):
         op = node["op"]
-        if op == "null" and i not in heads and not node["name"].endswith(("weight", "bias", "gamma", "beta")):
+        if op == "null":
             continue
-        pre = ",".join(nodes[e[0]]["name"] for e in node.get("inputs", []))
-        print_row([f"{node['name']} ({op})", "", "", pre], positions)
+        # parameters feeding this op node (null inputs that aren't data)
+        n_params = 0
+        pre_list = []
+        for e in node.get("inputs", []):
+            src = nodes[e[0]]
+            if src["op"] == "null":
+                if shape is not None and src["name"] in arg_shapes and \
+                        src["name"] not in shape and src["name"] not in seen_params:
+                    s = arg_shapes[src["name"]]
+                    if s is not None:
+                        n_params += int(_np.prod(s))
+                    seen_params.add(src["name"])
+            else:
+                pre_list.append(src["name"])
+        total_params += n_params
+        out_s = node_out_shape(node) if shape is not None else ""
+        print_row([f"{node['name']} ({op})", str(out_s or ""), n_params,
+                   ",".join(pre_list)], positions)
     print("=" * line_length)
     print(f"Total params: {total_params}")
     print("_" * line_length)
+    return total_params
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None, dtype=None,

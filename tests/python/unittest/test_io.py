@@ -1,0 +1,108 @@
+"""Data iterator tests (modeled on reference `tests/python/unittest/test_io.py`)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io.io import (NDArrayIter, ResizeIter, PrefetchingIter,
+                             CSVIter, LibSVMIter, DataBatch, DataDesc)
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(40).reshape(10, 4).astype("float32")
+    label = np.arange(10).astype("float32")
+    it = NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_allclose(batches[1].label[0].asnumpy(), label[5:])
+    # second epoch after reset
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_pad():
+    data = np.arange(14).reshape(7, 2).astype("float32")
+    it = NDArrayIter(data, np.zeros(7), batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].data[0].shape == (4, 2)
+    assert batches[1].pad == 1
+
+
+def test_ndarrayiter_discard():
+    data = np.arange(14).reshape(7, 2).astype("float32")
+    it = NDArrayIter(data, np.zeros(7), batch_size=4,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 1
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    data = np.arange(20).reshape(20, 1).astype("float32")
+    it = NDArrayIter(data, np.zeros(20), batch_size=5, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_ndarrayiter_dict_input():
+    it = NDArrayIter({"a": np.zeros((6, 2)), "b": np.ones((6, 3))},
+                     np.zeros(6), batch_size=3)
+    names = [d.name for d in it.provide_data]
+    assert set(names) == {"a", "b"}
+    b = next(iter(it))
+    assert len(b.data) == 2
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), dtype="float32")
+    base = NDArrayIter(data, np.zeros(10), batch_size=5)
+    it = ResizeIter(base, 5)
+    assert len(list(it)) == 5  # wraps around the 2-batch base iter
+
+
+def test_prefetching_iter():
+    data = np.arange(20).reshape(10, 2).astype("float32")
+    base = NDArrayIter(data, np.zeros(10), batch_size=5)
+    it = PrefetchingIter(base)
+    batches = [it.next() for _ in range(2)]
+    assert batches[0].data[0].shape == (5, 2)
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().data[0].shape == (5, 2)
+
+
+def test_csv_iter():
+    with tempfile.TemporaryDirectory() as d:
+        data_path = os.path.join(d, "data.csv")
+        label_path = os.path.join(d, "label.csv")
+        arr = np.random.RandomState(0).rand(8, 3)
+        np.savetxt(data_path, arr, delimiter=",")
+        np.savetxt(label_path, np.arange(8.0), delimiter=",")
+        it = CSVIter(data_csv=data_path, data_shape=(3,),
+                     label_csv=label_path, batch_size=4)
+        b = next(iter(it))
+        assert b.data[0].shape == (4, 3)
+        np.testing.assert_allclose(b.data[0].asnumpy(), arr[:4], rtol=1e-5)
+
+
+def test_libsvm_iter():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "data.svm")
+        with open(path, "w") as f:
+            f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0\n0 0:0.5\n")
+        it = LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2)
+        b = next(iter(it))
+        np.testing.assert_allclose(
+            b.data[0].asnumpy(), [[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]])
+        np.testing.assert_allclose(b.label[0].asnumpy(), [1, 0])
+
+
+def test_databatch_str_and_desc():
+    b = DataBatch(data=[mx.nd.zeros((2, 2))], label=[mx.nd.zeros((2,))])
+    assert "(2, 2)" in str(b)
+    d = DataDesc("data", (32, 3, 224, 224))
+    assert DataDesc.get_batch_axis(d.layout) == 0

@@ -1,0 +1,216 @@
+"""Module API tests (modeled on reference `tests/python/unittest/test_module.py`
+and `tests/python/train/test_mlp.py`)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp_sym(nh=64, classes=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=600, dim=20, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype("float32")
+    y = (X @ rng.randn(dim, classes)).argmax(1).astype("float32")
+    return X, y
+
+
+def test_module_fit_accuracy():
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=10,
+            initializer=mx.init.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.97, score
+
+
+def test_module_forward_backward_update():
+    X, y = _toy_data(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = next(iter(it))
+    w0 = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    mod.forward_backward(batch)
+    mod.update()
+    w1 = mod._exec.arg_dict["fc1_weight"].asnumpy()
+    assert not np.allclose(w0, w1)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (50, 4)
+
+
+def test_module_predict_merges():
+    X, y = _toy_data(n=120)
+    it = mx.io.NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params(mx.init.Xavier())
+    pred = mod.predict(it)
+    assert pred.shape == (120, 4)
+
+
+def test_module_checkpoint_roundtrip():
+    X, y = _toy_data(n=200)
+    train = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=3,
+            initializer=mx.init.Xavier())
+    score = mod.score(train, "acc")
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "chk")
+        mod.save_checkpoint(prefix, 3)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0003.params")
+        mod2 = mx.mod.Module.load(prefix, 3)
+        mod2.bind(train.provide_data, train.provide_label, for_training=False)
+        score2 = mod2.score(train, "acc")
+        assert abs(score[0][1] - score2[0][1]) < 1e-6
+
+
+def test_module_save_load_optimizer_states():
+    X, y = _toy_data(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "opt.states")
+        mod.save_optimizer_states(fname)
+        mod.load_optimizer_states(fname)
+
+
+def test_module_input_grads():
+    X, y = _toy_data(n=50)
+    it = mx.io.NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    dgrads = mod.get_input_grads()
+    assert dgrads[0].shape == (50, 20)
+    assert np.abs(dgrads[0].asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    """Bucketed 'sequence' MLPs sharing parameters (reference
+    test_module.py bucketing tests / BucketSentenceIter pattern)."""
+    classes = 3
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=16, name="fc_shared")
+        net = sym.Activation(net, act_type="relu", name="act")
+        net = sym.FullyConnected(net, num_hidden=classes, name="out_shared")
+        return sym.SoftmaxOutput(net, name="softmax"), ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    from mxnet_tpu.io.io import DataDesc, DataBatch
+
+    mod.bind(data_shapes=[DataDesc("data", (8, 10))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    rng = np.random.RandomState(0)
+    # same feature count (10) in both buckets but different batch handling
+    for bucket in (10, 10, 10):
+        x = rng.randn(8, bucket).astype("float32")
+        y = rng.randint(0, classes, (8,)).astype("float32")
+        batch = DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)],
+                          bucket_key=bucket,
+                          provide_data=[DataDesc("data", (8, bucket))],
+                          provide_label=[DataDesc("softmax_label", (8,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, classes)
+
+
+def test_bucketing_module_switch_bucket_shares_params():
+    def sym_gen(n_in):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=4, name="fc", flatten=False)
+        return sym.SoftmaxOutput(net, name="softmax"), ("data",), ("softmax_label",)
+
+    from mxnet_tpu.io.io import DataDesc
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=6, context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (2, 5, 6))],
+             label_shapes=[DataDesc("softmax_label", (2, 5))])
+    mod.init_params(mx.init.Xavier())
+    w_default = mod._curr_module._exec.arg_dict["fc_weight"].asnumpy()
+    mod.switch_bucket(6, None)  # same bucket — no-op
+    mod.switch_bucket_shapes = None
+    w_after = mod._curr_module._exec.arg_dict["fc_weight"].asnumpy()
+    np.testing.assert_allclose(w_default, w_after)
+
+
+def test_symbolblock_in_gluon_net():
+    """SymbolBlock used as a child inside a gluon net (reference
+    test_gluon.py test_symbol_block)."""
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 6).astype("float32"))
+    y_ref = net(x).asnumpy()
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "net")
+        net.export(prefix, 0)
+        imported = mx.gluon.SymbolBlock.imports(
+            prefix + "-symbol.json", ["data"], prefix + "-0000.params")
+    y2 = imported(x).asnumpy()
+    np.testing.assert_allclose(y_ref, y2, atol=1e-5)
+
+
+def test_hybridblock_export_with_batchnorm():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8))
+    net.add(nn.BatchNorm())
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(1).randn(4, 5).astype("float32"))
+    y_ref = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "bnnet")
+        s = net.export(prefix, 7)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0007.params")
+        assert s.list_auxiliary_states() != []
+        imported = mx.gluon.SymbolBlock.imports(
+            prefix + "-symbol.json", ["data"], prefix + "-0007.params")
+    np.testing.assert_allclose(y_ref, imported(x).asnumpy(), atol=1e-5)

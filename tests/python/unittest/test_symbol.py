@@ -1,0 +1,196 @@
+"""Symbol API tests (modeled on reference `tests/python/unittest/test_symbol.py`)."""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_compose_and_list():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.name == "softmax"
+
+
+def test_explicit_input_symbols():
+    data = sym.Variable("data")
+    w = sym.Variable("myweight")
+    net = sym.FullyConnected(data=data, weight=w, num_hidden=8, name="fc")
+    assert "myweight" in net.list_arguments()
+    assert "fc_weight" not in net.list_arguments()
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(8, 10), softmax_label=(8,))
+    assert arg_shapes == [(8, 10), (16, 10), (16,), (4, 16), (4,), (8,)]
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    out = _mlp()
+    arg_shapes, out_shapes, _ = out.infer_shape_partial()
+    assert arg_shapes[0] is None
+    with pytest.raises(mx.MXNetError):
+        out.infer_shape()  # nothing known
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 16, 16))
+    assert arg_shapes == [(2, 3, 16, 16), (8, 3, 3, 3), (8,)]
+    assert out_shapes == [(2, 8, 16, 16)]
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    graph = json.loads(js)
+    assert "nodes" in graph and "arg_nodes" in graph and "heads" in graph
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.tojson() == js
+    # save/load file
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "s.json")
+        out.save(path)
+        out3 = sym.load(path)
+        assert out3.list_arguments() == out.list_arguments()
+
+
+def test_batchnorm_aux_split():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(sym.FullyConnected(data, num_hidden=6, name="fc"),
+                        name="bn")
+    assert net.list_arguments() == ["data", "fc_weight", "fc_bias",
+                                    "bn_gamma", "bn_beta"]
+    assert net.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_symbol_arithmetic_and_internals():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b * 2.0
+    ex = c.bind(args={"a": mx.nd.ones((3,)), "b": mx.nd.ones((3,)) * 3})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.full(3, 7.0))
+    internals = c.get_internals()
+    assert len(internals.list_outputs()) >= 3
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    x = a * 2.0
+    y = a + 1.0
+    g = sym.Group([x, y])
+    assert len(g) == 2
+    ex = g.bind(args={"a": mx.nd.ones((2,))})
+    outs = ex.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [2, 2])
+    np.testing.assert_allclose(outs[1].asnumpy(), [2, 2])
+    first = g[0]
+    assert len(first) == 1
+
+
+def test_executor_forward_backward():
+    out = _mlp()
+    ex = out.simple_bind(grad_req="write", data=(8, 10), softmax_label=(8,))
+    rng = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k.endswith("weight"):
+            v[:] = rng.randn(*v.shape) * 0.1
+    x = rng.randn(8, 10).astype("float32")
+    y = rng.randint(0, 4, (8,)).astype("float32")
+    probs = ex.forward(is_train=True, data=x, softmax_label=y)[0].asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(8), rtol=1e-5)
+    ex.backward()
+    # SoftmaxOutput grad on fc2 output = (p - onehot)/... summed into fc2_weight
+    g = ex.grad_dict["fc2_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+    # numeric check on the data-free path: grad of fc2_bias = sum(p - onehot)
+    onehot = np.eye(4)[y.astype(int)]
+    expect_bias_grad = (probs - onehot).sum(axis=0)
+    np.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                               expect_bias_grad, atol=1e-4)
+
+
+def test_executor_grad_req_add_and_null():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b
+    ex = c.bind(args={"a": mx.nd.ones((3,)) * 2, "b": mx.nd.ones((3,)) * 5},
+                args_grad={"a": mx.nd.zeros((3,)), "b": mx.nd.zeros((3,))},
+                grad_req={"a": "add", "b": "null"})
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward(mx.nd.ones((3,)))
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), np.full(3, 10.0))
+
+
+def test_executor_aux_update_only_in_train():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn")
+    ex = net.simple_bind(data=(4, 3))
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3).astype("float32") * 3 + 1
+    mm0 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm0)
+    ex.forward(is_train=True, data=x)
+    assert not np.allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm0)
+
+
+def test_executor_reshape():
+    out = _mlp()
+    ex = out.simple_bind(data=(8, 10), softmax_label=(8,))
+    ex2 = ex.reshape(data=(4, 10), softmax_label=(4,))
+    res = ex2.forward(is_train=False, data=np.zeros((4, 10)))
+    assert res[0].shape == (4, 4)
+    # params shared by reference (same NDArray objects)
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+
+
+def test_monitor_callable():
+    from mxnet_tpu.monitor import Monitor
+
+    out = _mlp()
+    ex = out.simple_bind(data=(2, 10), softmax_label=(2,))
+    mon = Monitor(1)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False, data=np.zeros((2, 10)))
+    res = mon.toc()
+    assert len(res) >= 1
+
+
+def test_print_summary_counts_params(capsys):
+    out = _mlp()
+    total = mx.visualization.print_summary(out, shape={"data": (1, 10)})
+    assert total == (10 * 16 + 16) + (16 * 4 + 4)
+    captured = capsys.readouterr()
+    assert "Total params" in captured.out
+
+
+def test_eval_api():
+    a = sym.Variable("a")
+    out = (a + 2.0).eval(a=mx.nd.ones((2, 2)))
+    np.testing.assert_allclose(out[0].asnumpy(), np.full((2, 2), 3.0))
