@@ -40,6 +40,7 @@ from . import kvstore as kv
 from . import kvstore
 from . import model
 from . import recordio
+from . import rnn
 from . import gluon
 
 from . import metric

@@ -1,10 +1,4 @@
-"""gluon.rnn (parity `python/mxnet/gluon/rnn/__init__.py`).
-
-Populated by rnn_cell / rnn_layer as they land (SURVEY.md §7 stage 5).
-"""
-try:
-    from .rnn_cell import *  # noqa: F401,F403
-    from .rnn_layer import *  # noqa: F401,F403
-    from . import rnn_cell, rnn_layer  # noqa: F401
-except ImportError:  # pragma: no cover - during staged build only
-    pass
+"""gluon.rnn (parity `python/mxnet/gluon/rnn/__init__.py`)."""
+from . import rnn_cell, rnn_layer
+from .rnn_cell import *  # noqa: F401,F403
+from .rnn_layer import *  # noqa: F401,F403

@@ -1,0 +1,11 @@
+"""Legacy rnn package (parity: `python/mxnet/rnn/`): BucketSentenceIter +
+cell aliases. The gluon cells are the maintained implementation; the legacy
+symbolic cell classes re-export them for API parity."""
+from .io import BucketSentenceIter, encode_sentences
+from ..gluon.rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                         BidirectionalCell, DropoutCell, ZoneoutCell,
+                         ResidualCell)
+
+__all__ = ["BucketSentenceIter", "encode_sentences", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell"]

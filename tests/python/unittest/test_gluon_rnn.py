@@ -1,0 +1,230 @@
+"""RNN tests (modeled on reference `tests/python/unittest/test_gluon_rnn.py`
+and `test_operator.py` RNN cases): cell math vs hand-rolled numpy, fused
+layer vs cell unroll, bidirectional/multi-layer, and an LM training smoke
+(north-star config 3, WikiText-2-shaped)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import rnn, nn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _copy_cell_params(layer, cell, layer_prefix="l0_"):
+    lp, cp = layer.collect_params(), cell.collect_params()
+    for l_suf, c_suf in [("i2h_weight", "i2h_weight"), ("h2h_weight", "h2h_weight"),
+                         ("i2h_bias", "i2h_bias"), ("h2h_bias", "h2h_bias")]:
+        src = [v for k, v in lp.items() if k.endswith(layer_prefix + l_suf)][0]
+        dst = [v for k, v in cp.items() if k.endswith(c_suf)][0]
+        dst.set_data(src.data())
+
+
+def test_rnn_cell_math_vs_numpy():
+    """RNNCell h' = tanh(Wi x + bi + Wh h + bh) against numpy."""
+    H, I, N = 4, 3, 2
+    cell = rnn.RNNCell(H, activation="tanh", input_size=I)
+    cell.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    x = rng.randn(N, I).astype("float32")
+    h = rng.randn(N, H).astype("float32")
+    out, states = cell(mx.nd.array(x), [mx.nd.array(h)])
+    p = {k.split("_", 1)[1]: v.data().asnumpy()
+         for k, v in cell.collect_params().items()}
+    expect = np.tanh(x @ p["i2h_weight"].T + p["i2h_bias"] +
+                     h @ p["h2h_weight"].T + p["h2h_bias"])
+    np.testing.assert_allclose(out.asnumpy(), expect, atol=1e-5)
+    np.testing.assert_allclose(states[0].asnumpy(), expect, atol=1e-5)
+
+
+def test_lstm_cell_math_vs_numpy():
+    """LSTMCell gate math (order i,f,g,o) against numpy."""
+    H, I, N = 3, 5, 2
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(1)
+    x = rng.randn(N, I).astype("float32")
+    h = rng.randn(N, H).astype("float32")
+    c = rng.randn(N, H).astype("float32")
+    out, (h1, c1) = cell(mx.nd.array(x), [mx.nd.array(h), mx.nd.array(c)])
+    p = {k.split("_", 1)[1]: v.data().asnumpy()
+         for k, v in cell.collect_params().items()}
+    pre = x @ p["i2h_weight"].T + p["i2h_bias"] + \
+        h @ p["h2h_weight"].T + p["h2h_bias"]
+    i, f, g, o = np.split(pre, 4, axis=1)
+    c_new = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+    h_new = _sigmoid(o) * np.tanh(c_new)
+    np.testing.assert_allclose(c1.asnumpy(), c_new, atol=1e-5)
+    np.testing.assert_allclose(h1.asnumpy(), h_new, atol=1e-5)
+
+
+def test_gru_cell_math_vs_numpy():
+    """GRUCell gate math (order r,z,n; reset gates the h-side of n)."""
+    H, I, N = 4, 3, 2
+    cell = rnn.GRUCell(H, input_size=I)
+    cell.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(2)
+    x = rng.randn(N, I).astype("float32")
+    h = rng.randn(N, H).astype("float32")
+    out, _ = cell(mx.nd.array(x), [mx.nd.array(h)])
+    p = {k.split("_", 1)[1]: v.data().asnumpy()
+         for k, v in cell.collect_params().items()}
+    gi = x @ p["i2h_weight"].T + p["i2h_bias"]
+    gh = h @ p["h2h_weight"].T + p["h2h_bias"]
+    i_r, i_z, i_n = np.split(gi, 3, axis=1)
+    h_r, h_z, h_n = np.split(gh, 3, axis=1)
+    r = _sigmoid(i_r + h_r)
+    z = _sigmoid(i_z + h_z)
+    n = np.tanh(i_n + r * h_n)
+    expect = (1 - z) * n + z * h
+    np.testing.assert_allclose(out.asnumpy(), expect, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh"])
+def test_fused_layer_matches_cell_unroll(mode):
+    T, N, I, H = 5, 3, 4, 6
+    layer = {"lstm": rnn.LSTM, "gru": rnn.GRU,
+             "rnn_tanh": lambda h, input_size: rnn.RNN(h, activation="tanh",
+                                                       input_size=input_size)}[mode](H, input_size=I)
+    cell = {"lstm": rnn.LSTMCell, "gru": rnn.GRUCell,
+            "rnn_tanh": lambda h, input_size: rnn.RNNCell(h, activation="tanh",
+                                                          input_size=input_size)}[mode](H, input_size=I)
+    layer.initialize(mx.init.Xavier())
+    cell.initialize()
+    _copy_cell_params(layer, cell)
+    x = mx.nd.array(np.random.RandomState(0).randn(T, N, I).astype("float32"))
+    out_l = layer(x)
+    out_c, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(out_l.asnumpy(), out_c.asnumpy(), atol=1e-5)
+
+
+def test_lstm_final_states():
+    T, N, I, H = 4, 2, 3, 5
+    layer = rnn.LSTM(H, input_size=I)
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(T, N, I).astype("float32"))
+    out, (hT, cT) = layer(x, layer.begin_state(N))
+    assert hT.shape == (1, N, H) and cT.shape == (1, N, H)
+    # final hidden state equals last output step
+    np.testing.assert_allclose(hT.asnumpy()[0], out.asnumpy()[-1], atol=1e-6)
+
+
+def test_lstm_bidirectional_and_multilayer():
+    T, N, I, H = 6, 2, 3, 4
+    layer = rnn.LSTM(H, num_layers=2, bidirectional=True, input_size=I)
+    layer.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(T, N, I).astype("float32"))
+    out, (hT, cT) = layer(x, layer.begin_state(N))
+    assert out.shape == (T, N, 2 * H)
+    assert hT.shape == (4, N, H)  # num_layers * ndir
+
+
+def test_ntc_layout():
+    T, N, I, H = 5, 3, 4, 6
+    l_tnc = rnn.LSTM(H, input_size=I, layout="TNC")
+    l_ntc = rnn.LSTM(H, input_size=I, layout="NTC")
+    l_tnc.initialize(mx.init.Xavier())
+    l_ntc.initialize()
+    for (ka, va), (kb, vb) in zip(l_tnc.collect_params().items(),
+                                  l_ntc.collect_params().items()):
+        vb.set_data(va.data())
+    x = np.random.RandomState(0).randn(T, N, I).astype("float32")
+    out_t = l_tnc(mx.nd.array(x)).asnumpy()
+    out_n = l_ntc(mx.nd.array(x.transpose(1, 0, 2))).asnumpy()
+    np.testing.assert_allclose(out_t, out_n.transpose(1, 0, 2), atol=1e-5)
+
+
+def test_sequential_residual_bidirectional_cells():
+    T, N, I, H = 4, 2, 6, 6
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, input_size=I))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(H, input_size=H)))
+    stack.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(T, N, I).astype("float32"))
+    outs, states = stack.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (T, N, H)
+
+    bi = rnn.BidirectionalCell(rnn.GRUCell(H, input_size=I),
+                               rnn.GRUCell(H, input_size=I))
+    bi.initialize(mx.init.Xavier())
+    outs, states = bi.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (T, N, 2 * H)
+
+
+def test_zoneout_dropout_cells_smoke():
+    T, N, I, H = 3, 2, 4, 4
+    cell = rnn.ZoneoutCell(rnn.LSTMCell(H, input_size=I), 0.2, 0.2)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(T, N, I).astype("float32"))
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (T, N, H)
+    d = rnn.DropoutCell(0.5)
+    out, st = d(mx.nd.ones((2, 3)), [])
+    assert out.shape == (2, 3)
+
+
+def test_lstm_language_model_trains():
+    """Tiny LSTM LM (north-star config 3 shape): loss must drop by 20%+."""
+    V, E, H, T, N = 30, 16, 32, 8, 8
+    rng = np.random.RandomState(0)
+    # synthetic periodic "language"
+    seq = np.arange(400) % V
+    data = np.stack([seq[i:i + T] for i in range(0, 300, T)])
+    target = np.stack([seq[i + 1:i + T + 1] for i in range(0, 300, T)])
+
+    class LM(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.embed = nn.Embedding(V, E)
+                self.lstm = rnn.LSTM(H, input_size=E, layout="NTC")
+                self.out = nn.Dense(V, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return self.out(self.lstm(self.embed(x)))
+
+    net = LM()
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 0.01})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    losses = []
+    for epoch in range(6):
+        ep = 0.0
+        for i in range(0, len(data), N):
+            x = mx.nd.array(data[i:i + N])
+            y = mx.nd.array(target[i:i + N])
+            with mx.autograd.record():
+                logits = net(x)
+                loss = loss_fn(logits.reshape((-1, V)), y.reshape((-1,)))
+            loss.backward()
+            trainer.step(x.shape[0])
+            ep += float(loss.mean().asscalar())
+        losses.append(ep)
+    assert losses[-1] < 0.8 * losses[0], losses
+
+
+def test_bucket_sentence_iter():
+    from mxnet_tpu.rnn import BucketSentenceIter, encode_sentences
+
+    sentences = [[1, 2, 3], [2, 3], [1, 2, 3, 4, 5], [3, 4], [1, 2]] * 4
+    it = BucketSentenceIter(sentences, batch_size=4, buckets=[3, 6],
+                            invalid_label=0)
+    batch = it.next()
+    assert batch.bucket_key in (3, 6)
+    assert batch.data[0].shape[0] == 4
+    # label is data shifted left
+    d = batch.data[0].asnumpy()
+    l = batch.label[0].asnumpy()
+    np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+
+
+def test_encode_sentences_builds_vocab():
+    from mxnet_tpu.rnn import encode_sentences
+
+    coded, vocab = encode_sentences([["a", "b"], ["b", "c"]], start_label=1)
+    assert len(coded) == 2
+    assert set(vocab.keys()) >= {"a", "b", "c"}
