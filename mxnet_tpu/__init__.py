@@ -22,6 +22,7 @@ from . import symbol as sym
 from .symbol import Symbol
 
 from . import io
+from . import image
 from . import module
 from . import module as mod
 

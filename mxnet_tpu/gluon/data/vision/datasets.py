@@ -1,0 +1,202 @@
+"""Vision datasets (parity: `python/mxnet/gluon/data/vision/datasets.py`).
+
+MNIST/FashionMNIST (idx format), CIFAR10/100 (binary format),
+ImageRecordDataset (.rec), ImageFolderDataset. This environment has no
+network egress, so `root` must already contain the raw files (the
+reference's auto-download is replaced by a clear error listing what to
+place where).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as _np
+
+from .... import ndarray as nd
+from ....base import MXNetError
+from .. import dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(dataset.Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        if not os.path.isdir(self._root):
+            os.makedirs(self._root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(dims)
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST (reference datasets.py MNIST). Expects the idx files
+    (train-images-idx3-ubyte[.gz] etc.) under `root`."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _find(self, base):
+        for cand in (base, base + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        raise MXNetError(
+            f"{base}[.gz] not found under {self._root}; this environment has "
+            f"no network egress — place the raw idx files there")
+
+    def _get_data(self):
+        imgs, labels = (self._train_files if self._train else self._test_files)
+        data = _read_idx_images(self._find(imgs))
+        label = _read_idx_images(self._find(labels))
+        self._data = nd.array(data[..., None].astype("uint8"), dtype="uint8")
+        self._label = label.astype("int32")
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle batches under `root`
+    (cifar-10-batches-py/ or the .tar.gz)."""
+
+    _batch_dir = "cifar-10-batches-py"
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _load_batches(self, names):
+        d = os.path.join(self._root, self._batch_dir)
+        if not os.path.isdir(d):
+            tar = os.path.join(self._root, "cifar-10-python.tar.gz")
+            if os.path.exists(tar):
+                with tarfile.open(tar) as t:
+                    t.extractall(self._root)
+            else:
+                raise MXNetError(
+                    f"{self._batch_dir}/ not found under {self._root}; place "
+                    f"the CIFAR-10 python batches there (no network egress)")
+        data, labels = [], []
+        for n in names:
+            with open(os.path.join(d, n), "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            data.append(batch["data"])
+            labels.extend(batch.get("labels", batch.get("fine_labels")))
+        data = _np.concatenate(data).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data.astype("uint8"), _np.asarray(labels, dtype="int32")
+
+    def _get_data(self):
+        names = [f"data_batch_{i}" for i in range(1, 6)] if self._train \
+            else ["test_batch"]
+        data, label = self._load_batches(names)
+        self._data = nd.array(data, dtype="uint8")
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    _batch_dir = "cifar-100-python"
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=True, train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root=root, train=train, transform=transform)
+
+    def _get_data(self):
+        names = ["train"] if self._train else ["test"]
+        data, label = self._load_batches(names)
+        self._data = nd.array(data, dtype="uint8")
+        self._label = label
+
+
+class ImageRecordDataset(dataset.RecordFileDataset):
+    """Dataset over a .rec of packed images (reference ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....image import imdecode
+        from .... import recordio
+
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        image = imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(image, label)
+        return image, label
+
+
+class ImageFolderDataset(dataset.Dataset):
+    """root/class_x/xxx.jpg folder layout (reference ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png", ".bmp"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+
+        img = imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,209 @@
+"""Vision transforms (parity: `python/mxnet/gluon/data/vision/transforms.py`).
+
+Blocks so they compose with nn.Sequential and hybridize; math runs on
+HWC uint8/float inputs the datasets produce, emitting CHW float for
+ToTensor — the reference's conventions exactly.
+"""
+from __future__ import annotations
+
+import random as _random
+
+import numpy as _np
+
+from .... import ndarray as nd
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from ....image import image as _img
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting", "RandomGray", "CropResize"]
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (reference transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype) if hasattr(x, "astype") else \
+            F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference ToTensor)."""
+
+    def forward(self, x):
+        arr = x.asnumpy().astype("float32") / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return nd.array(arr)
+
+
+class Normalize(Block):
+    """(x - mean) / std on CHW float (reference Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, "float32")
+        self._std = _np.asarray(std, "float32")
+
+    def forward(self, x):
+        arr = x.asnumpy()
+        c = arr.shape[-3]
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return nd.array((arr - mean) / std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        if isinstance(self._size, int):
+            if self._keep:
+                return _img.resize_short(x, self._size, self._interpolation)
+            return _img.imresize(x, self._size, self._size,
+                                 self._interpolation)
+        return _img.imresize(x, self._size[0], self._size[1],
+                             self._interpolation)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        return _img.center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        return _img.random_size_crop(x, self._size, self._scale, self._ratio,
+                                     self._interpolation)[0]
+
+
+class CropResize(Block):
+    def __init__(self, x0, y0, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._args = (x0, y0, width, height)
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        out = _img.fixed_crop(x, *self._args)
+        if self._size:
+            out = _img.imresize(out, self._size[0], self._size[1],
+                                self._interpolation)
+        return out
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _random.random() < self._p:
+            return nd.array(x.asnumpy()[:, ::-1].copy(), dtype=str(x.dtype))
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _random.random() < self._p:
+            return nd.array(x.asnumpy()[::-1].copy(), dtype=str(x.dtype))
+        return x
+
+
+class _JitterBlock(Block):
+    _aug_cls = None
+
+    def __init__(self, amount):
+        super().__init__()
+        self._aug = self._aug_cls(amount)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomBrightness(_JitterBlock):
+    _aug_cls = _img.BrightnessJitterAug
+
+
+class RandomContrast(_JitterBlock):
+    _aug_cls = _img.ContrastJitterAug
+
+
+class RandomSaturation(_JitterBlock):
+    _aug_cls = _img.SaturationJitterAug
+
+
+class RandomHue(_JitterBlock):
+    _aug_cls = _img.HueJitterAug
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._aug = _img.ColorJitterAug(brightness, contrast, saturation)
+        self._hue = _img.HueJitterAug(hue) if hue else None
+
+    def forward(self, x):
+        x = self._aug(x)
+        if self._hue:
+            x = self._hue(x)
+        return x
+
+
+class RandomLighting(Block):
+    def __init__(self, alpha):
+        super().__init__()
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.814],
+                            [-0.5836, -0.6948, 0.4203]])
+        self._aug = _img.LightingAug(alpha, eigval, eigvec)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomGray(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._aug = _img.RandomGrayAug(p)
+
+    def forward(self, x):
+        return self._aug(x)

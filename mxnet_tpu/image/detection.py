@@ -1,0 +1,256 @@
+"""Detection image pipeline (parity: `python/mxnet/image/detection.py`):
+augmenters that transform image AND object boxes together, plus
+`ImageDetIter`. Labels follow the reference's detection format: each object
+row = [id, xmin, ymin, xmax, ymax, ...extras], coordinates normalized to
+[0, 1]."""
+from __future__ import annotations
+
+import json
+import random as _random
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..io.io import DataBatch, DataDesc
+from . import image as _img
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only augmenter (labels pass through)."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if _random.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return _random.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _random.random() < self.p:
+            src = nd.array(src.asnumpy()[:, ::-1].copy(), dtype=str(src.dtype))
+            label = label.copy()
+            tmp = 1.0 - label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = tmp
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained by min object coverage (reference
+    DetRandomCropAug, simplified candidate sampling)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def _crop_label(self, label, x0, y0, w, h):
+        out = []
+        for row in label:
+            cx = (row[1] + row[3]) / 2
+            cy = (row[2] + row[4]) / 2
+            if not (x0 <= cx <= x0 + w and y0 <= cy <= y0 + h):
+                continue
+            new = row.copy()
+            new[1] = max(0.0, (row[1] - x0) / w)
+            new[2] = max(0.0, (row[2] - y0) / h)
+            new[3] = min(1.0, (row[3] - x0) / w)
+            new[4] = min(1.0, (row[4] - y0) / h)
+            out.append(new)
+        return _np.asarray(out) if out else None
+
+    def __call__(self, src, label):
+        H, W = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area = _random.uniform(*self.area_range) * W * H
+            ratio = _random.uniform(*self.aspect_ratio_range)
+            w = int(round((area * ratio) ** 0.5))
+            h = int(round((area / ratio) ** 0.5))
+            if w > W or h > H:
+                continue
+            x0 = _random.randint(0, W - w)
+            y0 = _random.randint(0, H - h)
+            new_label = self._crop_label(label, x0 / W, y0 / H, w / W, h / H)
+            if new_label is None:
+                continue
+            return _img.fixed_crop(src, x0, y0, w, h), new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=50, pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.area_range = area_range
+        self.aspect_ratio_range = aspect_ratio_range
+
+    def __call__(self, src, label):
+        H, W = src.shape[:2]
+        scale = _random.uniform(*self.area_range)
+        new_w, new_h = int(W * scale ** 0.5), int(H * scale ** 0.5)
+        x0 = _random.randint(0, new_w - W) if new_w > W else 0
+        y0 = _random.randint(0, new_h - H) if new_h > H else 0
+        canvas = _np.empty((new_h, new_w, src.shape[2]), dtype="uint8")
+        canvas[:] = _np.asarray(self.pad_val, dtype="uint8")
+        canvas[y0:y0 + H, x0:x0 + W] = src.asnumpy()
+        label = label.copy()
+        label[:, 1] = (label[:, 1] * W + x0) / new_w
+        label[:, 3] = (label[:, 3] * W + x0) / new_w
+        label[:, 2] = (label[:, 2] * H + y0) / new_h
+        label[:, 4] = (label[:, 4] * H + y0) / new_h
+        return nd.array(canvas, dtype="uint8"), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 3.0),
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Build the standard detection augmenter list (reference
+    CreateDetAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(_img.ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])), max_attempts,
+                              pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    auglist.append(DetBorrowAug(_img.ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(_img.CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(_img.ColorJitterAug(
+            brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(_img.HueJitterAug(hue)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(_img.RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(DetBorrowAug(_img.ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(_img.ImageIter):
+    """Detection iterator: object labels padded to fixed [N, max_obj, width]
+    (reference ImageDetIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, aug_list=None,
+                 data_name="data", label_name="label", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_pad", "rand_gray",
+                         "rand_mirror", "mean", "std", "brightness",
+                         "contrast", "saturation", "pca_noise", "hue",
+                         "inter_method")})
+        self._det_auglist = aug_list
+        super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         aug_list=[], data_name=data_name,
+                         label_name=label_name, **{
+                             k: v for k, v in kwargs.items()
+                             if k not in ("resize", "rand_crop", "rand_pad",
+                                          "rand_gray", "rand_mirror", "mean",
+                                          "std", "brightness", "contrast",
+                                          "saturation", "pca_noise", "hue",
+                                          "inter_method")})
+        self._label_width = None
+
+    def _parse_label(self, label):
+        """Flat header label → [num_obj, width] array (reference
+        _parse_label: [header_width, obj_width, obj...])."""
+        raw = _np.asarray(label).ravel()
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        body = raw[header_width:]
+        n = len(body) // obj_width
+        return body[:n * obj_width].reshape(n, obj_width)
+
+    def _decode_augment(self, label, raw):
+        img = _img.imdecode(raw)
+        objs = self._parse_label(label)
+        for aug in self._det_auglist:
+            img, objs = aug(img, objs)
+        arr = img.asnumpy()
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        return objs, arr.astype("float32")
+
+    def next(self):
+        samples = []
+        pad = 0
+        try:
+            for _ in range(self.batch_size):
+                samples.append(self.next_sample())
+        except StopIteration:
+            if not samples:
+                raise
+            pad = self.batch_size - len(samples)
+        decoded = [self._decode_augment(l, r) for l, r in samples]
+        while len(decoded) < self.batch_size:
+            decoded.append(decoded[0])
+        data = _np.stack([d for _, d in decoded])
+        max_obj = max(len(l) for l, _ in decoded)
+        width = decoded[0][0].shape[1] if len(decoded[0][0]) else 5
+        labels = _np.full((self.batch_size, max_obj, width), -1.0, "float32")
+        for i, (l, _) in enumerate(decoded):
+            if len(l):
+                labels[i, :len(l)] = l
+        return DataBatch(data=[nd.array(data)], label=[nd.array(labels)],
+                         pad=pad)

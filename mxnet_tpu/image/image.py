@@ -1,0 +1,597 @@
+"""Image decode/augment pipeline.
+
+Parity: `python/mxnet/image/image.py` (imdecode/imresize/crops/augmenter
+classes/`ImageIter`) and the C++ decode path it fronts
+(`src/io/iter_image_recordio_2.cc:873` — N decode threads over RecordIO
+chunks → imdecode → augmenters; `src/io/image_aug_default.cc`).
+
+TPU-native design: decode+augment stay on HOST (numpy/PIL — the reference
+uses OpenCV on host too); a thread pool overlaps per-image work and a
+prefetch queue overlaps batch assembly with device compute, the role of the
+reference's decode threads + `PrefetcherIter`. Batches reach the device
+once, at the jit boundary.
+"""
+from __future__ import annotations
+
+import io as _io
+import logging
+import os
+import queue as _queue
+import random as _random
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from .. import recordio
+from ..io.io import DataIter, DataBatch, DataDesc
+
+__all__ = ["imdecode", "imread", "imresize", "scale_down", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "SequentialAug", "RandomOrderAug",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+           "CenterCropAug", "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "HueJitterAug", "ColorJitterAug", "LightingAug", "RandomGrayAug",
+           "CreateAugmenter", "ImageIter", "ImageRecordIter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an HWC uint8 NDArray (reference
+    image.py imdecode over cv2; PIL here)."""
+    from PIL import Image
+
+    if isinstance(buf, nd.NDArray):
+        buf = bytes(buf.asnumpy().astype("uint8"))
+    img = Image.open(_io.BytesIO(buf))
+    if flag == 0:
+        img = img.convert("L")
+        arr = _np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = _np.asarray(img)
+        if not to_rgb:
+            arr = arr[:, :, ::-1]
+    return nd.array(arr.astype("uint8"), dtype="uint8")
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize an HWC image NDArray with PIL (reference imresize)."""
+    from PIL import Image
+
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else _np.asarray(src)
+    squeeze = arr.shape[-1] == 1
+    img = Image.fromarray(arr[:, :, 0] if squeeze else arr.astype("uint8"))
+    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                3: Image.LANCZOS, 4: Image.LANCZOS}.get(interp, Image.BILINEAR)
+    img = img.resize((w, h), resample)
+    out = _np.asarray(img)
+    if squeeze:
+        out = out[:, :, None]
+    return nd.array(out.astype(arr.dtype.name), dtype=arr.dtype.name)
+
+
+def scale_down(src_size, size):
+    """Scale `size` down to fit inside src_size keeping aspect (reference)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else src
+    out = arr[y0:y0 + h, x0:x0 + w]
+    out_nd = nd.array(out, dtype=str(out.dtype))
+    if size is not None and (w, h) != size:
+        out_nd = imresize(out_nd, *size, interp=interp)
+    return out_nd
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _random.randint(0, w - new_w)
+    y0 = _random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random area+aspect crop (inception-style; reference
+    random_size_crop)."""
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _random.uniform(area[0], area[1]) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        new_ratio = _np.exp(_random.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * new_ratio)))
+        new_h = int(round(_np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _random.randint(0, w - new_w)
+            y0 = _random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    arr = src.asnumpy().astype("float32") if isinstance(src, nd.NDArray) else src
+    arr = arr - _np.asarray(mean)
+    if std is not None:
+        arr = arr / _np.asarray(std)
+    return nd.array(arr)
+
+
+# --------------------------------------------------------------------------
+# augmenters
+# --------------------------------------------------------------------------
+
+
+class Augmenter:
+    """Image augmenter base (reference image.py Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, nd.NDArray):
+                kwargs[k] = v.asnumpy().tolist()
+            elif isinstance(v, _np.ndarray):
+                kwargs[k] = v.tolist()
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _random.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, *self.size, interp=self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.random() < self.p:
+            return nd.array(src.asnumpy()[:, ::-1].copy(),
+                            dtype=str(src.dtype))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return nd.array(src.asnumpy().astype(self.typ), dtype=self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = _np.asarray(mean) if mean is not None else None
+        self.std = _np.asarray(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.brightness, self.brightness)
+        return nd.array(src.asnumpy().astype("float32") * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.contrast, self.contrast)
+        arr = src.asnumpy().astype("float32")
+        gray = (arr * self._coef).sum(axis=2, keepdims=True)
+        mean = gray.mean() * (1.0 - alpha)
+        return nd.array(arr * alpha + mean)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy().astype("float32")
+        gray = (arr * self._coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return nd.array(arr * alpha + gray)
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = _np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], "float32")
+        self.ityiq = _np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], "float32")
+
+    def __call__(self, src):
+        alpha = _random.uniform(-self.hue, self.hue)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], "float32")
+        t = self.ityiq @ bt @ self.tyiq
+        arr = src.asnumpy().astype("float32")
+        return nd.array(arr @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA (AlexNet-style) lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, "float32")
+        self.eigvec = _np.asarray(eigvec, "float32")
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,)).astype("float32")
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return nd.array(src.asnumpy().astype("float32") + rgb)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.random() < self.p:
+            arr = src.asnumpy().astype("float32")
+            gray = (arr * self._coef).sum(axis=2, keepdims=True)
+            return nd.array(_np.broadcast_to(gray, arr.shape).copy())
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference image.py
+    CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.814],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and (std is not None or std is None):
+        if isinstance(mean, (tuple, list)):
+            mean = _np.asarray(mean)
+        if isinstance(std, (tuple, list)):
+            std = _np.asarray(std)
+        if mean is not None:
+            auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# --------------------------------------------------------------------------
+# ImageIter — threaded decode+augment from RecordIO or image lists
+# --------------------------------------------------------------------------
+
+
+class ImageIter(DataIter):
+    """Image iterator with RecordIO (.rec) or imglist backends, a decode
+    thread pool and output prefetching (reference image.py ImageIter; the
+    threaded pipeline role of `iter_image_recordio_2.cc:873`)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 last_batch_handle="pad", num_threads=4, **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        assert dtype in ("int32", "float32", "int64", "float64"), dtype
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.dtype = dtype
+        self._num_threads = max(1, int(num_threads))
+
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            idx_path = path_imgidx or (os.path.splitext(path_imgrec)[0] + ".idx")
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys) if hasattr(self.imgrec, "keys") \
+                    else sorted(self.imgrec.idx.keys())
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+                assert not shuffle, "shuffle needs a .idx file"
+        elif path_imglist:
+            self.imglist = {}
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = _np.array(parts[1:-1], dtype=dtype)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = sorted(self.imglist.keys())
+            self.path_root = path_root
+        else:
+            self.imglist = {}
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (_np.array(label, ndmin=1, dtype=dtype), fname)
+            self.seq = list(self.imglist.keys())
+            self.path_root = path_root
+
+        if num_parts > 1 and self.seq is not None:
+            assert part_index < num_parts
+            n_per = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n_per:(part_index + 1) * n_per]
+
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast", "saturation",
+                         "hue", "pca_noise", "rand_gray", "inter_method")})
+        else:
+            self.auglist = aug_list
+
+        self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name, (batch_size, label_width)
+                                       if label_width > 1 else (batch_size,))]
+        self.last_batch_handle = last_batch_handle
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _random.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Return (label, raw image bytes or path)."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                label = header.label
+                return label, img
+            label, fname = self.imglist[idx]
+            path = os.path.join(self.path_root, fname) if self.path_root else fname
+            with open(path, "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def _decode_augment(self, label, raw):
+        img = imdecode(raw)
+        for aug in self.auglist:
+            img = aug(img)
+        arr = img.asnumpy()
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)  # HWC → CHW
+        return label, arr.astype("float32")
+
+    def next(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        samples = []
+        pad = 0
+        try:
+            for _ in range(self.batch_size):
+                samples.append(self.next_sample())
+        except StopIteration:
+            if not samples:
+                raise
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            pad = self.batch_size - len(samples)
+
+        if self._num_threads > 1 and len(samples) > 1:
+            if not hasattr(self, "_pool"):
+                self._pool = ThreadPoolExecutor(self._num_threads)
+            decoded = list(self._pool.map(
+                lambda s: self._decode_augment(*s), samples))
+        else:
+            decoded = [self._decode_augment(*s) for s in samples]
+
+        while len(decoded) < self.batch_size:  # pad by repeating the first
+            decoded.append(decoded[0])
+
+        data = _np.stack([d for _, d in decoded])
+        labels = _np.stack([_np.array(l, ndmin=1) for l, _ in decoded])
+        if self.label_width == 1:
+            labels = labels[:, 0]
+        return DataBatch(data=[nd.array(data)], label=[nd.array(labels)],
+                         pad=pad)
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
+                    shuffle=False, aug_list=None, preprocess_threads=4,
+                    prefetch_buffer=2, **kwargs):
+    """RecordIO image iterator + background prefetch: the python-native
+    rendering of the reference's registered `ImageRecordIter`
+    (`iter_image_recordio_2.cc:873` decode threads + `iter_prefetcher.h`)."""
+    from ..io.io import PrefetchingIter
+
+    base = ImageIter(batch_size, data_shape, label_width=label_width,
+                     path_imgrec=path_imgrec, shuffle=shuffle,
+                     aug_list=aug_list, num_threads=preprocess_threads,
+                     **kwargs)
+    return PrefetchingIter(base, prefetch_depth=prefetch_buffer)

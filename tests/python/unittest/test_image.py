@@ -1,0 +1,232 @@
+"""Image pipeline tests (modeled on reference
+`tests/python/unittest/test_image.py` and `test_gluon_data.py`)."""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+from mxnet_tpu import recordio
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+def _make_jpeg(path, w=32, h=24, color=(255, 0, 0)):
+    from PIL import Image
+
+    arr = np.zeros((h, w, 3), np.uint8)
+    arr[:] = color
+    Image.fromarray(arr).save(path, "JPEG")
+
+
+def _jpeg_bytes(w=32, h=24, color=(0, 128, 255)):
+    import io as _io
+    from PIL import Image
+
+    arr = np.zeros((h, w, 3), np.uint8)
+    arr[:] = color
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG")
+    return buf.getvalue()
+
+
+def test_imdecode_imresize():
+    raw = _jpeg_bytes(40, 30)
+    im = img.imdecode(raw)
+    assert im.shape == (30, 40, 3)
+    assert im.dtype == np.uint8
+    small = img.imresize(im, 20, 15)
+    assert small.shape == (15, 20, 3)
+
+
+def test_resize_short_and_crops():
+    raw = _jpeg_bytes(60, 40)
+    im = img.imdecode(raw)
+    r = img.resize_short(im, 20)
+    assert min(r.shape[:2]) == 20
+    c, rect = img.center_crop(im, (30, 30))
+    assert c.shape == (30, 30, 3)
+    rc, rect = img.random_crop(im, (20, 20))
+    assert rc.shape == (20, 20, 3)
+    rsc, _ = img.random_size_crop(im, (16, 16), (0.5, 1.0), (0.9, 1.1))
+    assert rsc.shape == (16, 16, 3)
+
+
+def test_augmenter_list_and_color_math():
+    raw = _jpeg_bytes(32, 32, (100, 150, 200))
+    im = img.imdecode(raw)
+    augs = img.CreateAugmenter((3, 24, 24), rand_mirror=True, mean=True,
+                               std=True, brightness=0.1, contrast=0.1,
+                               saturation=0.1)
+    out = im
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == np.float32
+    # normalize-only pipeline matches numpy
+    mean = np.array([1.0, 2.0, 3.0], np.float32)
+    std = np.array([2.0, 2.0, 2.0], np.float32)
+    norm = img.ColorNormalizeAug(mean, std)
+    got = norm(img.CastAug()(im)).asnumpy()
+    expect = (im.asnumpy().astype("float32") - mean) / std
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def _write_rec(tmpdir, n=8):
+    rec_path = os.path.join(tmpdir, "data.rec")
+    idx_path = os.path.join(tmpdir, "data.idx")
+    record = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        raw = _jpeg_bytes(32, 32, (i * 30 % 255, 100, 50))
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        record.write_idx(i, recordio.pack(header, raw))
+    record.close()
+    return rec_path
+
+
+def test_imageiter_from_rec():
+    with tempfile.TemporaryDirectory() as d:
+        rec = _write_rec(d, n=8)
+        it = img.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                           path_imgrec=rec, shuffle=True)
+        batch = it.next()
+        assert batch.data[0].shape == (4, 3, 28, 28)
+        assert batch.label[0].shape == (4,)
+        n_batches = 1 + sum(1 for _ in iter(it.next, None) if False)
+        it.reset()
+        assert sum(1 for _ in it) == 2
+
+
+def test_image_record_iter_prefetched():
+    with tempfile.TemporaryDirectory() as d:
+        rec = _write_rec(d, n=8)
+        it = img.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                                 batch_size=4)
+        b = it.next()
+        assert b.data[0].shape == (4, 3, 32, 32)
+
+
+def test_imageiter_from_imglist():
+    with tempfile.TemporaryDirectory() as d:
+        files = []
+        for i in range(4):
+            p = os.path.join(d, f"im{i}.jpg")
+            _make_jpeg(p, color=(i * 40, 0, 0))
+            files.append(([float(i)], f"im{i}.jpg"))
+        it = img.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                           imglist=files, path_root=d)
+        b = it.next()
+        assert b.data[0].shape == (2, 3, 24, 24)
+        np.testing.assert_allclose(b.label[0].asnumpy(), [0, 1])
+
+
+def test_image_folder_dataset_and_transforms():
+    from mxnet_tpu.gluon.data.vision import ImageFolderDataset
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    with tempfile.TemporaryDirectory() as d:
+        for cls in ("cat", "dog"):
+            os.makedirs(os.path.join(d, cls))
+            for i in range(3):
+                _make_jpeg(os.path.join(d, cls, f"{i}.jpg"))
+        ds = ImageFolderDataset(d)
+        assert len(ds) == 6
+        assert ds.synsets == ["cat", "dog"]
+        im0, label0 = ds[0]
+        assert label0 == 0 and im0.shape == (24, 32, 3)
+
+        tf = T.Compose([T.Resize(16), T.CenterCrop(16), T.ToTensor(),
+                        T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+        out = tf(im0)
+        assert out.shape == (3, 16, 16)
+        assert float(out.asnumpy().max()) <= 1.0
+
+
+def test_image_record_dataset_with_dataloader():
+    from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+    from mxnet_tpu.gluon.data import DataLoader
+
+    with tempfile.TemporaryDirectory() as d:
+        rec = _write_rec(d, n=6)
+        ds = ImageRecordDataset(rec)
+        assert len(ds) == 6
+        im, label = ds[0]
+        assert im.shape == (32, 32, 3)
+        loader = DataLoader(ds.transform_first(lambda x: x.astype("float32")),
+                            batch_size=3)
+        xs, ys = next(iter(loader))
+        assert xs.shape == (3, 32, 32, 3)
+
+
+def test_mnist_dataset_from_idx_files():
+    import gzip
+    import struct
+
+    with tempfile.TemporaryDirectory() as d:
+        # write tiny idx files
+        imgs = np.random.RandomState(0).randint(0, 255, (5, 28, 28), dtype=np.uint8)
+        labels = np.arange(5, dtype=np.uint8)
+        with open(os.path.join(d, "train-images-idx3-ubyte"), "wb") as f:
+            f.write(struct.pack(">I", 0x00000803))
+            f.write(struct.pack(">III", 5, 28, 28))
+            f.write(imgs.tobytes())
+        with open(os.path.join(d, "train-labels-idx1-ubyte"), "wb") as f:
+            f.write(struct.pack(">I", 0x00000801))
+            f.write(struct.pack(">I", 5))
+            f.write(labels.tobytes())
+        from mxnet_tpu.gluon.data.vision import MNIST
+
+        ds = MNIST(root=d, train=True)
+        assert len(ds) == 5
+        im, label = ds[2]
+        assert im.shape == (28, 28, 1)
+        assert label == 2
+
+
+def test_im2rec_tool_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        root = os.path.join(d, "images")
+        for cls in ("a", "b"):
+            os.makedirs(os.path.join(root, cls))
+            for i in range(2):
+                _make_jpeg(os.path.join(root, cls, f"{i}.jpg"))
+        prefix = os.path.join(d, "out")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r1 = subprocess.run([sys.executable,
+                             os.path.join(REPO, "tools", "im2rec.py"),
+                             prefix, root, "--list"],
+                            capture_output=True, text=True, env=env, timeout=300)
+        assert r1.returncode == 0, r1.stderr[-1500:]
+        r2 = subprocess.run([sys.executable,
+                             os.path.join(REPO, "tools", "im2rec.py"),
+                             prefix, root, "--pass-through"],
+                            capture_output=True, text=True, env=env, timeout=300)
+        assert r2.returncode == 0, r2.stderr[-1500:]
+        it = img.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                           path_imgrec=prefix + ".rec")
+        b = it.next()
+        assert b.data[0].shape == (2, 3, 24, 24)
+
+
+def test_detection_augmenters_and_flip_boxes():
+    from mxnet_tpu.image.detection import (DetHorizontalFlipAug,
+                                           CreateDetAugmenter)
+
+    raw = _jpeg_bytes(32, 32)
+    im = img.imdecode(raw)
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]])
+    flip = DetHorizontalFlipAug(1.0)
+    out, new_label = flip(im, label)
+    np.testing.assert_allclose(new_label[0, [1, 3]], [0.6, 0.9], atol=1e-6)
+    np.testing.assert_allclose(new_label[0, [2, 4]], [0.2, 0.6], atol=1e-6)
+
+    augs = CreateDetAugmenter((3, 24, 24), rand_mirror=True, rand_crop=0.5,
+                              rand_pad=0.5, mean=True, std=True)
+    out, l2 = im, label
+    for a in augs:
+        out, l2 = a(out, l2)
+    assert out.shape == (24, 24, 3)

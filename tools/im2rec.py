@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder / .lst into RecordIO (parity:
+`tools/im2rec.py` in the reference).
+
+Usage:
+  python tools/im2rec.py prefix root --list      # generate prefix.lst
+  python tools/im2rec.py prefix root             # pack prefix.lst → .rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def list_image(root, recursive=True, exts=(".jpg", ".jpeg", ".png", ".bmp")):
+    """Yield (index, relpath, label) walking class folders."""
+    i = 0
+    cat = {}
+    for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            fpath = os.path.join(path, fname)
+            if os.path.splitext(fname)[1].lower() in exts:
+                folder = os.path.relpath(path, root)
+                if folder not in cat:
+                    cat[folder] = len(cat)
+                yield i, os.path.relpath(fpath, root), cat[folder]
+                i += 1
+        if not recursive:
+            break
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, rel, label in image_list:
+            fout.write(f"{i}\t{label}\t{rel}\n")
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), parts[-1], [float(x) for x in parts[1:-1]]
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, not args.no_recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    write_list(args.prefix + ".lst", image_list)
+
+
+def im2rec(args):
+    lst = args.prefix + ".lst"
+    assert os.path.exists(lst), f"{lst} not found; run with --list first"
+    record = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                        args.prefix + ".rec", "w")
+    n = 0
+    for idx, rel, label in read_list(lst):
+        fullpath = os.path.join(args.root, rel)
+        with open(fullpath, "rb") as f:
+            img = f.read()
+        header = recordio.IRHeader(0, label[0] if len(label) == 1 else label,
+                                   idx, 0)
+        if args.pass_through:
+            packed = recordio.pack(header, img)
+        else:
+            from mxnet_tpu.image import imdecode, imresize
+            import numpy as np
+
+            arr = imdecode(img)
+            if args.resize:
+                h, w = arr.shape[:2]
+                if min(h, w) > args.resize:
+                    if h > w:
+                        arr = imresize(arr, args.resize,
+                                       args.resize * h // w)
+                    else:
+                        arr = imresize(arr, args.resize * w // h,
+                                       args.resize)
+            packed = recordio.pack_img(header, arr.asnumpy(),
+                                       quality=args.quality,
+                                       img_fmt=args.encoding)
+        record.write_idx(idx, packed)
+        n += 1
+        if n % 1000 == 0:
+            print(f"packed {n} images")
+    record.close()
+    print(f"wrote {n} records to {args.prefix}.rec")
+
+
+def main():
+    p = argparse.ArgumentParser(description="make image record files")
+    p.add_argument("prefix", help="output prefix")
+    p.add_argument("root", help="image root folder")
+    p.add_argument("--list", action="store_true",
+                   help="generate the .lst file instead of packing")
+    p.add_argument("--exts", nargs="+",
+                   default=[".jpg", ".jpeg", ".png", ".bmp"])
+    p.add_argument("--no-recursive", action="store_true")
+    p.add_argument("--shuffle", action="store_true", default=True)
+    p.add_argument("--pass-through", action="store_true",
+                   help="skip re-encode, pack raw bytes")
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg")
+    args = p.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        im2rec(args)
+
+
+if __name__ == "__main__":
+    main()
