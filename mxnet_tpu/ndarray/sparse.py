@@ -7,49 +7,135 @@ zeros/array/cast_storage) over the reference's storage types
 `square_sum.cc`).
 
 TPU-native design: XLA has no native sparse buffers, so compound storage is
-kept as (data, indices[, indptr]) dense components — exactly the
-reference's aux-data layout — and sparse ops lower to XLA gather/scatter
-(take / segment_sum). Ops that have no sparse win fall back to dense, the
-analogue of the reference's storage-fallback executor
-(`attach_op_execs_pass.cc:46`).
+the (data, indices[, indptr]) dense components — exactly the reference's
+aux-data layout — and sparse ops lower to XLA gather/segment_sum. The
+logically-dense view is **lazy**: nothing materializes the full array until
+a dense-only code path reads `_data` (the storage-fallback rule of
+`attach_op_execs_pass.cc:46`); `shape`/`dtype`/`size` come from metadata,
+so a 1M-row row_sparse gradient flows through retain/optimizer-update
+without ever allocating the dense matrix.
 """
 from __future__ import annotations
 
 import numpy as _np
+import jax
 import jax.numpy as jnp
 
 from .ndarray import NDArray, array as _dense_array, zeros as _dense_zeros
 from ..base import MXNetError, np_dtype
 
-__all__ = ["RowSparseNDArray", "CSRNDArray", "zeros", "array", "row_sparse_array",
-           "csr_matrix", "cast_storage", "retain", "dot"]
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray", "zeros",
+           "array", "row_sparse_array", "csr_matrix", "cast_storage",
+           "retain", "dot", "square_sum", "add"]
+
+
+def _as_nd(x, dtype=None):
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(jnp.asarray(x, dtype))
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ("_aux",)
+    """Compound-storage NDArray. `_data` (the dense view) is a lazily
+    computed property; sparse components live in `_aux`."""
+
+    __slots__ = ("_aux", "_shape_meta", "_dtype_meta", "_dense_cache",
+                 "_aux_stale")
+
+    def __init__(self, aux, shape, dtype, ctx, stype):
+        # NDArray slots, minus _data (shadowed by the property below)
+        self._aux = aux
+        self._shape_meta = tuple(int(s) for s in shape)
+        self._dtype_meta = _np.dtype(dtype)
+        self._dense_cache = None
+        self._aux_stale = False
+        self._ctx = ctx
+        self.grad = None
+        self.grad_req = "null"
+        self._ag_marked = False
+        self._stype = stype
+        self._fresh_grad = False
+
+    # -- lazy dense view -----------------------------------------------------
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._to_dense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        # a dense value was written into this array (fallback path); aux
+        # components re-sparsify lazily on next access
+        self._dense_cache = value
+        self._shape_meta = tuple(int(s) for s in value.shape)
+        self._aux_stale = True
+
+    def _components(self):
+        if self._aux_stale:
+            self._resparsify(self._dense_cache)
+            self._aux_stale = False
+        return self._aux
+
+    @property
+    def shape(self):
+        return self._shape_meta
+
+    @property
+    def dtype(self):
+        return self._dtype_meta
+
+    @property
+    def ndim(self):
+        return len(self._shape_meta)
+
+    @property
+    def size(self):
+        return int(_np.prod(self._shape_meta)) if self._shape_meta else 0
+
+    def densified(self):
+        """True if the dense view has been materialized (test hook)."""
+        return self._dense_cache is not None
+
+    def _to_dense(self):
+        raise NotImplementedError
+
+    def _resparsify(self, dense):
+        raise NotImplementedError
 
 
 class RowSparseNDArray(BaseSparseNDArray):
     """row_sparse: (data[K, ...], indices[K]) — K occupied rows of a
-    logically dense (N, ...) array."""
+    logically dense (N, ...) array. Indices are sorted unique."""
 
     def __init__(self, data, indices, shape, ctx=None):
-        dense = jnp.zeros(shape, data._data.dtype if isinstance(data, NDArray) else data.dtype)
-        self._aux = {
-            "data": data if isinstance(data, NDArray) else NDArray(jnp.asarray(data)),
-            "indices": indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices)),
-        }
-        full = dense.at[self._aux["indices"]._data.astype(jnp.int32)].set(self._aux["data"]._data) \
-            if self._aux["indices"].size else dense
-        super().__init__(full, ctx, stype="row_sparse")
+        data = _as_nd(data)
+        indices = _as_nd(indices, jnp.int64)
+        super().__init__({"data": data, "indices": indices}, shape,
+                         data.dtype, ctx, "row_sparse")
 
     @property
     def data(self):
-        return self._aux["data"]
+        return self._components()["data"]
 
     @property
     def indices(self):
-        return self._aux["indices"]
+        return self._components()["indices"]
+
+    def _to_dense(self):
+        aux = self._components()
+        dense = jnp.zeros(self._shape_meta, self._dtype_meta)
+        if aux["indices"].size:
+            dense = dense.at[aux["indices"]._data.astype(jnp.int32)].set(
+                aux["data"]._data)
+        return dense
+
+    def _resparsify(self, dense):
+        nz = jnp.any((dense != 0).reshape(dense.shape[0], -1), axis=1)
+        idx = jnp.nonzero(nz)[0]
+        self._aux = {"data": NDArray(jnp.take(dense, idx, axis=0)),
+                     "indices": NDArray(idx.astype(jnp.int64))}
 
     def tostype(self, stype):
         if stype == "row_sparse":
@@ -62,43 +148,67 @@ class RowSparseNDArray(BaseSparseNDArray):
         return f"\n<RowSparseNDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
 
     def copy(self):
-        return RowSparseNDArray(self.data.copy(), self.indices.copy(), self.shape, self._ctx)
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
+                                self.shape, self._ctx)
 
     def retain(self, indices):
         return retain(self, indices)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return add(self, other)
+        return super().__add__(other)
 
 
 class CSRNDArray(BaseSparseNDArray):
     """csr: (data[nnz], indices[nnz], indptr[N+1]) 2-D sparse matrix."""
 
     def __init__(self, data, indices, indptr, shape, ctx=None):
-        self._aux = {
-            "data": data if isinstance(data, NDArray) else NDArray(jnp.asarray(data)),
-            "indices": indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices)),
-            "indptr": indptr if isinstance(indptr, NDArray) else NDArray(jnp.asarray(indptr)),
-        }
-        d = self._aux["data"]._data
-        idx = self._aux["indices"]._data.astype(jnp.int32)
-        ptr = _np.asarray(self._aux["indptr"]._data)
-        dense = _np.zeros(shape, dtype=_np.asarray(d).dtype)
-        dnp = _np.asarray(d)
-        inp = _np.asarray(idx)
-        for r in range(shape[0]):
-            for j in range(int(ptr[r]), int(ptr[r + 1])):
-                dense[r, inp[j]] = dnp[j]
-        super().__init__(jnp.asarray(dense), ctx, stype="csr")
+        data = _as_nd(data)
+        indices = _as_nd(indices, jnp.int64)
+        indptr = _as_nd(indptr, jnp.int64)
+        super().__init__({"data": data, "indices": indices, "indptr": indptr},
+                         shape, data.dtype, ctx, "csr")
 
     @property
     def data(self):
-        return self._aux["data"]
+        return self._components()["data"]
 
     @property
     def indices(self):
-        return self._aux["indices"]
+        return self._components()["indices"]
 
     @property
     def indptr(self):
-        return self._aux["indptr"]
+        return self._components()["indptr"]
+
+    def _row_ids(self):
+        """Per-nnz row id from indptr — vectorized (searchsorted)."""
+        aux = self._components()
+        nnz = int(aux["data"].size)
+        ptr = aux["indptr"]._data
+        return jnp.searchsorted(ptr, jnp.arange(nnz), side="right") - 1
+
+    def _to_dense(self):
+        aux = self._components()
+        dense = jnp.zeros(self._shape_meta, self._dtype_meta)
+        if aux["data"].size:
+            rows = self._row_ids().astype(jnp.int32)
+            cols = aux["indices"]._data.astype(jnp.int32)
+            dense = dense.at[rows, cols].set(aux["data"]._data)
+        return dense
+
+    def _resparsify(self, dense):
+        d = _np.asarray(dense)
+        rows, cols = _np.nonzero(d)
+        order = _np.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        indptr = _np.zeros(d.shape[0] + 1, _np.int64)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr)
+        self._aux = {"data": NDArray(jnp.asarray(d[rows, cols])),
+                     "indices": NDArray(jnp.asarray(cols.astype(_np.int64))),
+                     "indptr": NDArray(jnp.asarray(indptr))}
 
     def tostype(self, stype):
         if stype == "csr":
@@ -114,19 +224,22 @@ class CSRNDArray(BaseSparseNDArray):
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2 and not isinstance(arg1[0], int):
         data, indices = arg1
-        return RowSparseNDArray(_dense_array(data, dtype=dtype), _dense_array(indices, dtype="int64"),
+        return RowSparseNDArray(_dense_array(data, dtype=dtype),
+                                _dense_array(indices, dtype="int64"),
                                 shape, ctx)
-    # dense input → convert
-    dense = _dense_array(arg1, ctx=ctx, dtype=dtype) if not isinstance(arg1, NDArray) else arg1
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype) \
+        if not isinstance(arg1, NDArray) else arg1
     return cast_storage(dense, "row_sparse")
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
-        return CSRNDArray(_dense_array(data, dtype=dtype), _dense_array(indices, dtype="int64"),
+        return CSRNDArray(_dense_array(data, dtype=dtype),
+                          _dense_array(indices, dtype="int64"),
                           _dense_array(indptr, dtype="int64"), shape, ctx)
-    dense = _dense_array(arg1, ctx=ctx, dtype=dtype) if not isinstance(arg1, NDArray) else arg1
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype) \
+        if not isinstance(arg1, NDArray) else arg1
     return cast_storage(dense, "csr")
 
 
@@ -134,10 +247,13 @@ def zeros(stype, shape, ctx=None, dtype=None):
     dt = np_dtype(dtype)
     if stype == "row_sparse":
         return RowSparseNDArray(NDArray(jnp.zeros((0,) + tuple(shape[1:]), dt)),
-                                NDArray(jnp.zeros((0,), jnp.int64)), tuple(shape), ctx)
+                                NDArray(jnp.zeros((0,), jnp.int64)),
+                                tuple(shape), ctx)
     if stype == "csr":
-        return CSRNDArray(NDArray(jnp.zeros((0,), dt)), NDArray(jnp.zeros((0,), jnp.int64)),
-                          NDArray(jnp.zeros((shape[0] + 1,), jnp.int64)), tuple(shape), ctx)
+        return CSRNDArray(NDArray(jnp.zeros((0,), dt)),
+                          NDArray(jnp.zeros((0,), jnp.int64)),
+                          NDArray(jnp.zeros((shape[0] + 1,), jnp.int64)),
+                          tuple(shape), ctx)
     return _dense_zeros(shape, ctx=ctx, dtype=dtype)
 
 
@@ -148,57 +264,114 @@ def array(source_array, ctx=None, dtype=None):
 
 
 def cast_storage(arr, stype):
-    """Parity: `cast_storage` op (`src/operator/tensor/cast_storage.cc`)."""
-    npv = arr.asnumpy()
-    if stype == "row_sparse":
-        nz_rows = _np.where(_np.any(npv.reshape(npv.shape[0], -1) != 0, axis=1))[0]
-        return RowSparseNDArray(
-            _dense_array(npv[nz_rows], dtype=npv.dtype),
-            _dense_array(nz_rows.astype(_np.int64), dtype="int64"),
-            npv.shape, arr._ctx,
-        )
-    if stype == "csr":
-        try:
-            import scipy.sparse as sp
-
-            m = sp.csr_matrix(npv)
-            return CSRNDArray(_dense_array(m.data, dtype=npv.dtype),
-                              _dense_array(m.indices.astype(_np.int64), dtype="int64"),
-                              _dense_array(m.indptr.astype(_np.int64), dtype="int64"),
-                              npv.shape, arr._ctx)
-        except ImportError:
-            data, indices, indptr = [], [], [0]
-            for r in range(npv.shape[0]):
-                cols = _np.where(npv[r] != 0)[0]
-                data.extend(npv[r, cols].tolist())
-                indices.extend(cols.tolist())
-                indptr.append(len(indices))
-            return CSRNDArray(_dense_array(_np.asarray(data, npv.dtype)),
-                              _dense_array(_np.asarray(indices, _np.int64), dtype="int64"),
-                              _dense_array(_np.asarray(indptr, _np.int64), dtype="int64"),
-                              npv.shape, arr._ctx)
+    """`cast_storage` op (`src/operator/tensor/cast_storage-inl.h`),
+    vectorized — no python per-element loops."""
+    if isinstance(arr, BaseSparseNDArray) and arr.stype == stype:
+        return arr
     if stype == "default":
         return NDArray(arr._data, arr._ctx)
+    dense = arr._data
+    if stype == "row_sparse":
+        nz = jnp.any((dense != 0).reshape(dense.shape[0], -1), axis=1)
+        idx = jnp.nonzero(nz)[0]
+        return RowSparseNDArray(NDArray(jnp.take(dense, idx, axis=0)),
+                                NDArray(idx.astype(jnp.int64)),
+                                dense.shape, arr._ctx)
+    if stype == "csr":
+        d = _np.asarray(dense)
+        rows, cols = _np.nonzero(d)
+        order = _np.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        indptr = _np.zeros(d.shape[0] + 1, _np.int64)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr)
+        return CSRNDArray(NDArray(jnp.asarray(d[rows, cols])),
+                          NDArray(jnp.asarray(cols.astype(_np.int64))),
+                          NDArray(jnp.asarray(indptr)), d.shape, arr._ctx)
     raise MXNetError(f"unknown stype {stype}")
 
 
 def retain(arr, indices):
-    """sparse_retain (`src/operator/tensor/sparse_retain.cc`)."""
+    """sparse_retain (`src/operator/tensor/sparse_retain.cc`): keep only the
+    requested rows — pure index math, never densifies."""
     if not isinstance(arr, RowSparseNDArray):
         raise MXNetError("retain expects a RowSparseNDArray")
-    idx = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) else _np.asarray(indices, _np.int64)
-    keep = _np.isin(arr.indices.asnumpy(), idx)
+    idx = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
+    idx = idx.astype(jnp.int64)
+    keep = jnp.isin(arr.indices._data, idx)
+    kept = jnp.nonzero(keep)[0]
     return RowSparseNDArray(
-        _dense_array(arr.data.asnumpy()[keep]),
-        _dense_array(arr.indices.asnumpy()[keep], dtype="int64"),
-        arr.shape, arr._ctx,
-    )
+        NDArray(jnp.take(arr.data._data, kept, axis=0)),
+        NDArray(jnp.take(arr.indices._data, kept)),
+        arr.shape, arr._ctx)
+
+
+def add(lhs, rhs):
+    """row_sparse + row_sparse → row_sparse (gradient accumulation),
+    via index union — never densifies."""
+    assert isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray)
+    assert lhs.shape == rhs.shape
+    li, ri = lhs.indices._data, rhs.indices._data
+    union = jnp.union1d(li, ri)
+    pos_l = jnp.searchsorted(union, li)
+    pos_r = jnp.searchsorted(union, ri)
+    out = jnp.zeros((union.shape[0],) + lhs.shape[1:], lhs.data._data.dtype)
+    out = out.at[pos_l].add(lhs.data._data)
+    out = out.at[pos_r].add(rhs.data._data)
+    return RowSparseNDArray(NDArray(out), NDArray(union.astype(jnp.int64)),
+                            lhs.shape, lhs._ctx)
+
+
+def square_sum(arr, axis=None, keepdims=False):
+    """_square_sum over row_sparse (`square_sum.cc`) — operates on the
+    stored rows only."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("square_sum expects a RowSparseNDArray")
+    sq = arr.data._data * arr.data._data
+    if axis is None:
+        return NDArray(jnp.sum(sq).reshape((1,) * arr.ndim if keepdims else ()))
+    if axis in (1, -1) and arr.ndim == 2:
+        # per-row sums scattered back to full length
+        out = jnp.zeros((arr.shape[0],), sq.dtype)
+        out = out.at[arr.indices._data.astype(jnp.int32)].set(sq.sum(axis=1))
+        if keepdims:
+            out = out[:, None]
+        return NDArray(out)
+    raise MXNetError(f"square_sum: unsupported axis {axis}")
+
+
+@jax.jit
+def _csr_dot_dense(data, row_ids, cols, rhs, n_rows):
+    contrib = data[:, None] * rhs[cols]
+    return jax.ops.segment_sum(contrib, row_ids, num_segments=n_rows)
+
+
+@jax.jit
+def _csr_t_dot_dense(data, row_ids, cols, rhs, n_cols):
+    contrib = data[:, None] * rhs[row_ids]
+    return jax.ops.segment_sum(contrib, cols, num_segments=n_cols)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """csr × dense / row_sparse-aware dot — lowers to dense XLA dot (the
-    gather-based path is a later optimization)."""
+    """Sparse dot (`src/operator/tensor/dot.cc`):
+
+    * csr × dense  → dense       (one segment_sum over nnz)
+    * csrᵀ × dense → row_sparse-shaped dense cols (kept dense: result cols
+      are generally dense) — the reference's dot(csr.T, dense) = row_sparse
+      is honored by returning row_sparse when requested via forward_stype.
+    """
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        data = lhs.data._data
+        cols = lhs.indices._data.astype(jnp.int32)
+        row_ids = lhs._row_ids().astype(jnp.int32)
+        if transpose_a:
+            out = _csr_t_dot_dense(data, row_ids, cols, rhs._data,
+                                   lhs.shape[1])
+        else:
+            out = _csr_dot_dense(data, row_ids, cols, rhs._data, lhs.shape[0])
+        return NDArray(out, lhs._ctx)
     from . import invoke_nd
 
-    return invoke_nd("dot", NDArray(lhs._data, lhs._ctx), NDArray(rhs._data, rhs._ctx),
+    return invoke_nd("dot", NDArray(lhs._data, lhs._ctx),
+                     NDArray(rhs._data, rhs._ctx),
                      transpose_a=transpose_a, transpose_b=transpose_b)

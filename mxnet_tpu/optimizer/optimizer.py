@@ -249,6 +249,13 @@ class SGD(Optimizer):
         if self.clip_gradient:
             kwargs["clip_gradient"] = self.clip_gradient
 
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update and \
+                not use_multi_precision:
+            _sparse_sgd_update(weight, grad, state, lr, wd, self.rescale_grad,
+                               self.clip_gradient, self.momentum)
+            return
         if not use_multi_precision:
             if state is not None:
                 nd.sgd_mom_update(weight, grad, state, out=weight,
@@ -483,6 +490,14 @@ class Adam(Optimizer):
                   "rescale_grad": self.rescale_grad, "lr": lr, "wd": wd}
         if self.clip_gradient:
             kwargs["clip_gradient"] = self.clip_gradient
+
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            _sparse_adam_update(weight, grad, state, lr, wd, self.rescale_grad,
+                                self.clip_gradient, self.beta1, self.beta2,
+                                self.epsilon)
+            return
 
         mean, var = state
         nd.adam_update(weight, grad, mean, var, out=weight,
