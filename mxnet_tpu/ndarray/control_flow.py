@@ -1,0 +1,232 @@
+"""Control-flow operators — foreach / while_loop / cond.
+
+Parity: reference `src/operator/control_flow.cc` (`_foreach`:1255,
+`_while_loop`:1316, `_cond`:1378) and the python frontends
+`python/mxnet/ndarray/contrib.py` (foreach/while_loop/cond taking python
+callables over NDArrays).
+
+TPU-native design: the body callables are traced ONCE into
+``lax.scan`` / masked-scan / ``lax.cond`` programs — compiler-friendly
+control flow with static shapes, instead of the reference's per-step
+subgraph executor loop.  ``while_loop`` is lowered to a bounded
+``lax.scan`` over ``max_iterations`` with an `active` mask, which makes it
+reverse-mode differentiable (``lax.while_loop`` is not) and keeps the trip
+count static for XLA.
+
+Free variables: closure-captured NDArrays inside the body (e.g. the weights
+of a layer called per step) are discovered in an abstract ``eval_shape``
+pass and promoted to explicit inputs of the traced function, so gradients
+flow to them — see ``register._resolve_nd_data``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ndarray import NDArray
+from . import register as _register
+from ..util import flatten_nested, unflatten_nested as _unflatten
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _flatten(x):
+    """x: NDArray | list/tuple (possibly nested) -> (flat list, structure)."""
+    return flatten_nested(x, NDArray)
+
+
+def _capture_run(pure_core, explicit_nds, warmup=None):
+    """Trace `pure_core(list_of_jax_arrays) -> tuple` with free-variable
+    capture; returns flat list[NDArray] outputs, recording one tape node
+    when autograd is on."""
+    from .. import autograd
+
+    # eager warm-up: run the body once OUTSIDE any trace so shape-dependent
+    # side effects (gluon deferred parameter init on first call) happen with
+    # concrete values instead of leaking tracers into parameter storage
+    if warmup is not None:
+        with autograd.pause():
+            warmup()
+
+    frames = _register._cf_frames()
+
+    # discovery pass: abstract trace collecting concrete NDArrays the body
+    # touches through op dispatch
+    frame = {"subst": {}, "collect": {}}
+    frames.append(frame)
+    try:
+        jax.eval_shape(lambda *a: pure_core(list(a)),
+                       *[n._data for n in explicit_nds])
+    finally:
+        frames.pop()
+    captured = [n for n in frame["collect"].values()]
+
+    n_exp = len(explicit_nds)
+
+    def pure(*arrays):
+        exp, cap = arrays[:n_exp], arrays[n_exp:]
+        fr = {"subst": {id(n): t for n, t in zip(captured, cap)},
+              "collect": None}
+        frames.append(fr)
+        try:
+            out = pure_core(list(exp))
+        finally:
+            frames.pop()
+        return out if len(out) != 1 else out[0]
+
+    all_nds = list(explicit_nds) + captured
+    arrays = [n._data for n in all_nds]
+    if autograd.is_recording():
+        outs, vjp = jax.vjp(pure, *arrays)
+    else:
+        outs = pure(*arrays)
+        vjp = None
+    outs_t = (outs,) if not isinstance(outs, tuple) else outs
+    ctx = explicit_nds[0]._ctx if explicit_nds else None
+    out_nds = [NDArray(o, ctx) for o in outs_t]
+    if vjp is not None:
+        autograd._record_node(
+            vjp, all_nds, out_nds,
+            [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs_t])
+    return out_nds
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Run `body(data_slice, states) -> (outputs, new_states)` over the
+    leading axis of `data`; outputs are stacked along a new leading axis.
+    Lowered to one `lax.scan` (reference `_foreach`, control_flow.cc:1255).
+    """
+    from .. import autograd
+
+    data_l, data_struct = _flatten(data)
+    states_l, states_struct = _flatten(init_states)
+    if not data_l:
+        raise ValueError("foreach: data must contain at least one NDArray")
+    n_data = len(data_l)
+    meta = {}
+
+    def pure_core(exp):
+        d, s = exp[:n_data], exp[n_data:]
+
+        def step(carry, xs):
+            with autograd.pause():
+                x_nd = _unflatten([NDArray(x) for x in xs], data_struct)
+                s_nd = _unflatten([NDArray(c) for c in carry], states_struct)
+                out, new_s = body(x_nd, s_nd)
+                out_l, out_struct = _flatten(out)
+                ns_l, ns_struct = _flatten(new_s)
+                if len(ns_l) != len(carry):
+                    raise ValueError(
+                        f"foreach: body returned {len(ns_l)} states, "
+                        f"expected {len(carry)}")
+                meta["out_struct"], meta["n_out"] = out_struct, len(out_l)
+                meta["ns_struct"] = ns_struct
+            return tuple(n._data for n in ns_l), tuple(o._data for o in out_l)
+
+        carry, ys = lax.scan(step, tuple(s), tuple(d))
+        return tuple(ys) + tuple(carry)
+
+    def warmup():
+        body(_unflatten([NDArray(d._data[0]) for d in data_l], data_struct),
+             _unflatten([NDArray(s._data) for s in states_l], states_struct))
+
+    out_nds = _capture_run(pure_core, data_l + states_l, warmup)
+    n_out = meta["n_out"]
+    outputs = _unflatten(out_nds[:n_out], meta["out_struct"]) if n_out else []
+    states = _unflatten(out_nds[n_out:], meta["ns_struct"]) if out_nds[n_out:] else []
+    return outputs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
+    """`while cond(*loop_vars): outputs, loop_vars = func(*loop_vars)`.
+
+    Reference `_while_loop` (control_flow.cc:1316).  Lowered to a bounded
+    `lax.scan` over `max_iterations` with an activity mask: static trip
+    count (XLA-friendly) and reverse-mode differentiable.  Step outputs are
+    stacked to shape (max_iterations, ...); rows past the actual step count
+    are zero (the reference's symbolic path pads identically).  Returns
+    (outputs, final_loop_vars).
+    """
+    from .. import autograd
+
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static trip "
+                         "count for XLA)")
+    max_iterations = int(max_iterations)
+    lv_l, lv_struct = _flatten(loop_vars)
+    if not lv_l:
+        raise ValueError("while_loop: loop_vars must be non-empty")
+    meta = {}
+
+    def pure_core(exp):
+        def step(carry, _):
+            lv, active = carry
+            with autograd.pause():
+                lv_nd = _unflatten([NDArray(a) for a in lv], lv_struct)
+                lv_list = lv_nd if isinstance(lv_nd, list) else [lv_nd]
+                c = cond(*lv_list)
+                cval = jnp.reshape(c._data, ()).astype(bool)
+                act = jnp.logical_and(active, cval)
+                out, new_lv = func(*lv_list)
+                out_l, out_struct = _flatten(out)
+                nl_l, _ = _flatten(new_lv)
+                if len(nl_l) != len(lv):
+                    raise ValueError(
+                        f"while_loop: func returned {len(nl_l)} loop_vars, "
+                        f"expected {len(lv)}")
+                meta["out_struct"], meta["n_out"] = out_struct, len(out_l)
+            new_carry = tuple(jnp.where(act, n._data, o)
+                              for n, o in zip(nl_l, lv))
+            ys = tuple(jnp.where(act, o._data, jnp.zeros_like(o._data))
+                       for o in out_l)
+            return (new_carry, act), ys
+
+        (carry, _), ys = lax.scan(
+            step, (tuple(exp), jnp.bool_(True)), None, length=max_iterations)
+        return tuple(ys) + tuple(carry)
+
+    def warmup():
+        lv_nd = _unflatten([NDArray(a._data) for a in lv_l], lv_struct)
+        lv_list = lv_nd if isinstance(lv_nd, list) else [lv_nd]
+        cond(*lv_list)
+        func(*lv_list)
+
+    out_nds = _capture_run(pure_core, lv_l, warmup)
+    n_out = meta["n_out"]
+    outputs = _unflatten(out_nds[:n_out], meta["out_struct"]) if n_out else []
+    final_lv = _unflatten(out_nds[n_out:], lv_struct)
+    return outputs, final_lv
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """`then_func() if pred else else_func()` as one traced `lax.cond`
+    (reference `_cond`, control_flow.cc:1378).  Both branches must return
+    the same structure/shapes."""
+    from .. import autograd
+
+    if not isinstance(pred, NDArray):
+        raise TypeError("cond: pred must be an NDArray scalar")
+    meta = {}
+
+    def pure_core(exp):
+        pv = jnp.reshape(exp[0], ()).astype(bool)
+
+        def mk(branch, tag):
+            def f(_):
+                with autograd.pause():
+                    out = branch()
+                    out_l, out_struct = _flatten(out)
+                    meta["out_struct"], meta["n_out"] = out_struct, len(out_l)
+                    return tuple(o._data for o in out_l)
+            return f
+
+        res = lax.cond(pv, mk(then_func, "then"), mk(else_func, "else"), None)
+        return tuple(res)
+
+    def warmup():
+        then_func()
+        else_func()
+
+    out_nds = _capture_run(pure_core, [pred], warmup)
+    return _unflatten(out_nds, meta["out_struct"])

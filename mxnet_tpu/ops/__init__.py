@@ -12,4 +12,6 @@ from . import init_ops      # noqa: F401
 from . import random_ops    # noqa: F401
 from . import nn            # noqa: F401
 from . import rnn           # noqa: F401
+from . import ctc           # noqa: F401
+from . import control_flow_ops  # noqa: F401
 from . import optimizer_ops # noqa: F401

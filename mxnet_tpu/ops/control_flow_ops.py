@@ -1,0 +1,148 @@
+"""Symbol-level control-flow operators over serialized subgraphs.
+
+Parity: reference `src/operator/control_flow.cc` registers `_foreach`
+(:1255), `_while_loop` (:1316) and `_cond` (:1378) as stateful ops whose
+attributes carry NNVM subgraphs; the python frontends cut the subgraphs and
+deduce inputs (`python/mxnet/symbol/contrib.py`).
+
+Here the subgraph travels as a JSON string attribute (the same format
+`Symbol.tojson` emits, so it survives model save/load), and execution
+lowers to `lax.scan` / bounded-scan / `lax.cond` — the whole loop compiles
+into the enclosing XLA program instead of re-entering a graph executor per
+iteration.
+
+RNG note: the control-flow op takes a PRNG key like any needs_rng op
+(frontends supply it: the nd path from the active key provider, the symbol
+executor by folding the bind-time key per node) and folds it again per scan
+step, so RNG ops inside the body draw fresh randomness each iteration —
+deterministic given the seed, documented divergence from the reference's
+global resource RNG (SURVEY.md §7 RNG parity note).
+"""
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _as_json_str(subgraph):
+    # outer JSON round-trip may literal_eval the attr into a dict
+    if isinstance(subgraph, str):
+        return subgraph
+    return json.dumps(subgraph)
+
+
+def _sub_fn(subgraph, arg_names, train):
+    """Compile a serialized subgraph into fn(key, args_tuple) -> outputs."""
+    from ..symbol import symbol as _sym
+    from ..symbol.executor import _graph_fn
+
+    g = _sym.load_json(_as_json_str(subgraph))
+    names = [n for n in arg_names.split(",") if n]
+    inner = _graph_fn(g, names, [], train=bool(train))
+
+    def fn(key, args):
+        outs, _ = inner(key, tuple(args), ())
+        return outs
+
+    return fn
+
+
+def _split_csv(s):
+    return [x for x in (s or "").split(",") if x]
+
+
+@register("_foreach", needs_rng=True, needs_mode=True,
+          num_outputs=lambda attrs: int(attrs["n_out"]) + int(attrs["n_states"]))
+def _foreach(key, *arrays, subgraph="", sub_args="", n_data=0, n_states=0,
+             n_out=0, _train=False):
+    n_data, n_states, n_out = int(n_data), int(n_states), int(n_out)
+    data = arrays[:n_data]
+    states = arrays[n_data:n_data + n_states]
+    free = arrays[n_data + n_states:]
+    fn = _sub_fn(subgraph, sub_args, _train)
+    T = data[0].shape[0]
+
+    def step(carry, xs):
+        t, xs = xs[0], xs[1:]
+        outs = fn(jax.random.fold_in(key, t),
+                  tuple(xs) + tuple(carry) + tuple(free))
+        return tuple(outs[n_out:]), tuple(outs[:n_out])
+
+    carry, ys = lax.scan(step, tuple(states),
+                         (jnp.arange(T),) + tuple(data))
+    res = tuple(ys) + tuple(carry)
+    return res if len(res) != 1 else res[0]
+
+
+@register("_while_loop", needs_rng=True, needs_mode=True,
+          num_outputs=lambda attrs: int(attrs["n_out"]) + int(attrs["n_lv"]))
+def _while_loop(key, *arrays, cond_subgraph="", body_subgraph="", cond_args="",
+                body_args="", lv_names="", n_lv=0, n_out=0, max_iterations=0,
+                _train=False):
+    n_lv, n_out = int(n_lv), int(n_out)
+    max_iterations = int(max_iterations)
+    lv = arrays[:n_lv]
+    free = arrays[n_lv:]
+    lvn = _split_csv(lv_names)
+    # free names follow lv slots in the node input order
+    free_names = []
+    seen = set(lvn)
+    for nm in _split_csv(cond_args) + _split_csv(body_args):
+        if nm not in seen:
+            seen.add(nm)
+            free_names.append(nm)
+    env_free = dict(zip(free_names, free))
+    cfn = _sub_fn(cond_subgraph, cond_args, _train)
+    bfn = _sub_fn(body_subgraph, body_args, _train)
+
+    def bind(names, lv_now):
+        env = dict(zip(lvn, lv_now))
+        env.update(env_free)
+        return tuple(env[nm] for nm in _split_csv(names))
+
+    def step(carry, t):
+        lv_now, active = carry
+        kt = jax.random.fold_in(key, t)
+        cval = jnp.reshape(
+            cfn(jax.random.fold_in(kt, 1), bind(cond_args, lv_now))[0],
+            ()).astype(bool)
+        act = jnp.logical_and(active, cval)
+        bouts = bfn(jax.random.fold_in(kt, 2), bind(body_args, lv_now))
+        outs, new_lv = bouts[:n_out], bouts[n_out:]
+        new_carry = tuple(jnp.where(act, n, o) for n, o in zip(new_lv, lv_now))
+        ys = tuple(jnp.where(act, o, jnp.zeros_like(o)) for o in outs)
+        return (new_carry, act), ys
+
+    (carry, _), ys = lax.scan(step, (tuple(lv), jnp.bool_(True)),
+                              jnp.arange(max_iterations))
+    res = tuple(ys) + tuple(carry)
+    return res if len(res) != 1 else res[0]
+
+
+@register("_cond", needs_rng=True, needs_mode=True,
+          num_outputs=lambda attrs: int(attrs["n_out"]))
+def _cond(key, *arrays, then_subgraph="", else_subgraph="", then_args="",
+          else_args="", n_out=0, _train=False):
+    n_out = int(n_out)
+    pred, free = arrays[0], arrays[1:]
+    free_names = []
+    seen = set()
+    for nm in _split_csv(then_args) + _split_csv(else_args):
+        if nm not in seen:
+            seen.add(nm)
+            free_names.append(nm)
+    env = dict(zip(free_names, free))
+    tfn = _sub_fn(then_subgraph, then_args, _train)
+    efn = _sub_fn(else_subgraph, else_args, _train)
+
+    pv = jnp.reshape(pred, ()).astype(bool)
+    t_in = tuple(env[nm] for nm in _split_csv(then_args))
+    e_in = tuple(env[nm] for nm in _split_csv(else_args))
+    res = lax.cond(pv, lambda _: tuple(tfn(jax.random.fold_in(key, 1), t_in)),
+                   lambda _: tuple(efn(jax.random.fold_in(key, 2), e_in)), None)
+    return tuple(res) if len(res) != 1 else res[0]

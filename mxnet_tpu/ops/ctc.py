@@ -1,0 +1,113 @@
+"""Connectionist Temporal Classification loss — TPU-native.
+
+Parity: reference `src/operator/nn/ctc_loss.cc` (warp-ctc backed op
+`_contrib_CTCLoss`, alias `ctc_loss`).  Semantics:
+
+* ``data``: (seq_len T, batch N, alphabet C) unnormalized activations —
+  softmax is applied internally.
+* ``label``: (N, L) class indices.  ``blank_label='first'`` reserves 0 for
+  blank (real labels 1..C-1, padding value 0); ``'last'`` reserves C-1
+  (real labels 0..C-2, padding value -1).
+* optional ``data_lengths`` (N,) / ``label_lengths`` (N,) gated by
+  ``use_data_lengths`` / ``use_label_lengths``; without label lengths the
+  length is inferred from the first padding value.
+* output: per-sample negative log-likelihood, shape (N,).
+
+Design: instead of the reference's hand-written warp-ctc alpha/beta kernels
+with an explicit gradient, this computes the forward log-likelihood with a
+log-space alpha recursion over ``lax.scan`` and lets jax/XLA derive the
+gradient by autodiff — exact, fuses on TPU, and supports bf16 inputs (math
+runs in f32).  The recursion is the standard Graves 2006 lattice over the
+blank-interleaved extended label sequence (S = 2L+1 states).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30  # finite stand-in for -inf: keeps logaddexp NaN-free
+
+
+def _logaddexp3(a, b, c):
+    return jnp.logaddexp(jnp.logaddexp(a, b), c)
+
+
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"),
+          tensor_opts=("data_lengths", "label_lengths"))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    if blank_label not in ("first", "last"):
+        raise ValueError(f"blank_label must be 'first' or 'last', got {blank_label!r}")
+    T, N, C = data.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)  # (T,N,C)
+    labels = label.astype(jnp.int32)
+
+    blank = 0 if blank_label == "first" else C - 1
+    pad = 0 if blank_label == "first" else -1
+
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        # first occurrence of the padding value terminates the label
+        is_pad = labels == pad
+        any_pad = jnp.any(is_pad, axis=1)
+        first_pad = jnp.argmax(is_pad, axis=1).astype(jnp.int32)
+        lab_len = jnp.where(any_pad, first_pad, L)
+    if use_data_lengths and data_lengths is not None:
+        dat_len = data_lengths.astype(jnp.int32)
+    else:
+        dat_len = jnp.full((N,), T, jnp.int32)
+
+    # extended sequence: [blank, l0, blank, l1, ..., blank]  (N, S)
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.clip(labels, 0, C - 1))
+
+    # skip transition s-2 -> s allowed iff ext[s] != blank and ext[s] != ext[s-2]
+    s_idx = jnp.arange(S)
+    is_label_pos = (s_idx % 2) == 1
+    same_as_prev2 = jnp.concatenate(
+        [jnp.zeros((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+    allow_skip = is_label_pos[None, :] & ~same_as_prev2  # (N,S)
+
+    # per-step emission log-probs gathered at extended labels: (T,N,S)
+    lp_ext = jnp.take_along_axis(
+        logp, jnp.broadcast_to(ext[None], (T, N, S)), axis=2)
+
+    valid1 = lab_len > 0
+    alpha0 = jnp.full((N, S), _NEG_INF, jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(lp_ext[0][:, 0])
+    if S > 1:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(valid1, lp_ext[0][:, 1], _NEG_INF))
+
+    def step(alpha, inp):
+        lp_t, t = inp
+        a1 = jnp.concatenate(
+            [jnp.full((N, 1), _NEG_INF, jnp.float32), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate(
+            [jnp.full((N, 2), _NEG_INF, jnp.float32), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(allow_skip, a2, _NEG_INF)
+        new = _logaddexp3(alpha, a1, a2) + lp_t
+        # samples whose sequence already ended keep their alpha frozen
+        alive = (t < dat_len)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = lax.scan(step, alpha0, (lp_ext[1:], ts))
+
+    # read out at the last blank (2*lab_len) and last label (2*lab_len - 1)
+    end_b = (2 * lab_len)[:, None]                     # (N,1)
+    a_end_b = jnp.take_along_axis(alpha, end_b, axis=1)[:, 0]
+    end_l = jnp.clip(2 * lab_len - 1, 0, S - 1)[:, None]
+    a_end_l = jnp.where(valid1,
+                        jnp.take_along_axis(alpha, end_l, axis=1)[:, 0],
+                        _NEG_INF)
+    ll = jnp.logaddexp(a_end_b, a_end_l)
+    return (-ll).astype(data.dtype)

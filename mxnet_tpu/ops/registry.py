@@ -27,10 +27,11 @@ _OPS: dict[str, "Op"] = {}
 class Op:
     """A registered operator."""
 
-    __slots__ = ("name", "fn", "num_outputs", "mutate_aux", "wrap_kwargs", "doc", "needs_rng", "needs_mode")
+    __slots__ = ("name", "fn", "num_outputs", "mutate_aux", "wrap_kwargs", "doc", "needs_rng",
+                 "needs_mode", "tensor_opts")
 
     def __init__(self, name, fn, num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
-                 needs_mode=False):
+                 needs_mode=False, tensor_opts=()):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
@@ -49,6 +50,12 @@ class Op:
         # the reference's FMutateInputs (`op_attr_types.h`).
         self.mutate_aux = mutate_aux
         self.wrap_kwargs = wrap_kwargs  # canonicalize attrs before hashing/jit
+        # names of OPTIONAL tensor inputs (defaulted-to-None fn params that
+        # take arrays, e.g. CTCLoss data_lengths/label_lengths).  The
+        # frontends keep their positional slots aligned (None placeholders in
+        # nd, `__opt_in__` keyword binding in symbol) so an absent earlier
+        # optional cannot shift a later one into its slot.
+        self.tensor_opts = tuple(tensor_opts)
         self.doc = fn.__doc__
 
     def n_out(self, attrs):
@@ -61,12 +68,12 @@ class Op:
 
 
 def register(name, aliases=(), num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
-             needs_mode=False):
+             needs_mode=False, tensor_opts=()):
     """Decorator: register a jax fn as operator ``name`` (+ aliases)."""
 
     def deco(fn):
         op = Op(name, fn, num_outputs=num_outputs, mutate_aux=mutate_aux, wrap_kwargs=wrap_kwargs,
-                needs_rng=needs_rng, needs_mode=needs_mode)
+                needs_rng=needs_rng, needs_mode=needs_mode, tensor_opts=tensor_opts)
         _OPS[name] = op
         for a in aliases:
             _OPS[a] = op
@@ -116,7 +123,9 @@ def bound_fn(name, **attrs):
     if op.wrap_kwargs is not None:
         attrs = op.wrap_kwargs(attrs)
     fn = op.fn
-    return lambda *arrays: fn(*arrays, **attrs)
+    # runtime **kw lets callers bind optional tensor inputs by name
+    # (symbol executor `__opt_in__` path) on top of the static attrs
+    return lambda *arrays, **kw: fn(*arrays, **attrs, **kw)
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,187 @@
+"""Symbolic control-flow frontends — symbol.contrib.foreach/while_loop/cond.
+
+Parity: `python/mxnet/symbol/contrib.py` (foreach/while_loop/cond cut NNVM
+subgraphs and deduce free-variable inputs).  Here the body callables build a
+Symbol sub-DAG over placeholder variables; free variables (weights etc. the
+body closes over) are discovered as the sub-DAG's non-placeholder variable
+leaves and wired as extra node inputs, so binding and autograd treat them
+like any other input.  The subgraph travels as a JSON attribute (survives
+save/load); execution lowers to lax.scan/lax.cond in
+`ops/control_flow_ops.py`.
+"""
+from __future__ import annotations
+
+import itertools
+
+from .symbol import Symbol, var, Group, _Node, _topo_order
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+_uid = itertools.count()
+
+
+def _flatten(x):
+    if isinstance(x, Symbol):
+        return [x], None
+    if x is None:
+        return [], ()
+    flat, struct = [], []
+    for item in x:
+        f, s = _flatten(item)
+        flat.extend(f)
+        struct.append((s, len(f)))
+    return flat, struct
+
+
+def _unflatten(flat, struct):
+    if struct is None:
+        return flat[0]
+    out, i = [], 0
+    for s, n in struct:
+        out.append(_unflatten(flat[i:i + n], s))
+        i += n
+    return out
+
+
+def _free_vars(heads, placeholder_names):
+    """Non-placeholder variable leaves of the sub-DAG, topo order."""
+    frees = []
+    for node in _topo_order([n for n, _ in heads._outputs]):
+        if node.is_variable and node.name not in placeholder_names:
+            frees.append(node)
+    return frees
+
+
+def _outputs_of(node, n):
+    return [Symbol([(node, i)]) for i in range(n)]
+
+
+def foreach(body, data, init_states, name=None):
+    """Symbolic foreach (reference `_foreach`, control_flow.cc:1255)."""
+    name = name or f"foreach{next(_uid)}"
+    data_l, data_struct = _flatten(data)
+    states_l, states_struct = _flatten(init_states)
+    if not data_l:
+        raise ValueError("foreach: data must contain at least one symbol")
+
+    slice_vars = [var(f"{name}_slice{i}") for i in range(len(data_l))]
+    state_vars = [var(f"{name}_state{i}") for i in range(len(states_l))]
+    out, new_s = body(_unflatten(slice_vars, data_struct),
+                      _unflatten(state_vars, states_struct))
+    out_l, out_struct = _flatten(out)
+    ns_l, ns_struct = _flatten(new_s)
+    if len(ns_l) != len(states_l):
+        raise ValueError(f"foreach: body returned {len(ns_l)} states, "
+                         f"expected {len(states_l)}")
+    sub = Group(out_l + ns_l)
+
+    ph = {s._outputs[0][0].name for s in slice_vars + state_vars}
+    frees = _free_vars(sub, ph)
+    sub_args = [s._outputs[0][0].name for s in slice_vars + state_vars] + \
+               [f.name for f in frees]
+
+    inputs = [s._outputs[0] for s in data_l + states_l] + \
+             [(f, 0) for f in frees]
+    attrs = {
+        "subgraph": sub.tojson(), "sub_args": ",".join(sub_args),
+        "n_data": len(data_l), "n_states": len(states_l),
+        "n_out": len(out_l), "__opt_in__": "",
+    }
+    node = _Node("_foreach", name, attrs, inputs)
+    outs = _outputs_of(node, len(out_l) + len(ns_l))
+    outputs = _unflatten(outs[:len(out_l)], out_struct) if out_l else []
+    states = _unflatten(outs[len(out_l):], ns_struct) if ns_l else []
+    return outputs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
+    """Symbolic while_loop (reference `_while_loop`, control_flow.cc:1316).
+    Bounded: requires `max_iterations` (static trip count for XLA); step
+    outputs are stacked to (max_iterations, ...) with zero padding."""
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations")
+    name = name or f"while{next(_uid)}"
+    lv_l, lv_struct = _flatten(loop_vars)
+    if not lv_l:
+        raise ValueError("while_loop: loop_vars must be non-empty")
+
+    lv_vars = [var(f"{name}_lv{i}") for i in range(len(lv_l))]
+    lv_args = _unflatten(lv_vars, lv_struct)
+    lv_list = lv_args if isinstance(lv_args, list) else [lv_args]
+
+    c_sym = cond(*lv_list)
+    if not isinstance(c_sym, Symbol):
+        raise TypeError("while_loop: cond must return a Symbol")
+    out, new_lv = func(*lv_list)
+    out_l, out_struct = _flatten(out)
+    nl_l, _ = _flatten(new_lv)
+    if len(nl_l) != len(lv_l):
+        raise ValueError(f"while_loop: func returned {len(nl_l)} loop_vars, "
+                         f"expected {len(lv_l)}")
+    body_sub = Group(out_l + nl_l)
+
+    lv_names = [v._outputs[0][0].name for v in lv_vars]
+    ph = set(lv_names)
+    c_frees = _free_vars(c_sym, ph)
+    b_frees = _free_vars(body_sub, ph)
+
+    def _used_names(sym_like, placeholders):
+        return [n.name for n in _topo_order([x for x, _ in sym_like._outputs])
+                if n.is_variable]
+
+    cond_args = _used_names(c_sym, ph)
+    body_args = _used_names(body_sub, ph)
+    free_nodes, seen = [], set(lv_names)
+    for f in c_frees + b_frees:
+        if f.name not in seen:
+            seen.add(f.name)
+            free_nodes.append(f)
+
+    inputs = [s._outputs[0] for s in lv_l] + [(f, 0) for f in free_nodes]
+    attrs = {
+        "cond_subgraph": c_sym.tojson(), "body_subgraph": body_sub.tojson(),
+        "cond_args": ",".join(cond_args), "body_args": ",".join(body_args),
+        "lv_names": ",".join(lv_names),
+        "n_lv": len(lv_l), "n_out": len(out_l),
+        "max_iterations": int(max_iterations),
+    }
+    node = _Node("_while_loop", name, attrs, inputs)
+    outs = _outputs_of(node, len(out_l) + len(lv_l))
+    outputs = _unflatten(outs[:len(out_l)], out_struct) if out_l else []
+    final_lv = _unflatten(outs[len(out_l):], lv_struct)
+    return outputs, final_lv
+
+
+def cond(pred, then_func, else_func, name=None):
+    """Symbolic cond (reference `_cond`, control_flow.cc:1378)."""
+    name = name or f"cond{next(_uid)}"
+    if not isinstance(pred, Symbol):
+        raise TypeError("cond: pred must be a Symbol")
+    t_out = then_func()
+    e_out = else_func()
+    t_l, t_struct = _flatten(t_out)
+    e_l, _ = _flatten(e_out)
+    if len(t_l) != len(e_l):
+        raise ValueError("cond: then/else must return the same number of "
+                         "outputs")
+    t_sub, e_sub = Group(t_l), Group(e_l)
+
+    t_args = [n.name for n in _topo_order([x for x, _ in t_sub._outputs])
+              if n.is_variable]
+    e_args = [n.name for n in _topo_order([x for x, _ in e_sub._outputs])
+              if n.is_variable]
+    free_nodes, seen = [], set()
+    for f in _free_vars(t_sub, set()) + _free_vars(e_sub, set()):
+        if f.name not in seen:
+            seen.add(f.name)
+            free_nodes.append(f)
+
+    inputs = [pred._outputs[0]] + [(f, 0) for f in free_nodes]
+    attrs = {
+        "then_subgraph": t_sub.tojson(), "else_subgraph": e_sub.tojson(),
+        "then_args": ",".join(t_args), "else_args": ",".join(e_args),
+        "n_out": len(t_l),
+    }
+    node = _Node("_cond", name, attrs, inputs)
+    outs = _outputs_of(node, len(t_l))
+    return _unflatten(outs, t_struct)

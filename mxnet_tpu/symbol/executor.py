@@ -73,10 +73,18 @@ def _graph_fn(sym, arg_names, aux_names, train):
                 attrs["_train"] = train
             f = _reg.bound_fn(node.op, **attrs)
             ins = [env[(id(c), oi)] for c, oi in node.inputs]
+            # optional tensor inputs recorded by _apply_op bind by keyword
+            opt_in = node.attrs.get("__opt_in__") or ""
+            kw_ins = {}
+            if opt_in:
+                names = opt_in.split(",")
+                n_pos = len(ins) - len(names)
+                kw_ins = dict(zip(names, ins[n_pos:]))
+                ins = ins[:n_pos]
             if op.needs_rng:
-                out = f(jax.random.fold_in(key, nidx), *ins)
+                out = f(jax.random.fold_in(key, nidx), *ins, **kw_ins)
             else:
-                out = f(*ins)
+                out = f(*ins, **kw_ins)
             outs = out if isinstance(out, (tuple, list)) else (out,)
             for i, o in enumerate(outs):
                 env[(id(node), i)] = o

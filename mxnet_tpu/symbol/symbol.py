@@ -514,8 +514,10 @@ def _apply_op(op_name, *args, name=None, attr=None, **kwargs):
         attrs.update(attr)
 
     pos_syms = [a for a in args if isinstance(a, Symbol)]
-    # None positionals are skipped (gluon passes bias=None for no_bias
-    # layers); other non-symbol positionals are rejected
+    # None positionals keep their slot only for declared optional tensor
+    # inputs (op.tensor_opts, e.g. CTCLoss lengths); elsewhere they are
+    # skipped (gluon passes bias=None for no_bias layers).  Other
+    # non-symbol positionals are rejected.
     extra_pos = [a for a in args if not isinstance(a, Symbol) and a is not None]
     if extra_pos:
         raise MXNetError(f"{op_name}: positional non-symbol args not "
@@ -528,11 +530,14 @@ def _apply_op(op_name, *args, name=None, attr=None, **kwargs):
             inputs.append((v._outputs[0][0], v._outputs[0][1]))
     else:
         pos_iter = iter(pos_syms)
+        n_pos_used = 0
         no_bias = bool(attrs.get("no_bias", False))
         for in_name in required:
             s = sym_kwargs.pop(in_name, None)
             if s is None:
                 s = next(pos_iter, None)
+                if s is not None:
+                    n_pos_used += 1
             if s is None:
                 s = var(f"{name}_{in_name}")
             if len(s._outputs) != 1:
@@ -543,10 +548,42 @@ def _apply_op(op_name, *args, name=None, attr=None, **kwargs):
             s = sym_kwargs.pop(optional, None)
             if s is None:
                 s = next(pos_iter, None)
+                if s is not None:
+                    n_pos_used += 1
             if s is None:
                 s = var(f"{name}_{optional}")
             inputs.append(s._outputs[0])
-        leftover = list(pos_iter)
+        if op.tensor_opts:
+            # map the raw positional tail (None placeholders preserved)
+            # onto the declared optional tensor slots, in order
+            raw_tail, seen = [], 0
+            for a in args:
+                if isinstance(a, Symbol):
+                    seen += 1
+                    if seen > n_pos_used:
+                        raw_tail.append(a)
+                elif a is None:
+                    raw_tail.append(None)
+            if len(raw_tail) > len(op.tensor_opts):
+                raise MXNetError(f"{op_name}: too many symbol inputs")
+            bound_opts = []
+            for slot, a in zip(op.tensor_opts, raw_tail):
+                s = sym_kwargs.pop(slot, None)
+                if s is None and isinstance(a, Symbol):
+                    s = a
+                if s is not None:
+                    inputs.append(s._outputs[0])
+                    bound_opts.append(slot)
+            for slot in op.tensor_opts[len(raw_tail):]:
+                s = sym_kwargs.pop(slot, None)
+                if s is not None:
+                    inputs.append(s._outputs[0])
+                    bound_opts.append(slot)
+            if bound_opts:
+                attrs["__opt_in__"] = ",".join(bound_opts)
+            leftover = []
+        else:
+            leftover = list(pos_iter)
         if leftover or sym_kwargs:
             raise MXNetError(f"{op_name}: too many symbol inputs "
                              f"(leftover={len(leftover)}, kw={list(sym_kwargs)})")
@@ -624,11 +661,18 @@ def _eval_node_shapes(node, in_shapes):
     fn = _reg.bound_fn(node.op, **{k: v for k, v in attrs.items()
                                    if not k.startswith("__")})
     specs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+    opt_in = node.attrs.get("__opt_in__") or ""
+    kw_specs = {}
+    if opt_in:
+        names = opt_in.split(",")
+        n_pos = len(specs) - len(names)
+        kw_specs = dict(zip(names, specs[n_pos:]))
+        specs = specs[:n_pos]
     if op.needs_rng:
         key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
-        out = jax.eval_shape(fn, key_spec, *specs)
+        out = jax.eval_shape(fn, key_spec, *specs, **kw_specs)
     else:
-        out = jax.eval_shape(fn, *specs)
+        out = jax.eval_shape(fn, *specs, **kw_specs)
     if isinstance(out, (list, tuple)):
         return [tuple(o.shape) for o in out]
     return [tuple(out.shape)]

@@ -42,3 +42,31 @@ def get_gpu_count():
 
 def get_gpu_memory(gpu_dev_id=0):
     return (0, 0)
+
+
+def flatten_nested(x, leaf_cls):
+    """Flatten an arbitrarily nested list/tuple of `leaf_cls` instances.
+    Returns (flat_list, structure); `structure` is None for a bare leaf and
+    a list of (child_structure, child_leaf_count) otherwise.  Shared by the
+    nd and symbol control-flow frontends (foreach/while_loop/cond)."""
+    if isinstance(x, leaf_cls):
+        return [x], None
+    if x is None:
+        return [], ()
+    flat, struct = [], []
+    for item in x:
+        f, s = flatten_nested(item, leaf_cls)
+        flat.extend(f)
+        struct.append((s, len(f)))
+    return flat, struct
+
+
+def unflatten_nested(flat, struct):
+    """Inverse of flatten_nested."""
+    if struct is None:
+        return flat[0]
+    out, i = [], 0
+    for s, n in struct:
+        out.append(unflatten_nested(flat[i:i + n], s))
+        i += n
+    return out

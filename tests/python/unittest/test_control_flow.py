@@ -1,0 +1,218 @@
+"""Control-flow operator tests — nd.contrib + symbol.contrib.
+
+Parity: reference `src/operator/control_flow.cc` (`_foreach`:1255,
+`_while_loop`:1316, `_cond`:1378), frontends
+`python/mxnet/{ndarray,symbol}/contrib.py`, test model
+`tests/python/unittest/test_contrib_control_flow.py`.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# --- ndarray frontends ------------------------------------------------------
+
+def test_nd_foreach_cumsum():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = nd.array(np.zeros(3, np.float32))
+    out, states = nd.contrib.foreach(lambda x, s: (x + s, x + s), data, init)
+    assert_almost_equal(out.asnumpy(), np.cumsum(data.asnumpy(), 0))
+    assert_almost_equal(states.asnumpy(), data.asnumpy().sum(0))
+
+
+def test_nd_foreach_closure_grads():
+    """Gradients must flow to closure-captured weights (free variables)."""
+    rng = np.random.RandomState(0)
+    w = nd.array(np.full((3, 3), 0.5, np.float32)); w.attach_grad()
+    x = nd.array(rng.randn(5, 2, 3).astype(np.float32)); x.attach_grad()
+    s0 = nd.array(np.zeros((2, 3), np.float32))
+    with autograd.record():
+        outs, _ = nd.contrib.foreach(
+            lambda xi, s: (nd.dot(xi, w) + s, nd.dot(xi, w) + s), x, s0)
+        loss = outs.sum()
+    loss.backward()
+    assert np.abs(w.grad.asnumpy()).sum() > 0
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+    # oracle: grads of the same unrolled computation
+    import jax
+    import jax.numpy as jnp
+
+    def unrolled(wv, xv):
+        s = jnp.zeros((2, 3), jnp.float32)
+        tot = 0.0
+        for t in range(5):
+            s = xv[t] @ wv + s
+            tot = tot + s.sum()
+        return tot
+
+    gw, gx = jax.grad(unrolled, argnums=(0, 1))(
+        jnp.full((3, 3), 0.5, jnp.float32),
+        jnp.asarray(x.asnumpy()))
+    assert_almost_equal(w.grad.asnumpy(), np.asarray(gw), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(x.grad.asnumpy(), np.asarray(gx), rtol=1e-4, atol=1e-5)
+
+
+def test_nd_foreach_multiple_data_states():
+    a = nd.array(np.ones((3, 2), np.float32))
+    b = nd.array(np.full((3, 2), 2.0, np.float32))
+    s = nd.array(np.zeros(2, np.float32))
+    out, st = nd.contrib.foreach(
+        lambda xs, ss: (xs[0] + xs[1], ss + xs[0].sum()), [a, b], s)
+    assert_almost_equal(out.asnumpy(), np.full((3, 2), 3.0))
+    assert_almost_equal(st.asnumpy(), np.full(2, 6.0))
+
+
+def test_nd_while_loop():
+    i = nd.array([0.0])
+    acc = nd.array([1.0])
+    outs, (fi, fa) = nd.contrib.while_loop(
+        lambda i, a: (i < 4).astype("float32"),
+        lambda i, a: ([a * 2], [i + 1, a * 2]),
+        [i, acc], max_iterations=8)
+    assert fa.asnumpy()[0] == 16.0
+    assert fi.asnumpy()[0] == 4.0
+    # padded beyond actual steps
+    assert_almost_equal(outs[0].asnumpy().ravel(),
+                        np.array([2, 4, 8, 16, 0, 0, 0, 0], np.float32))
+
+
+def test_nd_while_loop_grad():
+    x = nd.array([2.0]); x.attach_grad()
+    with autograd.record():
+        _, (_, final) = nd.contrib.while_loop(
+            lambda i, a: (i < 3).astype("float32"),
+            lambda i, a: ([a], [i + 1, a * x]),
+            [nd.array([0.0]), nd.array([1.0])], max_iterations=5)
+        loss = final.sum()
+    loss.backward()
+    # final = x^3 -> d/dx = 3 x^2 = 12
+    assert_almost_equal(x.grad.asnumpy(), np.array([12.0]), rtol=1e-5, atol=1e-6)
+
+
+def test_nd_cond():
+    a, b = nd.array([2.0]), nd.array([3.0])
+    r = nd.contrib.cond(nd.array([1.0]), lambda: a * 10, lambda: b * 10)
+    assert r.asnumpy()[0] == 20.0
+    r = nd.contrib.cond(nd.array([0.0]), lambda: a * 10, lambda: b * 10)
+    assert r.asnumpy()[0] == 30.0
+
+
+def test_nd_cond_grad_through_branches():
+    a = nd.array([2.0]); a.attach_grad()
+    with autograd.record():
+        r = nd.contrib.cond(nd.array([1.0]), lambda: a * a, lambda: a * 3)
+    r.backward()
+    assert_almost_equal(a.grad.asnumpy(), np.array([4.0]))
+
+
+def test_nd_foreach_deferred_init_in_body():
+    """A gluon block first-called INSIDE the body must not leak tracers
+    into its deferred-initialized parameters (regression: eager warm-up)."""
+    from mxnet_tpu.gluon import nn, Trainer
+
+    rng = np.random.RandomState(0)
+    T, B, D = 4, 2, 6
+    cell = nn.Dense(D, flatten=False)
+    cell.initialize()  # deferred: shapes unknown until first call
+    x = nd.array(rng.randn(T, B, D).astype(np.float32))
+    target = nd.array(rng.randn(B, D).astype(np.float32))
+    tr = Trainer(cell.collect_params(), "adam", {"learning_rate": 0.05})
+    losses = []
+    for _ in range(15):
+        with autograd.record():
+            _, final = nd.contrib.foreach(
+                lambda xi, s: (cell(xi + s), cell(xi + s)), x,
+                nd.array(np.zeros((B, D), np.float32)))
+            loss = ((final - target) ** 2).sum()
+        loss.backward()
+        tr.step(B)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+# --- symbol frontends -------------------------------------------------------
+
+def _foreach_graph():
+    data, init, w = sym.var("data"), sym.var("init"), sym.var("w")
+    outs, states = sym.contrib.foreach(
+        lambda x, s: (sym.dot(x, w) + s, sym.dot(x, w) + s), data, init)
+    return sym.Group([outs, states])
+
+
+def _foreach_oracle(dv, iv, wv):
+    st, ref = iv.copy(), []
+    for t in range(dv.shape[0]):
+        st = dv[t] @ wv + st
+        ref.append(st)
+    return np.stack(ref), st
+
+
+def test_sym_foreach_forward_backward():
+    g = _foreach_graph()
+    assert g.list_arguments() == ["data", "init", "w"]
+    rng = np.random.RandomState(0)
+    dv = rng.randn(4, 2, 3).astype(np.float32)
+    iv = np.zeros((2, 3), np.float32)
+    wv = rng.randn(3, 3).astype(np.float32)
+    ref_o, ref_s = _foreach_oracle(dv, iv, wv)
+
+    ex = g.simple_bind(grad_req="write", data=(4, 2, 3), init=(2, 3), w=(3, 3))
+    o, s = [a.asnumpy() for a in ex.forward(
+        is_train=True, data=nd.array(dv), init=nd.array(iv), w=nd.array(wv))]
+    assert_almost_equal(o, ref_o, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(s, ref_s, rtol=1e-5, atol=1e-6)
+
+    ex.backward([nd.array(np.ones((4, 2, 3), np.float32)),
+                 nd.array(np.zeros((2, 3), np.float32))])
+    # oracle grad via jax over the unrolled computation
+    import jax
+    import jax.numpy as jnp
+
+    def unrolled(wv_, dv_):
+        s_ = jnp.zeros((2, 3), jnp.float32)
+        tot = 0.0
+        for t in range(4):
+            s_ = dv_[t] @ wv_ + s_
+            tot = tot + s_.sum()
+        return tot
+
+    gw, gd = jax.grad(unrolled, argnums=(0, 1))(jnp.asarray(wv), jnp.asarray(dv))
+    assert_almost_equal(ex.grad_dict["w"].asnumpy(), np.asarray(gw),
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), np.asarray(gd),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_sym_foreach_json_roundtrip():
+    g = _foreach_graph()
+    rng = np.random.RandomState(1)
+    dv = rng.randn(4, 2, 3).astype(np.float32)
+    iv = np.zeros((2, 3), np.float32)
+    wv = rng.randn(3, 3).astype(np.float32)
+    ref_o, _ = _foreach_oracle(dv, iv, wv)
+    g2 = sym.load_json(g.tojson())
+    ex = g2.simple_bind(data=(4, 2, 3), init=(2, 3), w=(3, 3))
+    o = ex.forward(data=nd.array(dv), init=nd.array(iv),
+                   w=nd.array(wv))[0].asnumpy()
+    assert_almost_equal(o, ref_o, rtol=1e-5, atol=1e-6)
+
+
+def test_sym_while_loop_and_cond():
+    i, a = sym.var("i"), sym.var("acc")
+    _, (fi, fa) = sym.contrib.while_loop(
+        lambda i, a: i < 4, lambda i, a: ([a * 2], [i + 1, a * 2]),
+        [i, a], max_iterations=8)
+    ex = sym.Group([fi, fa]).simple_bind(i=(1,), acc=(1,))
+    ri, ra = [x.asnumpy() for x in ex.forward(i=nd.array([0.0]),
+                                              acc=nd.array([1.0]))]
+    assert ri[0] == 4.0 and ra[0] == 16.0
+
+    p, x = sym.var("p"), sym.var("x")
+    c = sym.contrib.cond(p, lambda: x * 2, lambda: x * 3)
+    exc = c.simple_bind(p=(1,), x=(1,))
+    assert exc.forward(p=nd.array([1.0]), x=nd.array([5.0]))[0].asnumpy()[0] == 10.0
+    assert exc.forward(p=nd.array([0.0]), x=nd.array([5.0]))[0].asnumpy()[0] == 15.0

