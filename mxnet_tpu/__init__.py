@@ -9,6 +9,19 @@ over ICI/DCN. API parity follows the reference `python/mxnet/__init__.py`.
 
 __version__ = "0.1.0"
 
+# `tools/launch.py` workers force their jax platform via MXNET_DIST_PLATFORM.
+# It must be applied before ANY backend touch (an NDArray built before
+# kv.create would otherwise initialise the default — possibly TPU — backend
+# and the later update would be a no-op with N workers fighting for one chip).
+import os as _os
+
+if _os.environ.get("MXNET_DIST_PLATFORM"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["MXNET_DIST_PLATFORM"])
+    if _os.environ["MXNET_DIST_PLATFORM"] == "cpu":
+        _jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
 from .base import MXNetError
 from . import context
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus

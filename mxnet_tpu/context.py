@@ -92,17 +92,21 @@ class Context:
 
 
 def _platform_devices(platform):
+    """Addressable devices of a platform. A Context names a device THIS
+    process can touch — in a multi-process job `jax.devices()` includes
+    other workers' (non-addressable) devices, which eager ops must never
+    device_put to (reference contexts are per-process for the same reason)."""
     jax = _jax()
     try:
-        return jax.devices(platform)
+        return [d for d in jax.local_devices() if d.platform == platform]
     except RuntimeError:
         return []
 
 
 def _accelerator_devices():
-    """All non-cpu jax devices (tpu; 'axon' tunnel; gpu as a courtesy)."""
+    """All non-cpu addressable jax devices (tpu; 'axon' tunnel; gpu)."""
     jax = _jax()
-    return [d for d in jax.devices() if d.platform != "cpu"]
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
 def cpu(device_id=0):

@@ -38,9 +38,10 @@ class KVStoreBase:
     """Shared interface (parity `include/mxnet/kvstore.h:59`)."""
 
     def __init__(self):
+        from .gradient_compression import GradientCompression
         self._updater = None
         self._updater_func = None
-        self._compression_params = None
+        self._gc = GradientCompression()
 
     # -- type/rank ----------------------------------------------------------
 
@@ -57,10 +58,10 @@ class KVStoreBase:
         return 1
 
     def set_gradient_compression(self, compression_params):
-        """2-bit gradient compression (reference `gradient_compression.cc`).
-        On TPU, ICI bandwidth makes compression rarely profitable; accepted
-        and recorded for API parity, applied only by dist kvstores."""
-        self._compression_params = dict(compression_params)
+        """2-bit gradient compression with error-feedback residual
+        (reference `gradient_compression.cc:45`): subsequent pushes are
+        quantized to {-threshold, 0, +threshold}; init bypasses it."""
+        self._gc.set_params(compression_params)
 
     def set_optimizer(self, optimizer):
         """Register optimizer so updates run 'on the kvstore' (parity
@@ -134,6 +135,10 @@ class KVStoreLocal(KVStoreBase):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized (call init first)")
             merged = _ctx_group_sum(vals)
+            if self._gc.active:
+                packed = self._gc.quantize(k, merged._data)
+                merged = NDArray(self._gc.dequantize(
+                    packed, merged.shape, merged.dtype), merged.context)
             if self._updater is not None:
                 idx = k if isinstance(k, int) else _str_key_int(k)
                 weight = self._store[k]
@@ -159,7 +164,11 @@ class KVStoreLocal(KVStoreBase):
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only selected rows (reference PullRowSparseImpl
-        `kvstore_dist.h:271`). Dense TPU rendering: gather the rows."""
+        `kvstore_dist.h:271`): the result has the full logical shape with
+        the deduplicated requested rows filled, everything else zero —
+        identical contract to the dist store."""
+        import jax.numpy as jnp
+
         assert out is not None and row_ids is not None
         if isinstance(out, NDArray):
             out = [out]
@@ -168,8 +177,12 @@ class KVStoreLocal(KVStoreBase):
         key_list = [key] if isinstance(key, (str, int)) else key
         for k, o, rid in zip(key_list * len(out), out, row_ids):
             src = self._store[k]
-            rows = nd.take(src, rid.as_in_context(src.context))
-            o[:] = rows.as_in_context(o.context)
+            ridx = rid._data.reshape(-1).astype(jnp.int32)
+            result = jnp.zeros(src.shape, src.dtype)
+            if ridx.size:
+                uniq = jnp.unique(ridx)
+                result = result.at[uniq].set(jnp.take(src._data, uniq, axis=0))
+            o._data = jnp.asarray(result, o.dtype)
 
 
 def _str_key_int(k):

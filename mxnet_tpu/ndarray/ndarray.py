@@ -462,7 +462,7 @@ def _ctx_of(data):
 def _accel_index(dev):
     import jax as _jax
 
-    accels = [d for d in _jax.devices() if d.platform != "cpu"]
+    accels = [d for d in _jax.local_devices() if d.platform != "cpu"]
     for i, d in enumerate(accels):
         if d == dev:
             return i

@@ -7,20 +7,40 @@ N server processes + M workers over ZMQ (`kvstore_dist.h:44`,
 (`ApplyUpdates:346`). On TPU there are no servers: every host joins one
 SPMD process group (`jax.distributed`), arrays are global, and a push is an
 AllReduce over ICI (DCN across slices) inside a tiny jitted program.
-update_on_kvstore maps to False — allreduce + local (replicated) update —
-the Horovod-style flow the reference itself uses at `gluon/trainer.py:327`.
+update_on_kvstore maps to an updater applied on the replicated aggregate —
+identical math on every process, no server round-trip.
+
+Data plane design (round-4 rewrite — no host bounce):
+
+* values stay jax Arrays end-to-end; a push builds one **global** array
+  whose leading axis is the device count (this process's contribution on
+  its local device 0, zeros elsewhere — `make_array_from_single_device_arrays`,
+  no host numpy copies), then runs one cached jitted ``sum(axis=0)`` with a
+  fully-replicated output sharding: XLA lowers that to the AllReduce.
+* keys are **bucketed**: one flattened+concatenated buffer per dtype per
+  push call (cap `MXNET_KVSTORE_DIST_BUCKET_SIZE` elements), one collective
+  per bucket — the reference's key batching (`MXNET_UPDATE_AGGREGATION_SIZE`,
+  `kvstore_nccl.h`).
+* 2-bit gradient compression (`gradient_compression.cc:45`): each worker
+  quantizes with its own error-feedback residual, the packed uint32 words
+  (16× smaller) ride one all-gather, and a single fused program dequantizes
+  every worker's words and sums them (`..gradient_compression`).
+* row_sparse pushes ship (indices, rows) padded to the max worker count —
+  an all-gather of the occupied rows only; the full dense gradient is never
+  materialized (reference `EncodeRowSparseKey`, `kvstore_dist.h:676`).
 """
 from __future__ import annotations
 
+import functools
 import os
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import default_mesh, create_mesh
+from .mesh import default_mesh
 from . import collectives as coll
 
 _initialized = False
@@ -33,6 +53,10 @@ def init_process_group(coordinator=None, num_processes=None, process_id=None):
     `DMLC_PS_ROOT_URI`/`DMLC_PS_ROOT_PORT` → coordinator address,
     `DMLC_NUM_WORKER` → process count, `DMLC_WORKER_ID` → process id
     (ps-lite's scheduler rendezvous, minus the scheduler).
+
+    `MXNET_DIST_PLATFORM=cpu` (set by `tools/launch.py --launcher local`)
+    forces the CPU backend with gloo cross-process collectives *before* the
+    backend initialises — multi-worker correctness runs need no TPU.
     """
     global _initialized
     if _initialized:
@@ -41,8 +65,16 @@ def init_process_group(coordinator=None, num_processes=None, process_id=None):
     if coordinator is None:
         _initialized = True  # single-process
         return
-    num_processes = num_processes or int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    process_id = process_id if process_id is not None else int(os.environ.get("DMLC_WORKER_ID", "0"))
+    platform = os.environ.get("MXNET_DIST_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    num_processes = num_processes or int(
+        os.environ.get("MXNET_NUM_PROCESSES", os.environ.get("DMLC_NUM_WORKER", "1")))
+    if process_id is None:
+        process_id = int(
+            os.environ.get("MXNET_PROCESS_ID", os.environ.get("DMLC_WORKER_ID", "0")))
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
@@ -50,6 +82,8 @@ def init_process_group(coordinator=None, num_processes=None, process_id=None):
 
 
 def _env_coordinator():
+    if os.environ.get("MXNET_COORDINATOR"):
+        return os.environ["MXNET_COORDINATOR"]
     uri = os.environ.get("DMLC_PS_ROOT_URI")
     if not uri:
         return None
@@ -69,13 +103,98 @@ def device_count():
     return len(jax.devices())
 
 
+# -- cached collective programs ----------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _collective_mesh():
+    """Flat 1-D mesh over every device in the job."""
+    return Mesh(np.array(jax.devices()), ("procdev",))
+
+
+@functools.lru_cache(maxsize=None)
+def _sum_over_devices_fn():
+    # jit caches per input shape/dtype; one wrapper suffices for all keys
+    mesh = _collective_mesh()
+    return jax.jit(lambda x: x.sum(axis=0),
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_fn():
+    """Replicate a device-sharded stack everywhere (AllGather)."""
+    mesh = _collective_mesh()
+    return jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=None)
+def _dequant_sum_fn(segments, threshold, dtype_str):
+    """One fused program: dequantize every worker's packed 2-bit words for a
+    whole key bucket and sum over workers. ``segments`` is a static tuple of
+    (word_start, word_count, shape) per key."""
+    from ..gradient_compression import dequantize_2bit
+
+    mesh = _collective_mesh()
+    dtype = jnp.dtype(dtype_str)
+
+    def body(packed_stack):  # (n_dev, total_words) uint32
+        outs = []
+        for (ws, wc, shape) in segments:
+            seg = packed_stack[:, ws:ws + wc]
+            de = jax.vmap(lambda p: dequantize_2bit(p, shape, threshold, dtype))(seg)
+            outs.append(de.sum(axis=0))
+        return tuple(outs)
+
+    return jax.jit(body, out_shardings=NamedSharding(mesh, P()))
+
+
+def _make_global_stack(buf, fill=0):
+    """Build the (n_dev, *buf.shape) global array: this process's ``buf`` on
+    its first local device, a neutral ``fill`` on its other local devices
+    (so a sum over axis 0 is the sum over processes, and gathers can filter
+    the neutral rows). No host round-trip."""
+    mesh = _collective_mesh()
+    n_dev = len(jax.devices())
+    sharding = NamedSharding(mesh, P("procdev"))
+    local = jax.local_devices()
+    shards = []
+    for i, d in enumerate(local):
+        if i == 0:
+            shards.append(jax.device_put(jnp.expand_dims(buf, 0), d))
+        else:
+            shards.append(jax.device_put(
+                jnp.full((1,) + buf.shape, fill, buf.dtype), d))
+    return jax.make_array_from_single_device_arrays(
+        (n_dev,) + tuple(buf.shape), sharding, shards)
+
+
+def _allreduce_sum(buf):
+    """Sum ``buf`` over all worker processes; replicated result (one
+    AllReduce on the wire)."""
+    if jax.process_count() == 1 and jax.local_device_count() == len(jax.devices()):
+        return buf
+    stack = _make_global_stack(buf)
+    out = _sum_over_devices_fn()(stack)
+    return out.addressable_data(0)
+
+
+def _allgather(buf, fill=0):
+    """All-gather ``buf`` from every device → replicated (n_dev, *shape).
+    Rows from non-primary local devices hold the neutral ``fill``."""
+    stack = _make_global_stack(buf, fill=fill)
+    out = _gather_fn()(stack)
+    return out.addressable_data(0)
+
+
+_BUCKET_CAP = int(os.environ.get("MXNET_KVSTORE_DIST_BUCKET_SIZE", str(4 << 20)))
+
+
 class KVStoreDistTPUSync:
     """`kv.create('dist_tpu_sync')` / `'dist_sync'` / `'dist'`.
 
     Keeps the KVStore front API (init/push/pull/pushpull, `kvstore.py`) so
     Trainer/Module code is unchanged, but push+pull together are ONE
-    AllReduce over every device in the mesh — per-key programs are compile-
-    cached by shape. Keys live replicated on the mesh.
+    AllReduce over every device in the mesh — per-bucket programs are
+    compile-cached by shape. Keys live replicated on the mesh.
 
     Semantics vs reference (`kvstore_dist_server.h` sync mode): the server
     aggregated exactly num_workers pushes then answered pulls; here the
@@ -84,12 +203,16 @@ class KVStoreDistTPUSync:
     """
 
     def __init__(self, mesh=None):
+        from ..gradient_compression import GradientCompression
+
         init_process_group()
         self.mesh = mesh or default_mesh()
-        self._store = {}
+        self._store = {}           # key -> replicated jax Array
+        self._pending = {}         # key -> aggregated dense grad
+        self._pending_rsp = {}     # key -> list of (idx int32 (m,), data (m, ...))
         self._updater = None
         self._optimizer = None
-        self._compression = None
+        self._gc = GradientCompression()
 
     # -- identity -----------------------------------------------------------
 
@@ -114,68 +237,221 @@ class KVStoreDistTPUSync:
         return [key], [value]
 
     def init(self, key, value):
+        """Set initial values (never compressed — reference inits bypass
+        gradient compression, `tests/nightly/dist_sync_kvstore.py:274-284`)."""
+        from ..base import MXNetError
         from ..ndarray import NDArray
 
         keys, vals = self._key_list(key, value)
-        repl = NamedSharding(self.mesh, P())
         for k, v in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
             arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-            self._store[k] = jax.device_put(arr, repl)
+            self._store[k] = jnp.asarray(arr)
 
     def push(self, key, value, priority=0, ignore_sparse=True):
-        """Accumulate grads: AllReduce(value) into the pending buffer."""
+        """Aggregate grads over all workers into the pending buffer."""
+        from ..base import MXNetError
         from ..ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
 
         keys, vals = self._key_list(key, value)
+        dense_keys, dense_arrs = [], []
         for k, v in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized (call init first)")
+            if isinstance(v, RowSparseNDArray):
+                self._push_row_sparse(k, v)
+                continue
             if isinstance(v, (list, tuple)):  # per-device list → local sum first
                 arr = _local_sum([x._data if isinstance(x, NDArray) else x for x in v])
             else:
                 arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-            reduced = self._allreduce(arr)
-            pend = self._store.get(("pending", k))
-            self._store[("pending", k)] = reduced if pend is None else pend + reduced
+            dense_keys.append(k)
+            dense_arrs.append(arr)
+        if not dense_keys:
+            return
+        if self._gc.active:
+            self._push_dense_compressed(dense_keys, dense_arrs)
+        else:
+            self._push_dense(dense_keys, dense_arrs)
+
+    def _push_dense(self, keys, arrs):
+        """Bucketed allreduce: flatten+concat per dtype (fp16 rides an fp32
+        wire — gloo/ICI-friendly, exact for gradient magnitudes), one
+        collective per bucket, split back per key."""
+        buckets = []  # list of (keys, arrs)
+        groups = {}
+        for k, a in zip(keys, arrs):
+            groups.setdefault(str(a.dtype), []).append((k, a))
+        for _, ka in groups.items():
+            cur_k, cur_a, cur_n = [], [], 0
+            for k, a in ka:
+                if cur_k and cur_n + a.size > _BUCKET_CAP:
+                    buckets.append((cur_k, cur_a))
+                    cur_k, cur_a, cur_n = [], [], 0
+                cur_k.append(k)
+                cur_a.append(a)
+                cur_n += a.size
+            if cur_k:
+                buckets.append((cur_k, cur_a))
+        for bkeys, barrs in buckets:
+            wire_dtype = jnp.float32 if barrs[0].dtype == jnp.float16 else barrs[0].dtype
+            if len(barrs) == 1:
+                reduced = _allreduce_sum(barrs[0].astype(wire_dtype))
+                parts = [reduced]
+            else:
+                flat = jnp.concatenate([a.reshape(-1).astype(wire_dtype) for a in barrs])
+                red = _allreduce_sum(flat)
+                parts, off = [], 0
+                for a in barrs:
+                    parts.append(red[off:off + a.size].reshape(a.shape))
+                    off += a.size
+            for k, a, p in zip(bkeys, barrs, parts):
+                p = p.astype(a.dtype)
+                pend = self._pending.get(k)
+                self._pending[k] = p if pend is None else pend + p
+
+    def _push_dense_compressed(self, keys, arrs):
+        """2-bit compressed push: quantize locally (error feedback), ship
+        packed words over one all-gather, dequantize+sum in one program."""
+        segments, packs = [], []
+        off = 0
+        for k, a in zip(keys, arrs):
+            packed = self._gc.quantize(k, a.astype(jnp.float32))
+            segments.append((off, packed.shape[0], tuple(a.shape)))
+            packs.append(packed)
+            off += packed.shape[0]
+        bucket = packs[0] if len(packs) == 1 else jnp.concatenate(packs)
+        stack = _make_global_stack(bucket)  # fill=0 words dequantize to 0
+        fn = _dequant_sum_fn(tuple(segments), float(self._gc.threshold), "float32")
+        outs = fn(stack)
+        for k, a, o in zip(keys, arrs, outs):
+            p = o.addressable_data(0).astype(a.dtype)
+            pend = self._pending.get(k)
+            self._pending[k] = p if pend is None else pend + p
+
+    def _push_row_sparse(self, k, v):
+        """Ship only the occupied rows: all-gather (indices, rows) padded to
+        the max per-worker row count (reference EncodeRowSparseKey,
+        `kvstore_dist.h:676`); aggregation stays sparse until update time."""
+        idx = v.indices._data.astype(jnp.int32)
+        data = v.data._data
+        n_proc = self.num_workers
+        if n_proc == 1:
+            if idx.size:
+                self._pending_rsp.setdefault(k, []).append((idx, data))
+            else:
+                self._pending_rsp.setdefault(k, [])
+            return
+        counts = _allgather(jnp.asarray([idx.shape[0]], jnp.int32))
+        cap = int(np.asarray(counts).max())
+        self._pending_rsp.setdefault(k, [])
+        if cap == 0:
+            return
+        row_shape = tuple(self._store[k].shape[1:])
+        pad_idx = jnp.full((cap,), -1, jnp.int32).at[:idx.shape[0]].set(idx)
+        pad_data = jnp.zeros((cap,) + row_shape, data.dtype)
+        if idx.shape[0]:
+            pad_data = pad_data.at[:idx.shape[0]].set(data)
+        all_idx = np.asarray(_allgather(pad_idx, fill=-1))  # (n_dev, cap)
+        all_data = _allgather(pad_data)                     # (n_dev, cap, ...)
+        pieces_i, pieces_d = [], []
+        for r in range(all_idx.shape[0]):
+            valid = all_idx[r] >= 0
+            if valid.any():
+                pieces_i.append(jnp.asarray(all_idx[r][valid]))
+                pieces_d.append(all_data[r][np.nonzero(valid)[0]])
+        if pieces_i:
+            self._pending_rsp[k].append(
+                (jnp.concatenate(pieces_i), jnp.concatenate(pieces_d)))
+
+    def _merged_rsp(self, k):
+        """Merge pending sparse pieces: unique rows + segment sum."""
+        pieces = self._pending_rsp.pop(k)
+        if not pieces:
+            return None
+        idx = jnp.concatenate([p[0] for p in pieces])
+        data = jnp.concatenate([p[1] for p in pieces])
+        uniq, inv = jnp.unique(idx, return_inverse=True)
+        summed = jax.ops.segment_sum(data, inv.reshape(-1), num_segments=uniq.shape[0])
+        return uniq, summed
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from ..base import MXNetError
         from ..ndarray import NDArray
 
         keys, outs = self._key_list(key, out)
         for k, o in zip(keys, outs):
-            pend = self._store.pop(("pending", k), None)
-            if pend is not None:
-                if self._updater is not None:
-                    # update_on_kvstore=True path: run optimizer on the
-                    # aggregated grad, replicated everywhere (the TPU
-                    # version of server-side ApplyUpdates)
-                    stored = NDArray(self._store[k])
-                    kk = k if isinstance(k, int) else _stable_key_index(k)
-                    self._updater(kk, NDArray(pend), stored)
-                    self._store[k] = stored._data
-                else:
-                    self._store[k] = pend
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized (call init first)")
+            self._apply_pending(k)
             val = self._store[k]
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 t._data = jnp.asarray(val, t.dtype)
+
+    def _apply_pending(self, k):
+        from ..ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if k in self._pending_rsp:
+            merged = self._merged_rsp(k)
+            stored = self._store[k]
+            if merged is None:
+                # every worker pushed an empty row_sparse grad: with an
+                # updater that's a no-op update; without one, stored becomes
+                # the (all-zero) aggregate (kvstore_dist_server.h ApplyUpdates)
+                if self._updater is None:
+                    self._store[k] = jnp.zeros_like(stored)
+                return
+            uniq, summed = merged
+            if self._updater is not None:
+                grad = RowSparseNDArray(NDArray(summed.astype(stored.dtype)),
+                                        NDArray(uniq.astype(jnp.int64)),
+                                        tuple(stored.shape))
+                w = NDArray(stored)
+                self._updater(_key_index(k), grad, w)
+                self._store[k] = w._data
+            else:
+                # sync mode without updater: stored = merged (CopyFromTo of
+                # the row_sparse aggregate, kvstore_dist_server.h ApplyUpdates)
+                dense = jnp.zeros_like(stored).at[uniq].set(summed.astype(stored.dtype))
+                self._store[k] = dense
+            return
+        pend = self._pending.pop(k, None)
+        if pend is None:
+            return
+        if self._updater is not None:
+            stored = NDArray(self._store[k])
+            self._updater(_key_index(k), NDArray(pend), stored)
+            self._store[k] = stored._data
+        else:
+            self._store[k] = jnp.asarray(pend, self._store[k].dtype)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse pull: gather the requested rows from the replicated value
-        (reference `PullRowSparseImpl`, `kvstore_dist.h:271`)."""
+        """Pull only the requested rows (reference `PullRowSparseImpl`,
+        `kvstore_dist.h:271`): result has the full logical shape with the
+        deduplicated requested rows filled, everything else zero."""
         from ..ndarray import NDArray
 
         keys, outs = self._key_list(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(keys)
         for k, o, r in zip(keys, outs, rids):
+            self._apply_pending(k)
             val = self._store[k]
-            idx = r._data.astype(jnp.int32) if isinstance(r, NDArray) else jnp.asarray(r, jnp.int32)
-            rows = jnp.take(val, idx, axis=0)
+            ridx = r._data if isinstance(r, NDArray) else jnp.asarray(r)
+            ridx = jnp.unique(ridx.reshape(-1).astype(jnp.int32)) if ridx.size else ridx.astype(jnp.int32)
+            result = jnp.zeros_like(val)
+            if ridx.size:
+                result = result.at[ridx].set(jnp.take(val, ridx, axis=0))
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
-                t._data = rows
+                t._data = jnp.asarray(result, t.dtype)
 
     # -- control plane -------------------------------------------------------
 
@@ -188,7 +464,7 @@ class KVStoreDistTPUSync:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        self._gc.set_params(compression_params)
 
     def barrier(self):
         coll.barrier(self.mesh)
@@ -203,44 +479,16 @@ class KVStoreDistTPUSync:
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
 
-    # -- internals -----------------------------------------------------------
 
-    def _allreduce(self, arr):
-        """Sum this key's contribution over all WORKER PROCESSES, result
-        replicated (the server-side aggregation of `kvstore_dist_server.h`
-        sync mode, minus the server).
+def _key_index(k):
+    """String keys map through the SAME deterministic index as the local
+    kvstore (`kvstore._str_key_int`) so optimizer states saved under one
+    store type resume correctly under the other."""
+    if isinstance(k, int):
+        return k
+    from ..kvstore import _str_key_int
 
-        Every device on this process holds an identical copy of the local
-        grad, so mean-over-all-devices × process_count = sum over distinct
-        process contributions — one ICI/DCN AllReduce, no ZMQ.
-        """
-        arr = jnp.asarray(arr)
-        n_proc = self.num_workers
-        if n_proc == 1:
-            return arr
-        # conversion and reduction must agree on one (flattened) mesh: a
-        # multi-axis self.mesh would shard the stacked dim on axis 0 only
-        # while the reduce runs over a different mesh
-        mesh, axis = coll._flat_collective_mesh(self.mesh)
-        from jax.experimental import multihost_utils
-        local = np.stack([np.asarray(arr)] * jax.local_device_count())
-        global_arr = multihost_utils.host_local_array_to_global_array(
-            local, mesh, P(axis))
-        reduced = coll.eager_all_reduce(global_arr, axis=axis, op="mean", mesh=mesh)
-        # result is replicated per device along the stacked axis; local
-        # shard 0 is addressable on every process
-        local_out = [s.data for s in reduced.addressable_shards][0]
-        return jnp.asarray(local_out[0] if local_out.ndim == arr.ndim + 1 else local_out) * n_proc
-
-
-def _stable_key_index(key):
-    """Deterministic int index for a string key — identical across worker
-    processes and restarts (Python's str hash is salted per process, which
-    would break idx2name-keyed lr/wd multipliers and optimizer-state
-    save/load)."""
-    import zlib
-
-    return zlib.crc32(str(key).encode("utf-8")) & 0x3FFFFFFF
+    return _str_key_int(k)
 
 
 def _local_sum(arrs):

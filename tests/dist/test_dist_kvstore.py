@@ -1,0 +1,357 @@
+"""Distributed kvstore correctness harness — run under the launcher:
+
+    python tools/launch.py -n 4 python tests/dist/test_dist_kvstore.py
+
+Ports the reference's nightly invariants (`tests/nightly/dist_sync_kvstore.py:36-44`):
+push/pull math across shapes including a key above the big-array bound,
+row_sparse pushes/pulls (incl. empty and random-subset), fp16 keys,
+2-bit gradient compression (residual semantics + the reference's own
+expected-value simulation, `tests/nightly/test_kvstore.py:33`), init-key
+broadcast, invalid usage, and gluon Trainer convergence vs a single-process
+numpy simulation.
+
+Every worker runs the whole file; collectives require all workers to make
+the same calls in the same order (SPMD contract).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+shape = (2, 3)
+irregular_shape = (1211, 1211)
+big_shape = (1200, 1200)  # above MXNET_KVSTORE_BIGARRAY_BOUND
+
+keys_shape = ["3", "5", "7"]
+keys_big_shape = ["99"]
+fp16_keys_shape = ["4", "6", "8"]
+fp16_keys_big_shape = ["100"]
+rsp_keys_shape = ["9", "11", "13"]
+rsp_keys_big_shape = ["97"]
+
+keys_shapes = [(k, shape) for k in keys_shape] + [(k, big_shape) for k in keys_big_shape]
+fp16_keys_shapes = ([(k, shape) for k in fp16_keys_shape]
+                    + [(k, big_shape) for k in fp16_keys_big_shape])
+
+compr_keys_shapes = [("1000", shape), ("1200", irregular_shape), ("1300", big_shape)]
+compr_init_keys_shapes = [("1001", shape), ("1201", irregular_shape), ("1301", big_shape)]
+compr_random_keys_shapes = [("1002", shape), ("1202", irregular_shape), ("1302", big_shape)]
+
+rate = 2
+nrepeat = 3
+
+kv = mx.kv.create("dist_sync")
+my_rank = kv.rank
+nworker = kv.num_workers
+
+
+def check_diff(A, x, extra=None):
+    a = A.asnumpy() if hasattr(A, "asnumpy") else np.asarray(A)
+    x = x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+    assert np.sum(np.abs(a - x)) == 0, (my_rank, extra, a, x)
+
+
+def expected_2bit_quantization(arr, curr_residual, threshold):
+    """The reference's expected-value simulation
+    (`tests/nightly/test_kvstore.py:33` compute_expected_2bit_quantization),
+    re-derived: residual folds in, values clip to {-t, 0, +t}."""
+    r = np.asarray(arr, np.float32) + curr_residual
+    decompr = np.zeros_like(r)
+    new_residual = r.copy()
+    pos = r >= threshold
+    neg = r <= -threshold
+    decompr[pos] = threshold
+    decompr[neg] = -threshold
+    new_residual[pos] -= threshold
+    new_residual[neg] += threshold
+    return new_residual, decompr
+
+
+def init_kv():
+    kv.init(keys_shape, [mx.nd.ones(shape)] * len(keys_shape))
+    kv.init(keys_big_shape, [mx.nd.ones(big_shape)] * len(keys_big_shape))
+    kv.init(rsp_keys_shape, [mx.nd.ones(shape)] * len(rsp_keys_shape))
+    kv.init(rsp_keys_big_shape, [mx.nd.ones(big_shape)] * len(rsp_keys_big_shape))
+    kv.init(fp16_keys_shape, [mx.nd.ones(shape, dtype="float16")] * len(fp16_keys_shape))
+    kv.init(fp16_keys_big_shape, [mx.nd.ones(big_shape, dtype="float16")] * len(fp16_keys_big_shape))
+
+
+def test_sync_push_pull():
+    def check_default_keys(dtype):
+        ks = keys_shapes if dtype == "float32" else fp16_keys_shapes
+        for k, s in ks:
+            for i in range(nrepeat):
+                kv.push(k, mx.nd.ones(s, dtype=dtype) * (my_rank + 1))
+                num = (nworker + 1) * nworker * rate / 2 * (i + 1) + 1
+                val = mx.nd.zeros(s, dtype=dtype)
+                kv.pull(k, out=val)
+                check_diff(val, num * np.ones(s, dtype=dtype), (k, i))
+
+    def check_row_sparse_keys():
+        k = rsp_keys_shape[0]
+        v = mx.nd.zeros(shape)
+        my_row = my_rank % shape[0]
+        v[my_row] = my_rank + 1
+        for i in range(nrepeat):
+            kv.push(k, v.tostype("row_sparse"))
+            num_rows = shape[0]
+            row_ids_np = np.random.randint(num_rows, size=num_rows)
+            row_ids = mx.nd.array(row_ids_np, dtype="int64")
+            val = mx.nd.zeros(shape)
+            kv.row_sparse_pull(k, out=val, row_ids=row_ids)
+            updated_val = np.ones(shape, np.float32)
+            for rank in range(nworker):
+                row = rank % shape[0]
+                updated_val[row] += (rank + 1) * rate * (i + 1)
+            expected = np.zeros(shape, np.float32)
+            for row in row_ids_np:
+                expected[row] = updated_val[row]
+            check_diff(val, expected, (k, i))
+
+    def check_row_sparse_keys_with_zeros():
+        k1 = rsp_keys_shape[1]
+        k2 = rsp_keys_big_shape[0]
+        v = mx.nd.zeros(shape).tostype("row_sparse")
+        big_v = mx.nd.zeros(big_shape).tostype("row_sparse")
+        for _ in range(nrepeat):
+            kv.push(k1, v)
+            kv.push(k2, big_v)
+            val = mx.nd.zeros(shape)
+            big_val = mx.nd.zeros(big_shape)
+            kv.row_sparse_pull(k1, out=val, row_ids=mx.nd.arange(0, shape[0], dtype="int64"))
+            kv.row_sparse_pull(k2, out=big_val, row_ids=mx.nd.arange(0, big_shape[0], dtype="int64"))
+            check_diff(val, np.ones(shape, np.float32))
+            check_diff(big_val, np.ones(big_shape, np.float32))
+            # empty row_ids pulls nothing
+            kv.row_sparse_pull(k1, out=val, row_ids=mx.nd.array([], dtype="int64"))
+            kv.row_sparse_pull(k2, out=big_val, row_ids=mx.nd.array([], dtype="int64"))
+            check_diff(val, np.zeros(shape, np.float32))
+            check_diff(big_val, np.zeros(big_shape, np.float32))
+
+    def check_big_row_sparse_keys():
+        k = rsp_keys_big_shape[0]
+        np.random.seed(123)
+        density = 0.3
+        v = np.zeros(big_shape, np.float32)
+        idx_sample = np.random.rand(big_shape[0])
+        indices = np.argwhere(idx_sample < density).flatten()
+        update_rows = []
+        for rank in range(nworker):
+            rows, i, step = [], 0, (rank + 1) * 2
+            while i < len(indices):
+                rows.append(indices[i])
+                i += step
+            update_rows.append(np.array(rows))
+        for row in update_rows[my_rank]:
+            v[row] = my_rank + 1
+        vnd = mx.nd.array(v)
+        for i in range(nrepeat):
+            kv.push(k, vnd.tostype("row_sparse"))
+            np.random.seed(my_rank)
+            row_ids_np = np.random.randint(big_shape[0], size=big_shape[0])
+            row_ids = mx.nd.array(row_ids_np, dtype="int64")
+            val = mx.nd.zeros(big_shape)
+            kv.row_sparse_pull(k, out=val, row_ids=row_ids)
+            updated_val = np.ones(big_shape, np.float32)
+            for rank in range(nworker):
+                for row in update_rows[rank]:
+                    updated_val[row] += (rank + 1) * rate * (i + 1)
+            expected = np.zeros(big_shape, np.float32)
+            for row in row_ids_np:
+                expected[row] = updated_val[row]
+            check_diff(val, expected, (k, i))
+        np.random.seed(123 + my_rank)  # desync again
+
+    check_default_keys("float32")
+    check_default_keys("float16")
+    check_row_sparse_keys()
+    check_row_sparse_keys_with_zeros()
+    check_big_row_sparse_keys()
+    print(f"worker {my_rank} done with non-compression tests", flush=True)
+
+
+def init_kv_compressed():
+    threshold = 0.5
+    kv.set_gradient_compression({"type": "2bit", "threshold": threshold})
+    for k, s in compr_keys_shapes:
+        kv.init(k, mx.nd.zeros(s))
+    for k, s in compr_init_keys_shapes:
+        kv.init(k, mx.nd.ones(s))
+    return threshold
+
+
+def test_sync_2bit_compression(threshold):
+    def check_compr_residual():
+        for k, s in compr_keys_shapes:
+            # doesn't meet threshold → all stays in residual
+            kv.push(k, mx.nd.ones(s) * 0.4)
+            val = mx.nd.zeros(s)
+            kv.pull(k, out=val)
+            check_diff(val, np.zeros(s, np.float32))
+            # residual 0.4 + 0.1 == threshold → fires
+            kv.push(k, mx.nd.ones(s) * (threshold - 0.4))
+            val2 = mx.nd.zeros(s)
+            kv.pull(k, out=val2)
+            curval = threshold * rate * nworker
+            check_diff(val2, np.full(s, curval, np.float32))
+            # 0.2 below threshold again
+            kv.push(k, mx.nd.ones(s) * 0.2)
+            val3 = mx.nd.zeros(s)
+            kv.pull(k, out=val3)
+            check_diff(val3, np.full(s, curval, np.float32))
+            # residual 0.2 + 0.3 fires again
+            kv.push(k, mx.nd.ones(s) * (threshold - 0.2))
+            val4 = mx.nd.zeros(s)
+            kv.pull(k, out=val4)
+            curval += threshold * rate * nworker
+            check_diff(val4, np.full(s, curval, np.float32))
+            # residual is 0 now
+
+    def check_compr_ones():
+        for k, s in compr_keys_shapes:
+            val = mx.nd.zeros(s)
+            kv.pull(k, out=val)
+            curval = val.asnumpy()[(0,) * len(s)]
+            kv.push(k, mx.nd.ones(s) * threshold)
+            val2 = mx.nd.zeros(s)
+            kv.pull(k, out=val2)
+            newval = curval + rate * nworker * threshold
+            check_diff(val2, np.full(s, newval, np.float32))
+
+    def check_compr_pull_before_push():
+        for k, s in compr_keys_shapes:
+            val = mx.nd.ones(s)
+            kv.pull(k, out=val)
+            check_diff(val, np.zeros(s, np.float32))
+        for k, s in compr_init_keys_shapes:
+            # init bypasses compression
+            val = mx.nd.zeros(s)
+            kv.pull(k, out=val)
+            check_diff(val, np.ones(s, np.float32))
+
+    def check_compr_zero():
+        for k, s in compr_keys_shapes:
+            kv.push(k, mx.nd.zeros(s))
+            val = mx.nd.ones(s)
+            kv.pull(k, out=val)
+            check_diff(val, np.zeros(s, np.float32))
+
+    def check_compr_random():
+        np.random.seed(123)  # same data on every worker
+        for k, s in compr_random_keys_shapes:
+            kv.init(k, mx.nd.zeros(s))
+        for k, s in compr_random_keys_shapes:
+            curr_residual = np.zeros(s, np.float32)
+            for _ in range(nrepeat):
+                orig_val = mx.nd.zeros(s)
+                kv.pull(k, out=orig_val)
+                grad_np = np.random.rand(*s).astype(np.float32)
+                kv.push(k, mx.nd.array(grad_np))
+                val = mx.nd.zeros(s)
+                kv.pull(k, out=val)
+                diff = val.asnumpy() - orig_val.asnumpy()
+                curr_residual, decompr = expected_2bit_quantization(
+                    grad_np, curr_residual, threshold)
+                np.testing.assert_almost_equal(diff, decompr * nworker * rate,
+                                               decimal=5)
+
+    check_compr_pull_before_push()
+    check_compr_zero()
+    check_compr_residual()
+    check_compr_ones()
+    check_compr_random()
+    print(f"worker {my_rank} done with compression tests", flush=True)
+
+
+def test_sync_init():
+    keys = [str(i) for i in range(200, 220)]
+    for i, k in enumerate(keys):
+        if i % 2 == 0:
+            kv.init(k, mx.nd.ones(shape) * (i + 1))
+        else:
+            kv.init(k, mx.nd.ones(shape, dtype="float16") * (i + 1))
+    for i, k in enumerate(keys):
+        dtype = "float32" if i % 2 == 0 else "float16"
+        out = mx.nd.zeros(shape, dtype=dtype)
+        kv.pull(k, out=out)
+        check_diff(out, np.ones(shape, dtype) * (i + 1), k)
+    print(f"worker {my_rank} done with init tests", flush=True)
+
+
+def test_invalid_operations():
+    try:
+        kv.push("never_inited", mx.nd.ones(shape))
+        raise AssertionError("push of uninitialized key must raise")
+    except MXNetError:
+        pass
+    try:
+        kv.init(keys_shape[0], mx.nd.ones(shape))
+        raise AssertionError("double init must raise")
+    except MXNetError:
+        pass
+    try:
+        mx.kv.create("dist_async")
+        raise AssertionError("dist_async must raise on the TPU build")
+    except MXNetError:
+        pass
+    print(f"worker {my_rank} done with invalid-usage tests", flush=True)
+
+
+def test_gluon_trainer():
+    """n-worker Trainer must match a numpy sim of the same updates
+    (grads are summed over workers; every worker sees identical weights)."""
+    import mxnet_tpu.gluon as gluon
+
+    np.random.seed(7)
+    w0 = np.random.rand(3, 4).astype(np.float32)
+    x_all = np.random.rand(nworker, 8, 4).astype(np.float32)
+    y_all = np.random.rand(nworker, 8, 3).astype(np.float32)
+
+    net = gluon.nn.Dense(3, use_bias=False, in_units=4)
+    net.initialize()
+    net.weight.set_data(mx.nd.array(w0))
+    lr = 0.05
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "rescale_grad": 1.0 / (8 * nworker)},
+                            kvstore="dist_sync")
+    from mxnet_tpu import autograd
+
+    w_np = w0.copy()
+    for step in range(4):
+        x = mx.nd.array(x_all[my_rank])
+        y = mx.nd.array(y_all[my_rank])
+        with autograd.record():
+            out = net(x)
+            loss = ((out - y) ** 2).sum()
+        loss.backward()
+        trainer.step(1)
+        # numpy sim: summed grads over all workers
+        g = np.zeros_like(w_np)
+        for r in range(nworker):
+            xr, yr = x_all[r], y_all[r]
+            err = xr @ w_np.T - yr
+            g += 2 * err.T @ xr
+        w_np -= lr * g / (8 * nworker)
+    got = net.weight.data().asnumpy()
+    np.testing.assert_allclose(got, w_np, rtol=2e-4, atol=2e-5)
+    print(f"worker {my_rank} done with gluon trainer test", flush=True)
+
+
+if __name__ == "__main__":
+    assert nworker == int(os.environ.get("MXNET_NUM_PROCESSES", "1")), \
+        (nworker, os.environ.get("MXNET_NUM_PROCESSES"))
+    init_kv()
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=rate))
+    test_sync_push_pull()
+    test_sync_init()
+    test_invalid_operations()
+    threshold = init_kv_compressed()
+    test_sync_2bit_compression(threshold)
+    test_gluon_trainer()
+    kv.barrier()
+    print(f"worker {my_rank}: ALL DIST KVSTORE TESTS PASSED", flush=True)

@@ -1,0 +1,34 @@
+"""Multi-process distributed kvstore launch test.
+
+The reference exercises `dist_sync` with `tools/launch.py -n 7 --launcher
+local tests/nightly/dist_sync_kvstore.py` in CI
+(`ci/docker/runtime_functions.sh:1099-1106`). Here `tools/launch.py` spawns
+4 real worker processes that rendezvous over jax.distributed (CPU backend,
+gloo collectives) and run the full ported invariant suite in
+`tests/dist/test_dist_kvstore.py`.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+@pytest.mark.slow
+def test_launch_4proc_dist_kvstore():
+    env = dict(os.environ)
+    # workers choose their own platform (cpu) via MXNET_DIST_PLATFORM; the
+    # suite's XLA_FLAGS virtual-device count must not leak into them (it
+    # would give each worker 8 local devices and n_dev=32)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"), "-n", "4",
+         "--timeout", "900",
+         sys.executable, os.path.join(REPO, "tests", "dist", "test_dist_kvstore.py")],
+        env=env, cwd=REPO, capture_output=True, timeout=960)
+    out = proc.stdout.decode(errors="replace")
+    assert proc.returncode == 0, f"launcher failed rc={proc.returncode}\n{out[-8000:]}"
+    for rank in range(4):
+        assert f"worker {rank}: ALL DIST KVSTORE TESTS PASSED" in out, out[-8000:]

@@ -49,10 +49,14 @@ def test_row_sparse_pull():
     kv = kvstore.create("local")
     w = np.random.rand(6, 4).astype("float32")
     kv.init("emb", mx.nd.array(w))
-    out = mx.nd.zeros((3, 4))
-    rid = mx.nd.array(np.array([0, 2, 5], dtype="float32"))
+    # reference PullRowSparseImpl contract: full logical shape, requested
+    # rows (deduplicated) filled, other rows zero
+    out = mx.nd.zeros((6, 4))
+    rid = mx.nd.array(np.array([0, 2, 5, 2], dtype="int64"))
     kv.row_sparse_pull("emb", out=out, row_ids=rid)
-    assert np.allclose(out.asnumpy(), w[[0, 2, 5]])
+    expected = np.zeros_like(w)
+    expected[[0, 2, 5]] = w[[0, 2, 5]]
+    assert np.allclose(out.asnumpy(), expected)
 
 
 def test_dist_async_rejected():
