@@ -114,6 +114,47 @@ def predict_mode():
 # ---------------------------------------------------------------------------
 
 
+class _RowSparseCT:
+    """A row-sparse cotangent flowing through the tape: (indices, rows) of a
+    logically-dense grad. The reference expresses this as a row_sparse
+    NDArray chosen by FInferStorageType (`include/mxnet/op_attr_types.h`
+    FInferStorageType; Embedding's sparse grad `indexing_op.cc`); here it is
+    the tape value type, deduplicated lazily at deposit time so chained
+    accumulations stay O(touched rows)."""
+
+    __slots__ = ("indices", "data", "shape", "dtype")
+
+    def __init__(self, indices, data, shape, dtype):
+        self.indices = indices      # int32 (k,)
+        self.data = data            # (k, *shape[1:])
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def __add__(self, other):
+        if other is None or (isinstance(other, int) and other == 0):
+            return self
+        if isinstance(other, _RowSparseCT):
+            return _RowSparseCT(jnp.concatenate([self.indices, other.indices]),
+                                jnp.concatenate([self.data, other.data]),
+                                self.shape, self.dtype)
+        return self.densify() + other
+
+    __radd__ = __add__
+
+    def densify(self):
+        out = jnp.zeros(self.shape, self.dtype)
+        if self.indices.size:
+            out = out.at[self.indices].add(self.data)
+        return out
+
+    def dedup(self):
+        """(unique_rows, summed_data) — the canonical row_sparse form."""
+        uniq, inv = jnp.unique(self.indices, return_inverse=True)
+        summed = jax.ops.segment_sum(self.data, inv.reshape(-1),
+                                     num_segments=uniq.shape[0])
+        return uniq, summed
+
+
 class _TapeNode:
     __slots__ = ("vjp", "inputs", "outputs", "out_avals")
 
@@ -169,7 +210,10 @@ def _run_backward(heads, head_grads, retain_graph, deposit=True):
         cts = []
         for i, aval in enumerate(node.out_avals):
             if i < len(node.outputs) and id(node.outputs[i]) in grad_map:
-                cts.append(jnp.asarray(grad_map[id(node.outputs[i])], aval.dtype))
+                g = grad_map[id(node.outputs[i])]
+                if isinstance(g, _RowSparseCT):
+                    g = g.densify()  # a pullback consumes dense cotangents
+                cts.append(jnp.asarray(g, aval.dtype))
             else:
                 cts.append(_zero_ct(aval))
         cts = tuple(cts) if len(node.out_avals) > 1 else cts[0]
@@ -201,15 +245,35 @@ def _run_backward(heads, head_grads, retain_graph, deposit=True):
 
 
 def _deposit(nd_in, grad_map):
+    from .ndarray.ndarray import NDArray
+    from .ndarray.sparse import RowSparseNDArray
+
     if nd_in is None or not getattr(nd_in, "_ag_marked", False):
         return
     g = grad_map.get(id(nd_in))
     if g is None or nd_in.grad is None:
         return
-    if nd_in.grad_req == "write":
-        nd_in.grad._data = jnp.asarray(g, nd_in.grad.dtype)
-    elif nd_in.grad_req == "add":
-        nd_in.grad._data = nd_in.grad._data + jnp.asarray(g, nd_in.grad.dtype)
+    if isinstance(g, _RowSparseCT) and isinstance(nd_in.grad, RowSparseNDArray):
+        # sparse cotangent into a row_sparse grad buffer: never densify
+        uniq, summed = g.dedup()
+        if nd_in.grad_req == "add" and nd_in.grad.indices.size:
+            old = nd_in.grad
+            cat = _RowSparseCT(
+                jnp.concatenate([old.indices._data.astype(jnp.int32), uniq]),
+                jnp.concatenate([old.data._data, summed.astype(old.data.dtype)]),
+                g.shape, g.dtype)
+            uniq, summed = cat.dedup()
+        nd_in.grad._aux = {"data": NDArray(summed.astype(nd_in.grad.dtype)),
+                           "indices": NDArray(uniq.astype(jnp.int32))}
+        nd_in.grad._dense_cache = None
+        nd_in.grad._aux_stale = False
+    else:
+        if isinstance(g, _RowSparseCT):
+            g = g.densify()
+        if nd_in.grad_req == "write":
+            nd_in.grad._data = jnp.asarray(g, nd_in.grad.dtype)
+        elif nd_in.grad_req == "add":
+            nd_in.grad._data = nd_in.grad._data + jnp.asarray(g, nd_in.grad.dtype)
     nd_in._fresh_grad = True  # cleared by Trainer._update (stale-grad check)
     grad_map[id(nd_in)] = None  # only deposit once
 
@@ -257,6 +321,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         if g is None:
             raise MXNetError("Cannot differentiate with respect to a variable the heads "
                              "do not depend on")
+        if isinstance(g, _RowSparseCT):
+            g = g.densify()
         outs.append(NDArray(jnp.asarray(g, v.dtype), v._ctx))
     if not retain_graph:
         _clear_tape()

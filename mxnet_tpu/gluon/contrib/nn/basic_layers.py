@@ -51,10 +51,10 @@ class Identity(HybridBlock):
 
 class SparseEmbedding(Block):
     """Embedding whose gradient is row_sparse (parity contrib
-    basic_layers.py:118). On TPU the gradient is dense (XLA scatter-add);
-    the class exists for API parity and still stores weight with
-    `grad_stype='row_sparse'` metadata so Trainer selects the sparse
-    update path."""
+    basic_layers.py:118). Backward emits a `RowSparseNDArray` of only the
+    touched rows (`ops/indexing.py _embedding_sparse_vjp`); the optimizer's
+    sparse branch then updates those rows in place — a lookup into a 1M-row
+    table costs O(batch) in backward+update, never O(table)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, **kwargs):

@@ -172,7 +172,16 @@ class Parameter:
         from .. import autograd
         self._grad = {}
         for k, arr in self._data.items():
-            g = nd.zeros(arr.shape, dtype=arr.dtype, ctx=arr.context)
+            if self._grad_stype == "row_sparse":
+                from ..ndarray.sparse import RowSparseNDArray
+                import jax.numpy as jnp
+
+                g = RowSparseNDArray(
+                    nd.NDArray(jnp.zeros((0,) + tuple(arr.shape[1:]), arr.dtype)),
+                    nd.NDArray(jnp.zeros((0,), jnp.int32)),
+                    tuple(arr.shape), arr.context)
+            else:
+                g = nd.zeros(arr.shape, dtype=arr.dtype, ctx=arr.context)
             self._grad[k] = g
             autograd.mark_variables(arr, g, self._grad_req)
 
@@ -298,8 +307,53 @@ class Parameter:
         return self._var
 
     def row_sparse_data(self, row_id):
-        raise NotImplementedError("row_sparse parameters: dense TPU path stores dense "
-                                  "embeddings; use data()")
+        """Rows of this parameter selected by ``row_id`` as a
+        RowSparseNDArray (parity `gluon/parameter.py row_sparse_data`).
+
+        The reference requires `stype='row_sparse'` and pulls the rows from
+        the trainer's kvstore (dist servers hold the authority copy). The
+        TPU design stores the weight dense in HBM (gathers are XLA-native);
+        when a dist trainer is attached the rows are refreshed through
+        `kvstore.row_sparse_pull` first, then gathered — only O(rows)
+        touches the host/wire, never the full table."""
+        from ..base import MXNetError
+        from ..ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+
+        if self._stype != "row_sparse" and self._grad_stype != "row_sparse":
+            raise MXNetError(
+                f"Parameter '{self.name}' is not sparse (stype={self._stype}, "
+                f"grad_stype={self._grad_stype}); use data() instead")
+        if not isinstance(row_id, NDArray):
+            row_id = nd.array(row_id, dtype="int64")
+        trainer = getattr(self, "_trainer", None)
+        if trainer is not None and getattr(trainer, "_kvstore", None) is not None \
+                and "dist" in trainer._kvstore.type:
+            trainer._row_sparse_pull(self, row_id)
+        arr = self._check_and_get(self._data, None)
+        return self._gather_rows(arr, row_id)
+
+    @staticmethod
+    def _gather_rows(arr, row_id):
+        from ..ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+
+        uniq = jnp.unique(row_id._data.reshape(-1).astype(jnp.int32)) \
+            if row_id.size else jnp.zeros((0,), jnp.int32)
+        rows = jnp.take(arr._data, uniq, axis=0) if uniq.size else \
+            jnp.zeros((0,) + tuple(arr.shape[1:]), arr.dtype)
+        return RowSparseNDArray(NDArray(rows), NDArray(uniq), tuple(arr.shape),
+                                arr.context)
+
+    def list_row_sparse_data(self, row_id):
+        """One RowSparseNDArray per context, aligned with list_ctx()
+        (parity gluon/parameter.py list_row_sparse_data)."""
+        trainer = getattr(self, "_trainer", None)
+        if trainer is not None and getattr(trainer, "_kvstore", None) is not None \
+                and "dist" in trainer._kvstore.type:
+            trainer._row_sparse_pull(self, row_id)
+        arrs = self._check_and_get(self._data, list)
+        return [self._gather_rows(a, row_id) for a in arrs]
 
 
 class Constant(Parameter):

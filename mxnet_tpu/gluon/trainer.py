@@ -100,6 +100,11 @@ class Trainer:
         if self._distributed:
             # allreduce-over-ICI has no server; update locally after sync
             update_on_kvstore = False
+        if any(p._grad_stype == "row_sparse" for p in self._params):
+            # sparse grads aggregate through the sparse merge path and update
+            # locally (reference trainer.py:169: update_on_kvstore=False when
+            # grads are sparse but weights dense)
+            update_on_kvstore = False
         if config["update_on_kvstore"] is not None:
             update_on_kvstore = config["update_on_kvstore"]
         if kvstore:
@@ -184,11 +189,48 @@ class Trainer:
         if not self._kvstore:
             return
         for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.list_grad(), priority=-i)
-                if not self._update_on_kvstore:
-                    self._kvstore.pull(i, param.list_grad(), priority=-i,
-                                       ignore_sparse=self._distributed)
+            if param.grad_req == "null":
+                continue
+            if param._grad_stype == "row_sparse":
+                # row_sparse grads never ride the dense push/pull (which
+                # would densify the table): merge sparse pieces directly
+                self._allreduce_sparse_grads(i, param)
+                continue
+            self._kvstore.push(i, param.list_grad(), priority=-i)
+            if not self._update_on_kvstore:
+                self._kvstore.pull(i, param.list_grad(), priority=-i,
+                                   ignore_sparse=self._distributed)
+
+    def _allreduce_sparse_grads(self, i, param):
+        """Aggregate row_sparse grads across device replicas (and worker
+        processes for dist) while staying O(touched rows) — the role of the
+        reference's row_sparse CommCPU reduce (`comm.h` ReduceRowSparse) +
+        ps-lite row_sparse push (`kvstore_dist.h:676`)."""
+        import jax.numpy as jnp
+        from .. import autograd
+        from ..ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
+
+        grads = [g for g in param.list_grad() if isinstance(g, RowSparseNDArray)]
+        if not grads:
+            return
+        idx = jnp.concatenate([g.indices._data.astype(jnp.int32) for g in grads])
+        data = jnp.concatenate([g.data._data for g in grads])
+        if self._distributed:
+            # one padded all-gather of the occupied rows over the workers
+            merged_local = RowSparseNDArray(
+                NDArray(data), NDArray(idx), tuple(grads[0].shape))
+            self._kvstore.push(i, merged_local, priority=-i)
+            uniq, summed = self._kvstore.pull_sparse_grad(i)
+        else:
+            ct = autograd._RowSparseCT(idx, data, tuple(grads[0].shape),
+                                       grads[0].dtype)
+            uniq, summed = ct.dedup()
+        for g in grads:
+            g._aux = {"data": NDArray(jnp.asarray(summed, g.dtype)),
+                      "indices": NDArray(uniq)}
+            g._dense_cache = None
+            g._aux_stale = False
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Update parameters WITHOUT allreduce — second half of the split
@@ -237,6 +279,35 @@ class Trainer:
                 if upd:
                     i, g, w = zip(*upd)
                     updater(list(i), list(g), list(w))
+
+    def _row_sparse_pull(self, parameter, row_id, full_idx=False):
+        """Refresh the requested rows of a sparse parameter from the kvstore
+        (parity trainer.py:289 `_row_sparse_pull`).
+
+        Only meaningful when the optimizer runs ON the kvstore (the store
+        then holds the authority copy, like the reference's servers); with
+        local updates — the TPU dist default — every worker's weight is
+        already authoritative and this is a no-op."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._kvstore is None or not self._update_on_kvstore:
+            return
+        import jax.numpy as jnp
+        from ..ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
+
+        idx = self._param2idx[parameter.name]
+        w = parameter._check_and_get(parameter._data, None)
+        # a row_sparse out makes the store hand back only (indices, rows)
+        tmp = RowSparseNDArray(
+            NDArray(jnp.zeros((0,) + tuple(w.shape[1:]), w.dtype)),
+            NDArray(jnp.zeros((0,), jnp.int32)), tuple(w.shape))
+        self._kvstore.row_sparse_pull(idx, out=tmp, row_ids=row_id, priority=-idx)
+        rows = tmp.indices._data.astype(jnp.int32)
+        if rows.size:
+            w._data = w._data.at[rows].set(tmp.data._data.astype(w.dtype))
 
     def save_states(self, fname):
         """Save optimizer (updater) states (parity trainer.py:419)."""

@@ -175,14 +175,13 @@ class KVStoreLocal(KVStoreBase):
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(out)
         key_list = [key] if isinstance(key, (str, int)) else key
+        from .parallel.dist import _fill_rows
+
         for k, o, rid in zip(key_list * len(out), out, row_ids):
             src = self._store[k]
             ridx = rid._data.reshape(-1).astype(jnp.int32)
-            result = jnp.zeros(src.shape, src.dtype)
-            if ridx.size:
-                uniq = jnp.unique(ridx)
-                result = result.at[uniq].set(jnp.take(src._data, uniq, axis=0))
-            o._data = jnp.asarray(result, o.dtype)
+            uniq = jnp.unique(ridx) if ridx.size else jnp.zeros((0,), jnp.int32)
+            _fill_rows(o, src._data, uniq)
 
 
 def _str_key_int(k):

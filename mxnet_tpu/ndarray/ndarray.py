@@ -150,8 +150,18 @@ class NDArray:
     # -- autograd -----------------------------------------------------------
 
     def attach_grad(self, grad_req="write", stype=None):
-        """Allocate gradient buffer (parity `ndarray.py attach_grad`)."""
-        self.grad = NDArray(jnp.zeros(self.shape, self.dtype), self._ctx)
+        """Allocate gradient buffer (parity `ndarray.py attach_grad`).
+        ``stype='row_sparse'`` allocates a row-sparse buffer: backward then
+        deposits only the touched rows (never the dense table)."""
+        if stype == "row_sparse":
+            from .sparse import RowSparseNDArray
+
+            self.grad = RowSparseNDArray(
+                NDArray(jnp.zeros((0,) + tuple(self.shape[1:]), self.dtype)),
+                NDArray(jnp.zeros((0,), jnp.int32)),
+                tuple(self.shape), self._ctx)
+        else:
+            self.grad = NDArray(jnp.zeros(self.shape, self.dtype), self._ctx)
         self.grad_req = grad_req
         self._ag_marked = True
 

@@ -111,7 +111,7 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __init__(self, data, indices, shape, ctx=None):
         data = _as_nd(data)
-        indices = _as_nd(indices, jnp.int64)
+        indices = _as_nd(indices, jnp.int32)
         super().__init__({"data": data, "indices": indices}, shape,
                          data.dtype, ctx, "row_sparse")
 
@@ -135,7 +135,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         nz = jnp.any((dense != 0).reshape(dense.shape[0], -1), axis=1)
         idx = jnp.nonzero(nz)[0]
         self._aux = {"data": NDArray(jnp.take(dense, idx, axis=0)),
-                     "indices": NDArray(idx.astype(jnp.int64))}
+                     "indices": NDArray(idx.astype(jnp.int32))}
 
     def tostype(self, stype):
         if stype == "row_sparse":
@@ -143,6 +143,24 @@ class RowSparseNDArray(BaseSparseNDArray):
         if stype == "default":
             return NDArray(self._data, self._ctx)
         raise MXNetError(f"cast_storage from row_sparse to {stype} not supported")
+
+    def __setitem__(self, key, value):
+        # `g[:] = 0` (Parameter.zero_grad) must stay O(rows): reset the
+        # sparse components instead of materializing a dense zeros(table)
+        if isinstance(key, slice) and key == slice(None) and \
+                _np.isscalar(value) and value == 0:
+            self._aux = {"data": NDArray(jnp.zeros((0,) + self._shape_meta[1:],
+                                                   self._dtype_meta)),
+                         "indices": NDArray(jnp.zeros((0,), jnp.int32))}
+            self._dense_cache = None
+            self._aux_stale = False
+            return
+        super().__setitem__(key, value)
+
+    def astype(self, dtype, copy=True):
+        """Stays row_sparse (the reference's Cast keeps storage type)."""
+        return RowSparseNDArray(self.data.astype(dtype), self.indices.copy(),
+                                self.shape, self._ctx)
 
     def __repr__(self):
         return f"\n<RowSparseNDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
@@ -165,8 +183,8 @@ class CSRNDArray(BaseSparseNDArray):
 
     def __init__(self, data, indices, indptr, shape, ctx=None):
         data = _as_nd(data)
-        indices = _as_nd(indices, jnp.int64)
-        indptr = _as_nd(indptr, jnp.int64)
+        indices = _as_nd(indices, jnp.int32)
+        indptr = _as_nd(indptr, jnp.int32)
         super().__init__({"data": data, "indices": indices, "indptr": indptr},
                          shape, data.dtype, ctx, "csr")
 
@@ -225,7 +243,7 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2 and not isinstance(arg1[0], int):
         data, indices = arg1
         return RowSparseNDArray(_dense_array(data, dtype=dtype),
-                                _dense_array(indices, dtype="int64"),
+                                _dense_array(indices, dtype="int32"),
                                 shape, ctx)
     dense = _dense_array(arg1, ctx=ctx, dtype=dtype) \
         if not isinstance(arg1, NDArray) else arg1
@@ -236,8 +254,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         return CSRNDArray(_dense_array(data, dtype=dtype),
-                          _dense_array(indices, dtype="int64"),
-                          _dense_array(indptr, dtype="int64"), shape, ctx)
+                          _dense_array(indices, dtype="int32"),
+                          _dense_array(indptr, dtype="int32"), shape, ctx)
     dense = _dense_array(arg1, ctx=ctx, dtype=dtype) \
         if not isinstance(arg1, NDArray) else arg1
     return cast_storage(dense, "csr")
@@ -247,12 +265,12 @@ def zeros(stype, shape, ctx=None, dtype=None):
     dt = np_dtype(dtype)
     if stype == "row_sparse":
         return RowSparseNDArray(NDArray(jnp.zeros((0,) + tuple(shape[1:]), dt)),
-                                NDArray(jnp.zeros((0,), jnp.int64)),
+                                NDArray(jnp.zeros((0,), jnp.int32)),
                                 tuple(shape), ctx)
     if stype == "csr":
         return CSRNDArray(NDArray(jnp.zeros((0,), dt)),
-                          NDArray(jnp.zeros((0,), jnp.int64)),
-                          NDArray(jnp.zeros((shape[0] + 1,), jnp.int64)),
+                          NDArray(jnp.zeros((0,), jnp.int32)),
+                          NDArray(jnp.zeros((shape[0] + 1,), jnp.int32)),
                           tuple(shape), ctx)
     return _dense_zeros(shape, ctx=ctx, dtype=dtype)
 
@@ -275,7 +293,7 @@ def cast_storage(arr, stype):
         nz = jnp.any((dense != 0).reshape(dense.shape[0], -1), axis=1)
         idx = jnp.nonzero(nz)[0]
         return RowSparseNDArray(NDArray(jnp.take(dense, idx, axis=0)),
-                                NDArray(idx.astype(jnp.int64)),
+                                NDArray(idx.astype(jnp.int32)),
                                 dense.shape, arr._ctx)
     if stype == "csr":
         d = _np.asarray(dense)
@@ -297,7 +315,7 @@ def retain(arr, indices):
     if not isinstance(arr, RowSparseNDArray):
         raise MXNetError("retain expects a RowSparseNDArray")
     idx = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
-    idx = idx.astype(jnp.int64)
+    idx = idx.astype(jnp.int32)
     keep = jnp.isin(arr.indices._data, idx)
     kept = jnp.nonzero(keep)[0]
     return RowSparseNDArray(
@@ -318,7 +336,7 @@ def add(lhs, rhs):
     out = jnp.zeros((union.shape[0],) + lhs.shape[1:], lhs.data._data.dtype)
     out = out.at[pos_l].add(lhs.data._data)
     out = out.at[pos_r].add(rhs.data._data)
-    return RowSparseNDArray(NDArray(out), NDArray(union.astype(jnp.int64)),
+    return RowSparseNDArray(NDArray(out), NDArray(union.astype(jnp.int32)),
                             lhs.shape, lhs._ctx)
 
 

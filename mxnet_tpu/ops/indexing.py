@@ -24,7 +24,31 @@ def _take(a, indices, axis=0, mode="clip", **kw):
     return jnp.take(a, idx, axis=int(axis))
 
 
-@register("Embedding")
+def _embedding_sparse_vjp(arrays, attrs):
+    """sparse_grad=True: backward emits a row-sparse weight cotangent —
+    (touched indices, per-row cotangent slices) — instead of scatter-adding
+    into a dense zeros(weight.shape). The reference dispatches this via
+    FInferStorageType on `indexing_op.cc` Embedding (grad stype row_sparse);
+    here the tape carries `autograd._RowSparseCT` so a 1M-row table's
+    gradient costs O(batch), not O(table)."""
+    from ._utils import parse_bool
+
+    if not parse_bool(attrs.get("sparse_grad", False)):
+        return None
+    data, weight = arrays[0], arrays[1]
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1).reshape(-1)
+    w_shape, w_dtype = tuple(weight.shape), weight.dtype
+
+    def pullback(out_ct):
+        from .. import autograd
+
+        rows = out_ct.reshape(-1, w_shape[1]).astype(w_dtype)
+        return (None, autograd._RowSparseCT(idx, rows, w_shape, w_dtype))
+
+    return pullback
+
+
+@register("Embedding", sparse_vjp=_embedding_sparse_vjp)
 def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False, **kw):
     """Parity: `indexing_op.cc` Embedding. One XLA gather feeding the MXU."""
     idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)

@@ -28,10 +28,10 @@ class Op:
     """A registered operator."""
 
     __slots__ = ("name", "fn", "num_outputs", "mutate_aux", "wrap_kwargs", "doc", "needs_rng",
-                 "needs_mode", "tensor_opts")
+                 "needs_mode", "tensor_opts", "sparse_vjp")
 
     def __init__(self, name, fn, num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
-                 needs_mode=False, tensor_opts=()):
+                 needs_mode=False, tensor_opts=(), sparse_vjp=None):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
@@ -56,6 +56,12 @@ class Op:
         # nd, `__opt_in__` keyword binding in symbol) so an absent earlier
         # optional cannot shift a later one into its slot.
         self.tensor_opts = tuple(tensor_opts)
+        # optional storage-type-aware pullback factory (the FInferStorageType
+        # role, `include/mxnet/op_attr_types.h`): called (arrays, attrs) at
+        # record time; returning a pullback makes backward emit row_sparse
+        # cotangents for this op instead of dense ones; returning None keeps
+        # the dense jax.vjp path.
+        self.sparse_vjp = sparse_vjp
         self.doc = fn.__doc__
 
     def n_out(self, attrs):
@@ -68,12 +74,13 @@ class Op:
 
 
 def register(name, aliases=(), num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
-             needs_mode=False, tensor_opts=()):
+             needs_mode=False, tensor_opts=(), sparse_vjp=None):
     """Decorator: register a jax fn as operator ``name`` (+ aliases)."""
 
     def deco(fn):
         op = Op(name, fn, num_outputs=num_outputs, mutate_aux=mutate_aux, wrap_kwargs=wrap_kwargs,
-                needs_rng=needs_rng, needs_mode=needs_mode, tensor_opts=tensor_opts)
+                needs_rng=needs_rng, needs_mode=needs_mode, tensor_opts=tensor_opts,
+                sparse_vjp=sparse_vjp)
         _OPS[name] = op
         for a in aliases:
             _OPS[a] = op

@@ -209,6 +209,56 @@ def _flatten_list(nested_list):
     return [item for sublist in nested_list for item in sublist]
 
 
+def _sparse_sgd_update(weight, grad, state, lr, wd, rescale_grad,
+                       clip_gradient, momentum):
+    """Lazy (rows-only) SGD for row_sparse grads — the reference's
+    sgd(_mom)_update with lazy_update=True on a row_sparse grad
+    (`src/operator/optimizer_op.cc` SGDUpdateRspImpl): weight, momentum and
+    wd touch ONLY the occupied rows; a 1M-row table costs O(batch) per step."""
+    import jax.numpy as jnp
+
+    rows = grad.indices._data.astype(jnp.int32)
+    if rows.size == 0:
+        return
+    g = grad.data._data.astype(weight.dtype) * rescale_grad
+    if clip_gradient:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w = weight._data
+    wr = jnp.take(w, rows, axis=0)
+    g = g + wd * wr
+    if momentum and state is not None:
+        m = state._data
+        mr = jnp.take(m, rows, axis=0) * momentum - lr * g
+        state._data = m.at[rows].set(mr)
+        weight._data = w.at[rows].set(wr + mr)
+    else:
+        weight._data = w.at[rows].set(wr - lr * g)
+
+
+def _sparse_adam_update(weight, grad, state, lr, wd, rescale_grad,
+                        clip_gradient, beta1, beta2, epsilon):
+    """Lazy (rows-only) Adam for row_sparse grads (reference
+    AdamUpdateRspImpl, `optimizer_op.cc`): mean/var state rows decay only
+    where the grad has rows."""
+    import jax.numpy as jnp
+
+    rows = grad.indices._data.astype(jnp.int32)
+    if rows.size == 0:
+        return
+    g = grad.data._data.astype(weight.dtype) * rescale_grad
+    if clip_gradient:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean, var = state
+    w = weight._data
+    wr = jnp.take(w, rows, axis=0)
+    g = g + wd * wr
+    mr = beta1 * jnp.take(mean._data, rows, axis=0) + (1 - beta1) * g
+    vr = beta2 * jnp.take(var._data, rows, axis=0) + (1 - beta2) * g * g
+    mean._data = mean._data.at[rows].set(mr)
+    var._data = var._data.at[rows].set(vr)
+    weight._data = w.at[rows].set(wr - lr * mr / (jnp.sqrt(vr) + epsilon))
+
+
 @register
 class SGD(Optimizer):
     """Stochastic gradient descent w/ momentum and multi-precision

@@ -408,7 +408,7 @@ class KVStoreDistTPUSync:
             uniq, summed = merged
             if self._updater is not None:
                 grad = RowSparseNDArray(NDArray(summed.astype(stored.dtype)),
-                                        NDArray(uniq.astype(jnp.int64)),
+                                        NDArray(uniq.astype(jnp.int32)),
                                         tuple(stored.shape))
                 w = NDArray(stored)
                 self._updater(_key_index(k), grad, w)
@@ -433,10 +433,24 @@ class KVStoreDistTPUSync:
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
 
+    def pull_sparse_grad(self, key):
+        """Hand back the merged pending row_sparse aggregate as
+        (unique_rows, summed_data) WITHOUT applying it to the stored value
+        or densifying — gluon Trainer's allreduce-then-update-locally flow
+        (the reference pulls row_sparse grads the same lazy way)."""
+        merged = self._merged_rsp(key) if key in self._pending_rsp else None
+        if merged is None:
+            val = self._store[key]
+            return (jnp.zeros((0,), jnp.int32),
+                    jnp.zeros((0,) + tuple(val.shape[1:]), val.dtype))
+        return merged
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (reference `PullRowSparseImpl`,
         `kvstore_dist.h:271`): result has the full logical shape with the
-        deduplicated requested rows filled, everything else zero."""
+        deduplicated requested rows filled, everything else zero. A
+        RowSparseNDArray ``out`` receives just (indices, rows) — O(rows),
+        no dense table is built."""
         from ..ndarray import NDArray
 
         keys, outs = self._key_list(key, out)
@@ -445,13 +459,11 @@ class KVStoreDistTPUSync:
             self._apply_pending(k)
             val = self._store[k]
             ridx = r._data if isinstance(r, NDArray) else jnp.asarray(r)
-            ridx = jnp.unique(ridx.reshape(-1).astype(jnp.int32)) if ridx.size else ridx.astype(jnp.int32)
-            result = jnp.zeros_like(val)
-            if ridx.size:
-                result = result.at[ridx].set(jnp.take(val, ridx, axis=0))
+            ridx = jnp.unique(ridx.reshape(-1).astype(jnp.int32)) if ridx.size \
+                else jnp.zeros((0,), jnp.int32)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
-                t._data = jnp.asarray(result, t.dtype)
+                _fill_rows(t, val, ridx)
 
     # -- control plane -------------------------------------------------------
 
@@ -478,6 +490,26 @@ class KVStoreDistTPUSync:
         assert self._updater is not None
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
+
+
+def _fill_rows(target, val, ridx):
+    """Write the selected rows of ``val`` into ``target``: sparse targets
+    get only (indices, rows); dense targets get the zero-padded full shape."""
+    from ..ndarray import NDArray
+    from ..ndarray.sparse import RowSparseNDArray
+
+    if isinstance(target, RowSparseNDArray):
+        rows = jnp.take(val, ridx, axis=0) if ridx.size else \
+            jnp.zeros((0,) + tuple(val.shape[1:]), val.dtype)
+        target._aux = {"data": NDArray(rows.astype(target.dtype)),
+                       "indices": NDArray(ridx)}
+        target._dense_cache = None
+        target._aux_stale = False
+        return
+    result = jnp.zeros_like(val)
+    if ridx.size:
+        result = result.at[ridx].set(jnp.take(val, ridx, axis=0))
+    target._data = jnp.asarray(result, target.dtype)
 
 
 def _key_index(k):

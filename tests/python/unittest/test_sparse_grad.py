@@ -1,0 +1,279 @@
+"""Sparse gradient end-to-end tests.
+
+Parity targets: Embedding's row_sparse gradient via FInferStorageType
+(`src/operator/tensor/indexing_op.cc`), lazy sparse optimizer updates
+(`src/operator/optimizer_op.cc` SGDUpdateRspImpl/AdamUpdateRspImpl),
+`Parameter.row_sparse_data` (`python/mxnet/gluon/parameter.py`), and the
+sparse linear-classification north-star
+(`example/sparse/linear_classification/train.py`).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+
+def test_embedding_sparse_grad_stype():
+    """The headline invariant: backward emits a row_sparse grad."""
+    w = nd.random.normal(0, 1, shape=(50, 8))
+    w.attach_grad(stype="row_sparse")
+    x = nd.array([[1, 3], [3, 7]], dtype="int32")
+    with autograd.record():
+        y = nd.Embedding(x, w, input_dim=50, output_dim=8, sparse_grad=True)
+        loss = (y * y).sum()
+    loss.backward()
+    assert isinstance(w.grad, RowSparseNDArray)
+    assert w.grad.stype == "row_sparse"
+    # only the touched rows appear, deduplicated and sorted
+    np.testing.assert_array_equal(w.grad.indices.asnumpy(), [1, 3, 7])
+    # values match the dense computation: d(sum y^2)/dw[r] = 2*sum of w[r]
+    # occurrences
+    wn = w.asnumpy()
+    expected = {1: 2 * wn[1], 3: 2 * 2 * wn[3], 7: 2 * wn[7]}
+    got = w.grad.data.asnumpy()
+    for i, row in enumerate([1, 3, 7]):
+        np.testing.assert_allclose(got[i], expected[row], rtol=1e-5)
+
+
+def test_embedding_sparse_vs_dense_grad():
+    rng = np.random.RandomState(0)
+    wdat = rng.rand(30, 5).astype(np.float32)
+    idx = rng.randint(0, 30, size=(4, 6))
+    head = rng.rand(4, 6, 5).astype(np.float32)
+
+    def run(sparse):
+        w = nd.array(wdat)
+        w.attach_grad(stype="row_sparse" if sparse else None)
+        x = nd.array(idx, dtype="int32")
+        with autograd.record():
+            y = nd.Embedding(x, w, input_dim=30, output_dim=5, sparse_grad=sparse)
+        y.backward(nd.array(head))
+        return w.grad
+
+    g_sparse = run(True)
+    g_dense = run(False)
+    np.testing.assert_allclose(g_sparse.asnumpy(), g_dense.asnumpy(), rtol=1e-5)
+
+
+def test_sparse_grad_req_add():
+    w = nd.ones((10, 3))
+    w.attach_grad(grad_req="add", stype="row_sparse")
+    for rows in ([1, 2], [2, 5]):
+        x = nd.array(rows, dtype="int32")
+        with autograd.record():
+            y = nd.Embedding(x, w, input_dim=10, output_dim=3, sparse_grad=True)
+            loss = y.sum()
+        loss.backward()
+    assert isinstance(w.grad, RowSparseNDArray)
+    np.testing.assert_array_equal(w.grad.indices.asnumpy(), [1, 2, 5])
+    np.testing.assert_allclose(w.grad.data.asnumpy(),
+                               [[1] * 3, [2] * 3, [1] * 3])
+
+
+def test_sparse_sgd_updates_only_rows():
+    """Lazy sparse SGD w/ momentum: untouched rows (weight AND momentum)
+    stay bit-identical; the full table is never densified."""
+    n, d = 1000, 16
+    rng = np.random.RandomState(1)
+    w0 = rng.rand(n, d).astype(np.float32)
+    net = gluon.contrib.nn.SparseEmbedding(n, d)
+    net.initialize()
+    net.weight.set_data(nd.array(w0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.array([3, 3, 7], dtype="int32")
+    with autograd.record():
+        y = net(x)
+        loss = y.sum()
+    loss.backward()
+    g = net.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert not g.densified(), "sparse grad was densified during backward"
+    trainer.step(1)
+    assert not g.densified(), "sparse grad was densified during update"
+    w1 = net.weight.data().asnumpy()
+    untouched = np.setdiff1d(np.arange(n), [3, 7])
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    # touched rows: w -= lr * grad (first step momentum = -lr*g)
+    np.testing.assert_allclose(w1[3], w0[3] - 0.1 * 2.0, rtol=1e-6)
+    np.testing.assert_allclose(w1[7], w0[7] - 0.1 * 1.0, rtol=1e-6)
+
+
+def test_sparse_adam_updates_only_rows():
+    n, d = 200, 4
+    w0 = np.ones((n, d), np.float32)
+    net = gluon.nn.Embedding(n, d, sparse_grad=True)
+    net.initialize()
+    net.weight.set_data(nd.array(w0))
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    x = nd.array([5], dtype="int32")
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    w1 = net.weight.data().asnumpy()
+    untouched = np.setdiff1d(np.arange(n), [5])
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert np.all(w1[5] < w0[5])  # moved against the positive grad
+
+
+def test_parameter_row_sparse_data():
+    net = gluon.nn.Embedding(20, 6, sparse_grad=True)
+    net.initialize()
+    rsp = net.weight.row_sparse_data(nd.array([2, 9, 2], dtype="int64"))
+    assert isinstance(rsp, RowSparseNDArray)
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [2, 9])
+    np.testing.assert_allclose(rsp.data.asnumpy(),
+                               net.weight.data().asnumpy()[[2, 9]])
+    dense_param = gluon.nn.Dense(3, in_units=4)
+    dense_param.initialize()
+    with pytest.raises(mx.MXNetError):
+        dense_param.weight.row_sparse_data(nd.array([0], dtype="int64"))
+
+
+def test_big_embedding_trains_without_densify():
+    """The VERDICT criterion: a large table trains with O(batch) work —
+    grad buffer never materializes its dense view."""
+    n, d = 1_000_000, 32
+    net = gluon.contrib.nn.SparseEmbedding(n, d)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        ids = rng.randint(0, n, size=(64,))
+        x = nd.array(ids, dtype="int32")
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        g = net.weight.grad()
+        assert isinstance(g, RowSparseNDArray)
+        assert g.indices.shape[0] <= 64
+        trainer.step(64)
+        assert not g.densified(), "dense view of the 1M-row grad was materialized"
+
+
+def test_sparse_linear_classification():
+    """Port of `example/sparse/linear_classification/train.py` as an
+    accuracy-threshold test: logistic regression over sparse categorical
+    features via SparseEmbedding, sparse grads end-to-end."""
+    rng = np.random.RandomState(42)
+    n_features, n_active, n_samples = 500, 8, 512
+    true_w = rng.randn(n_features).astype(np.float32)
+    X_ids = rng.randint(0, n_features, size=(n_samples, n_active)).astype(np.int32)
+    logits = true_w[X_ids].sum(axis=1)
+    y = (logits > 0).astype(np.float32)
+
+    embed = gluon.contrib.nn.SparseEmbedding(n_features, 1)
+    embed.initialize()
+    trainer = gluon.Trainer(embed.collect_params(), "adam", {"learning_rate": 0.05})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+
+    bs = 64
+    for epoch in range(12):
+        for i in range(0, n_samples, bs):
+            xb = nd.array(X_ids[i:i + bs], dtype="int32")
+            yb = nd.array(y[i:i + bs])
+            with autograd.record():
+                pred = embed(xb).sum(axis=1).reshape((-1,))
+                l = loss_fn(pred, yb).mean()
+            l.backward()
+            assert isinstance(embed.weight.grad(), RowSparseNDArray)
+            trainer.step(1)
+    pred = embed(nd.array(X_ids, dtype="int32")).sum(axis=1).reshape((-1,)).asnumpy()
+    acc = ((pred > 0) == (y > 0.5)).mean()
+    assert acc > 0.95, f"sparse linear classification accuracy {acc}"
+
+
+def test_hybridized_embedding_falls_back_dense_correct():
+    """Hybridized blocks trace one whole-graph vjp (dense); values must
+    still be correct when deposited into the row_sparse buffer."""
+    net = gluon.nn.Embedding(15, 4, sparse_grad=True)
+    net.initialize()
+    net.hybridize()
+    x = nd.array([1, 1, 4], dtype="int32")
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    g = net.weight.grad()
+    dense = g.asnumpy()
+    expected = np.zeros((15, 4), np.float32)
+    expected[1] = 2
+    expected[4] = 1
+    np.testing.assert_allclose(dense, expected)
+
+
+def test_cast_preserves_sparse_grad_buffer():
+    """Parameter.cast must not silently replace the row_sparse grad buffer
+    with a dense one (disabling the sparse update path)."""
+    net = gluon.nn.Embedding(40, 4, sparse_grad=True)
+    net.initialize()
+    net.cast("float16")
+    g = net.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert g.dtype == np.float16
+    x = nd.array([3], dtype="int32")
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert isinstance(net.weight.grad(), RowSparseNDArray)
+    np.testing.assert_array_equal(net.weight.grad().indices.asnumpy(), [3])
+
+
+def test_zero_grad_stays_sparse():
+    """zero_grad on a row_sparse grad resets the components — it must not
+    materialize a dense zeros(table)."""
+    net = gluon.contrib.nn.SparseEmbedding(5000, 8)
+    net.initialize()
+    net.weight.grad_req = "add"
+    x = nd.array([7, 9], dtype="int32")
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert net.weight.grad().indices.shape[0] == 2
+    net.collect_params().zero_grad()
+    g = net.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert not g.densified(), "zero_grad materialized the dense table"
+    assert g.indices.shape[0] == 0
+
+
+def test_multi_device_trainer_sparse_no_densify():
+    """Multi-context Trainer must aggregate row_sparse grads sparsely —
+    the kvstore dense push/pull path would densify the table."""
+    n, d = 10000, 8
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    net = gluon.contrib.nn.SparseEmbedding(n, d)
+    net.initialize(ctx=ctxs)
+    w0 = net.weight.data(ctxs[0]).asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                            kvstore="device")
+    batches = [nd.array([5], dtype="int32").as_in_context(ctxs[0]),
+               nd.array([5, 11], dtype="int32").as_in_context(ctxs[1])]
+    with autograd.record():
+        losses = [net(x).sum() for x in batches]
+    autograd.backward(losses)
+    trainer.step(1)
+    for g in net.weight.list_grad():
+        assert isinstance(g, RowSparseNDArray)
+        assert not g.densified(), "multi-device sparse grad was densified"
+        np.testing.assert_array_equal(g.indices.asnumpy(), [5, 11])
+    for c in ctxs:
+        w1 = net.weight.data(c).asnumpy()
+        untouched = np.setdiff1d(np.arange(n), [5, 11])
+        np.testing.assert_array_equal(w1[untouched], w0[untouched])
+        # row 5 got grad 1 from each replica (summed), row 11 got 1
+        np.testing.assert_allclose(w1[5], w0[5] - 0.1 * 2.0, rtol=1e-6)
+        np.testing.assert_allclose(w1[11], w0[11] - 0.1 * 1.0, rtol=1e-6)
+
+
+def test_list_row_sparse_data_per_context():
+    net = gluon.nn.Embedding(30, 4, sparse_grad=True)
+    net.initialize(ctx=[mx.cpu(0), mx.cpu(1)])
+    outs = net.weight.list_row_sparse_data(nd.array([1, 4], dtype="int32"))
+    assert len(outs) == 2
+    for o in outs:
+        assert isinstance(o, RowSparseNDArray)
+        np.testing.assert_array_equal(o.indices.asnumpy(), [1, 4])
