@@ -40,17 +40,21 @@ class _RNNLayer(HybridBlock):
 
         self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
         ng, ni, nh = self._gates, input_size, hidden_size
+        np_ = projection_size if projection_size else nh
         for i in range(num_layers):
             for j in ["l", "r"][:self._dir]:
                 self._register_param(f"{j}{i}_i2h_weight", (ng * nh, ni),
                                      i2h_weight_initializer)
-                self._register_param(f"{j}{i}_h2h_weight", (ng * nh, nh),
+                self._register_param(f"{j}{i}_h2h_weight", (ng * nh, np_),
                                      h2h_weight_initializer)
                 self._register_param(f"{j}{i}_i2h_bias", (ng * nh,),
                                      i2h_bias_initializer)
                 self._register_param(f"{j}{i}_h2h_bias", (ng * nh,),
                                      h2h_bias_initializer)
-            ni = nh * self._dir
+                if projection_size:
+                    self._register_param(f"{j}{i}_h2r_weight", (np_, nh),
+                                         h2h_weight_initializer)
+            ni = np_ * self._dir
 
     def _register_param(self, name, shape, init):
         p = self.params.get(name, shape=shape, init=init,
@@ -93,10 +97,11 @@ class _RNNLayer(HybridBlock):
     def infer_shape(self, x, *args):
         ni = x.shape[-1] if self._layout[-1] == "C" else x.shape[-1]
         ng, nh = self._gates, self._hidden_size
+        np_ = self._projection_size if self._projection_size else nh
         for i in range(self._num_layers):
             for j in ["l", "r"][:self._dir]:
                 self._reg_params[f"{j}{i}_i2h_weight"].shape = (ng * nh, ni)
-            ni = nh * self._dir
+            ni = np_ * self._dir
 
     def __call__(self, inputs, states=None, **kwargs):
         self.skip_states = states is None
@@ -134,6 +139,10 @@ class _RNNLayer(HybridBlock):
             for j in ["l", "r"][:self._dir]:
                 plist.append(params[f"{j}{i}_i2h_bias"])
                 plist.append(params[f"{j}{i}_h2h_bias"])
+        if self._projection_size:
+            for i in range(self._num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    plist.append(params[f"{j}{i}_h2r_weight"])
         flat = F._internal._rnn_param_concat(*plist, dim=0)
 
         if self._mode == "lstm":
@@ -141,6 +150,7 @@ class _RNNLayer(HybridBlock):
             out = F.RNN(inputs, flat, h0, c0, state_size=self._hidden_size,
                         num_layers=self._num_layers, mode=self._mode,
                         bidirectional=self._dir == 2, p=self._dropout,
+                        projection_size=self._projection_size,
                         state_outputs=True)
             outputs, hT, cT = out
             new_states = [hT, cT]
@@ -189,8 +199,11 @@ class LSTM(_RNNLayer):
                          h2h_bias_initializer, "lstm", projection_size, **kwargs)
 
     def state_info(self, batch_size=0):
-        return [{"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"},
+        # h state carries the projected size for LSTMP (reference
+        # rnn_layer.py LSTM.state_info with projection_size)
+        hsz = self._projection_size if self._projection_size else self._hidden_size
+        return [{"shape": (self._num_layers * self._dir, batch_size, hsz),
+                 "__layout__": "LNC"},
                 {"shape": (self._num_layers * self._dir, batch_size,
                            self._hidden_size), "__layout__": "LNC"}]
 

@@ -28,7 +28,7 @@ class Op:
     """A registered operator."""
 
     __slots__ = ("name", "fn", "num_outputs", "mutate_aux", "wrap_kwargs", "doc", "needs_rng",
-                 "needs_mode", "tensor_opts", "sparse_vjp")
+                 "needs_mode", "tensor_opts", "sparse_vjp", "_schema_cache")
 
     def __init__(self, name, fn, num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
                  needs_mode=False, tensor_opts=(), sparse_vjp=None):
@@ -62,6 +62,7 @@ class Op:
         # cotangents for this op instead of dense ones; returning None keeps
         # the dense jax.vjp path.
         self.sparse_vjp = sparse_vjp
+        self._schema_cache = None
         self.doc = fn.__doc__
 
     def n_out(self, attrs):
@@ -102,6 +103,85 @@ def get_op(name):
 
 def list_ops():
     return sorted(_OPS)
+
+
+# Keys meaningful to the dispatch/frontend layer rather than any op fn.
+_FRAMEWORK_ATTRS = frozenset({"name", "attr", "out", "ctx", "_train", "__opt_in__"})
+# Reference performance-hint params (DMLC-declared on many ops) with no TPU
+# meaning: accepted and ignored by design — they cannot change results, XLA
+# owns scheduling/workspace. Semantic params are NEVER in this set.
+_PERF_HINT_ATTRS = frozenset({"cudnn_off", "cudnn_tune", "workspace",
+                              "cudnn_algo_verbose"})
+
+
+def attr_schema(op):
+    """The op's declared parameter schema, derived from its fn signature —
+    the single source of truth (the `DMLC_DECLARE_PARAMETER` role,
+    reference `src/operator/nn/convolution-inl.h`): {name: default} for
+    every keyword (defaulted) parameter, None when the fn is fully open
+    (*args/**kwargs only, e.g. add_n)."""
+    cached = getattr(op, "_schema_cache", None)
+    if cached is not None:
+        return cached or None
+    import inspect
+
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        op._schema_cache = {}
+        return None
+    params = list(sig.parameters.values())
+    named = [p for p in params if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                                             inspect.Parameter.KEYWORD_ONLY)]
+    if op.needs_rng and named and named[0].name == "key":
+        # the PRNG key is injected by the frontend, never user-facing
+        named = named[1:]
+    if not named:
+        op._schema_cache = {}
+        return None
+    schema = {p.name: (p.default if p.default is not inspect.Parameter.empty
+                       else inspect.Parameter.empty)
+              for p in named}
+    op._schema_cache = schema
+    return schema
+
+
+def validate_attrs(op, attrs):
+    """Reject unknown keyword arguments — the reference's dmlc::Parameter
+    Init() throws on unknown/malformed kwargs; silently-ignored typos must
+    not train wrong. Called by BOTH frontends (nd + symbol)."""
+    schema = attr_schema(op)
+    if schema is None:
+        return
+    unknown = [k for k in attrs
+               if k not in schema and k not in _FRAMEWORK_ATTRS
+               and k not in _PERF_HINT_ATTRS]
+    if unknown:
+        from ..base import MXNetError
+
+        valid = ", ".join(n for n in schema if not n.startswith("_"))
+        raise MXNetError(
+            f"operator {op.name}: unknown argument(s) {sorted(unknown)}. "
+            f"Valid parameters: [{valid}]")
+
+
+def param_doc(op):
+    """Render the schema as a docstring 'Parameters' section (the role of
+    the reference's generated op docs, `python/mxnet/ndarray/register.py`)."""
+    schema = attr_schema(op)
+    if not schema:
+        return ""
+    import inspect
+
+    lines = ["", "Parameters (keyword)", "--------------------"]
+    for n, d in schema.items():
+        if n.startswith("_"):
+            continue
+        if d is inspect.Parameter.empty:
+            lines.append(f"{n} : required tensor input")
+        else:
+            lines.append(f"{n} : optional, default={d!r}")
+    return "\n".join(lines)
 
 
 def _freeze(v):

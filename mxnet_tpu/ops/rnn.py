@@ -29,38 +29,39 @@ from ._utils import parse_bool
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
 
-def _layer_param_sizes(mode, input_size, state_size, proj_size=None):
-    g = _GATES[mode]
-    return g * state_size * input_size, g * state_size * state_size, \
-        g * state_size, g * state_size
-
-
 def rnn_param_size(num_layers, state_size, input_size, mode,
-                   bidirectional=False):
-    """Total flat parameter count (the reference's GetRnnParamSize)."""
+                   bidirectional=False, projection_size=None):
+    """Total flat parameter count (the reference's GetRnnParamSize,
+    `rnn-inl.h:63-88`, incl. the LSTM-projection extension)."""
     ndir = 2 if bidirectional else 1
+    g = _GATES[mode]
+    hid = projection_size if projection_size else state_size
     total = 0
     for layer in range(num_layers):
-        isz = input_size if layer == 0 else state_size * ndir
-        wi, wh, bi, bh = _layer_param_sizes(mode, isz, state_size)
-        total += ndir * (wi + wh + bi + bh)
+        isz = input_size if layer == 0 else hid * ndir
+        total += ndir * g * state_size * (isz + hid + 2)
+    if projection_size:
+        total += projection_size * state_size * num_layers * ndir
     return total
 
 
-def _slice_params(params, num_layers, state_size, input_size, mode, ndir):
+def _slice_params(params, num_layers, state_size, input_size, mode, ndir,
+                  proj_size=None):
     """Split the flat parameter vector into per-(layer, direction) weight
     matrices and bias vectors, reference/cuDNN layout: all weights first
-    (layer-major, direction-minor), then all biases."""
+    (layer-major, direction-minor, i2h then h2h), then all biases, then —
+    for LSTM projection — all projection matrices (P, H)."""
     g = _GATES[mode]
+    hid = proj_size if proj_size else state_size
     weights = []
     off = 0
     for layer in range(num_layers):
-        isz = input_size if layer == 0 else state_size * ndir
+        isz = input_size if layer == 0 else hid * ndir
         for d in range(ndir):
             wi = params[off: off + g * state_size * isz].reshape(g * state_size, isz)
             off += g * state_size * isz
-            wh = params[off: off + g * state_size * state_size].reshape(g * state_size, state_size)
-            off += g * state_size * state_size
+            wh = params[off: off + g * state_size * hid].reshape(g * state_size, hid)
+            off += g * state_size * hid
             weights.append((wi, wh))
     biases = []
     for layer in range(num_layers):
@@ -70,52 +71,80 @@ def _slice_params(params, num_layers, state_size, input_size, mode, ndir):
             bh = params[off: off + g * state_size]
             off += g * state_size
             biases.append((bi, bh))
-    return [(w[0], w[1], b[0], b[1]) for w, b in zip(weights, biases)]
+    projs = []
+    for layer in range(num_layers * ndir):
+        if proj_size:
+            wr = params[off: off + proj_size * state_size].reshape(proj_size, state_size)
+            off += proj_size * state_size
+        else:
+            wr = None
+        projs.append(wr)
+    return [(w[0], w[1], b[0], b[1], r)
+            for w, b, r in zip(weights, biases, projs)]
 
 
-def _cell_step(mode, state_size):
-    """One time-step transition: (carry, gates_preact) -> new carry + output."""
+def _run_direction(x, h0, c0, wi, wh, bi, bh, mode, reverse=False,
+                   wproj=None, seq_len=None, clip_min=None, clip_max=None,
+                   clip_nan=False):
+    """Scan one direction of one layer. x: [T, N, I] -> [T, N, H|P].
+
+    ``seq_len`` [N] masks time steps past each sequence's length: the carry
+    freezes, padded outputs are zero, and final states come from the last
+    VALID step (cuDNN variable-length semantics, `rnn-inl.h:219`
+    use_sequence_length). Works for the reverse direction too: scanning
+    reversed time, masked leading padding leaves h0 untouched until the
+    sequence's true tail is reached. ``wproj`` is the LSTM projection
+    (P, H); ``clip_*`` clip the LSTM cell state each step
+    (cudnnRNNSetClip role, `rnn.cc` lstm_state_clip_*)."""
+    T = x.shape[0]
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    t_idx = jnp.arange(T)
+    if reverse:
+        t_idx = jnp.flip(t_idx, axis=0)
+    # hoist the input projection out of the scan: one big MXU matmul
+    xw = jnp.einsum("tni,gi->tng", x, wi) + bi + bh
+
+    def mask_of(t):
+        if seq_len is None:
+            return None
+        return (t < seq_len)[:, None]  # [N, 1]
+
+    def apply_mask(m, new, old):
+        return new if m is None else jnp.where(m, new, old)
+
+    def clip_c(c):
+        if clip_min is None and clip_max is None:
+            return c
+        if clip_nan:
+            c = jnp.nan_to_num(c, nan=0.0)
+        return jnp.clip(c, clip_min, clip_max)
+
     if mode == "lstm":
-        def step(carry, pre):
+        def body(carry, xt_t):
+            xt, t = xt_t
             h, c = carry
+            pre = xt + h @ wh.T
             i, f, g, o = jnp.split(pre, 4, axis=-1)
             i = jax.nn.sigmoid(i)
             f = jax.nn.sigmoid(f)
             g = jnp.tanh(g)
             o = jax.nn.sigmoid(o)
-            c = f * c + i * g
-            h = o * jnp.tanh(c)
-            return (h, c), h
-        return step
-    if mode == "gru":
-        raise AssertionError("gru uses custom scan body")
-    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
-
-    def step(carry, pre):
-        (h,) = carry
-        h = act(pre)
-        return (h,), h
-    return step
-
-
-def _run_direction(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
-    """Scan one direction of one layer. x: [T, N, I] -> [T, N, H]."""
-    if reverse:
-        x = jnp.flip(x, axis=0)
-    # hoist the input projection out of the scan: one big MXU matmul
-    xw = jnp.einsum("tni,gi->tng", x, wi) + bi + bh
-
-    if mode == "lstm":
-        def body(carry, xt):
-            h, c = carry
-            pre = xt + h @ wh.T
-            (h, c), out = _cell_step("lstm", None)((h, c), pre)
-            return (h, c), out
-        (hT, cT), ys = lax.scan(body, (h0, c0), xw)
+            c_new = clip_c(f * c + i * g)
+            h_new = o * jnp.tanh(c_new)
+            if wproj is not None:
+                h_new = h_new @ wproj.T
+            m = mask_of(t)
+            h_new = apply_mask(m, h_new, h)
+            c_new = apply_mask(m, c_new, c)
+            out = h_new if m is None else jnp.where(m, h_new, jnp.zeros((), h_new.dtype))
+            return (h_new, c_new), out
+        (hT, cT), ys = lax.scan(body, (h0, c0), (xw, t_idx))
     elif mode == "gru":
         H = h0.shape[-1]
 
-        def body(carry, xt):
+        def body(carry, xt_t):
+            xt, t = xt_t
             (h,) = carry
             # cuDNN GRU: r/z use summed bias form; n-gate: x-side and
             # h-side have separate biases and r gates the h-side only
@@ -123,26 +152,34 @@ def _run_direction(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
             r = jax.nn.sigmoid(xt[..., :H] + hr[..., :H])
             z = jax.nn.sigmoid(xt[..., H:2 * H] + hr[..., H:2 * H])
             n = jnp.tanh(xt[..., 2 * H:] + r * hr[..., 2 * H:])
-            h = (1 - z) * n + z * h
-            return (h,), h
+            h_new = (1 - z) * n + z * h
+            m = mask_of(t)
+            h_new = apply_mask(m, h_new, h)
+            out = h_new if m is None else jnp.where(m, h_new, jnp.zeros((), h_new.dtype))
+            return (h_new,), out
         # x-side already has bi+bh added; compensate by re-adding only bi
         xw = jnp.einsum("tni,gi->tng", x, wi) + bi
-        (hT,), ys = lax.scan(body, (h0,), xw)
+        (hT,), ys = lax.scan(body, (h0,), (xw, t_idx))
         cT = None
     else:
-        def body(carry, xt):
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def body(carry, xt_t):
+            xt, t = xt_t
             (h,) = carry
-            pre = xt + h @ wh.T
-            (h,), out = _cell_step(mode, None)((h,), pre)
-            return (h,), out
-        (hT,), ys = lax.scan(body, (h0,), xw)
+            h_new = act(xt + h @ wh.T)
+            m = mask_of(t)
+            h_new = apply_mask(m, h_new, h)
+            out = h_new if m is None else jnp.where(m, h_new, jnp.zeros((), h_new.dtype))
+            return (h_new,), out
+        (hT,), ys = lax.scan(body, (h0,), (xw, t_idx))
         cT = None
     if reverse:
         ys = jnp.flip(ys, axis=0)
     return ys, hT, cT
 
 
-@register("RNN", needs_rng=True, needs_mode=True,
+@register("RNN", needs_rng=True, needs_mode=True, tensor_opts=("sequence_length",),
           num_outputs=lambda attrs: 1 + (2 if attrs.get("mode") == "lstm" and
                                          parse_bool(attrs.get("state_outputs", False))
                                          else (1 if parse_bool(attrs.get("state_outputs", False)) else 0)))
@@ -150,13 +187,21 @@ def _rnn(key, data, parameters, state, *maybe_state_cell, state_size=None,
          num_layers=1, mode="lstm", bidirectional=False, p=0.0,
          state_outputs=False, projection_size=None, lstm_state_clip_min=None,
          lstm_state_clip_max=None, lstm_state_clip_nan=False,
-         use_sequence_length=False, _train=False, **kw):
+         use_sequence_length=False, sequence_length=None, _train=False, **kw):
     """Fused multi-layer (bi)directional RNN (reference `rnn.cc`).
 
-    data [T, N, I]; parameters: flat vector; state [L*D, N, H];
-    state_cell [L*D, N, H] for LSTM. Returns output [T, N, H*D]
-    (+ final states when state_outputs).
+    data [T, N, I]; parameters: flat vector (see `_slice_params` layout);
+    state [L*D, N, H] ([L*D, N, P] for projected LSTM); state_cell
+    [L*D, N, H] for LSTM. With ``use_sequence_length`` an extra
+    ``sequence_length`` [N] input masks padded steps (outputs zero, final
+    states from the last valid step — cuDNN semantics, `rnn-inl.h:219`).
+    ``projection_size`` enables LSTMP (`rnn-inl.h:63` GetRnnParamSize);
+    ``lstm_state_clip_min/max/nan`` clip the cell state every step
+    (cudnnRNNSetClip role). Returns output [T, N, H*D] (+ final states
+    when state_outputs).
     """
+    from ..base import MXNetError
+
     mode = str(mode)
     state_size = int(state_size)
     num_layers = int(num_layers)
@@ -164,11 +209,28 @@ def _rnn(key, data, parameters, state, *maybe_state_cell, state_size=None,
     ndir = 2 if bidir else 1
     p = float(p)
     train = parse_bool(_train)
+    proj = int(projection_size) if projection_size else None
+    clip_min = None if lstm_state_clip_min is None else float(lstm_state_clip_min)
+    clip_max = None if lstm_state_clip_max is None else float(lstm_state_clip_max)
+    if (proj or clip_min is not None or clip_max is not None) and mode != "lstm":
+        raise MXNetError("projection_size / lstm_state_clip_* are only "
+                         "supported for mode='lstm' (reference rnn-inl.h:435-442)")
+
+    maybe_state_cell = list(maybe_state_cell)
+    if parse_bool(use_sequence_length) and sequence_length is None:
+        # the extra input arrives positionally after the states
+        if not maybe_state_cell:
+            raise MXNetError("use_sequence_length=True requires a "
+                             "sequence_length input")
+        sequence_length = maybe_state_cell.pop()
+    if not parse_bool(use_sequence_length):
+        sequence_length = None
+    seq_len = None if sequence_length is None else sequence_length.astype(jnp.int32)
 
     x = data
     input_size = x.shape[-1]
     layer_params = _slice_params(parameters, num_layers, state_size,
-                                 input_size, mode, ndir)
+                                 input_size, mode, ndir, proj_size=proj)
     h0_all = state
     c0_all = maybe_state_cell[0] if maybe_state_cell else None
 
@@ -177,11 +239,14 @@ def _rnn(key, data, parameters, state, *maybe_state_cell, state_size=None,
         outs = []
         for d in range(ndir):
             idx = layer * ndir + d
-            wi, wh, bi, bh = layer_params[idx]
+            wi, wh, bi, bh, wproj = layer_params[idx]
             h0 = h0_all[idx]
             c0 = c0_all[idx] if c0_all is not None else None
             ys, hT, cT = _run_direction(x, h0, c0, wi, wh, bi, bh, mode,
-                                        reverse=(d == 1))
+                                        reverse=(d == 1), wproj=wproj,
+                                        seq_len=seq_len, clip_min=clip_min,
+                                        clip_max=clip_max,
+                                        clip_nan=parse_bool(lstm_state_clip_nan))
             outs.append(ys)
             hT_list.append(hT)
             if cT is not None:
@@ -191,9 +256,6 @@ def _rnn(key, data, parameters, state, *maybe_state_cell, state_size=None,
             mask = jax.random.bernoulli(
                 jax.random.fold_in(key, layer), 1 - p, x.shape)
             x = jnp.where(mask, x / (1 - p), jnp.zeros((), x.dtype))
-
-    if mode == "lstm" and lstm_state_clip_min is not None:
-        x = jnp.clip(x, None, None)  # clip applies to states, not outputs
 
     out = x.astype(data.dtype)
     if not parse_bool(state_outputs):

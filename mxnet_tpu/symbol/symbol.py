@@ -510,6 +510,10 @@ def _apply_op(op_name, *args, name=None, attr=None, **kwargs):
     sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
     attrs = {k: v for k, v in kwargs.items()
              if not isinstance(v, Symbol) and v is not None}
+    # unknown kwargs are errors, not silent no-ops (dmlc::Parameter Init
+    # role) — checked BEFORE merging attr=, which carries arbitrary node
+    # metadata (ctx_group, __lr_mult__, AttrScope) by contract
+    _reg.validate_attrs(op, attrs)
     if attr:
         attrs.update(attr)
 
