@@ -35,12 +35,43 @@ def _find_lib():
     return None
 
 
+def _try_build():
+    """Build librt_tpu.so from src/ if a toolchain is present (`make -C src`).
+    Failures are silent (everything has a pure-python fallback) and cached
+    via a marker file so forked workers / later processes don't each re-run
+    a doomed compile."""
+    import shutil
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(os.path.dirname(here), "src")
+    if not os.path.isdir(src) or shutil.which("make") is None:
+        return
+    marker = os.path.join(here, "_native", ".build_failed")
+    if os.path.exists(marker):
+        return
+    try:
+        subprocess.run(["make", "-C", src], capture_output=True, timeout=120)
+    except Exception:
+        pass
+    if _find_lib() is None:
+        try:
+            os.makedirs(os.path.dirname(marker), exist_ok=True)
+            with open(marker, "w") as f:
+                f.write("native build failed; delete this file to retry\n")
+        except OSError:
+            pass
+
+
 def get_lib():
     global _lib, _lib_tried
     with _lock:
         if not _lib_tried:
             _lib_tried = True
             path = _find_lib()
+            if path is None and os.environ.get("MXNET_BUILD_NATIVE", "1") == "1":
+                _try_build()
+                path = _find_lib()
             if path:
                 try:
                     _lib = ctypes.CDLL(path)
@@ -63,5 +94,27 @@ def native_engine():
         if _engine is None:
             from .native_engine import NativeEngine
 
-            _engine = NativeEngine(lib)
+            nthreads = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
+            _engine = NativeEngine(lib, num_threads=nthreads)
     return _engine
+
+
+def native_recordio(path):
+    """Native mmap RecordIO index for `path`; None if the .so isn't built."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    from .native_engine import NativeRecordIO
+
+    return NativeRecordIO(lib, path)
+
+
+def shared_memory(name, size=None, create=False):
+    """Named POSIX shm segment (CPUSharedStorageManager role); None if the
+    .so isn't built."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    from .native_engine import SharedMemoryArena
+
+    return SharedMemoryArena(lib, name, size=size, create=create)

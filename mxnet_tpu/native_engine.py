@@ -1,0 +1,214 @@
+"""ctypes bindings for the native host runtime (librt_tpu.so).
+
+Three components, mirroring the host-side slice of the reference's C++
+core (SURVEY.md §2.1):
+
+* :class:`NativeEngine` — the dependency engine (`src/engine.cc`;
+  reference `src/engine/threaded_engine.cc`): python callables pushed with
+  const/mutable variable lists run on native worker threads with reads
+  concurrent and writes exclusive+ordered per variable.
+* :class:`NativeRecordIO` — mmap'd RecordIO frame scanner
+  (`src/recordio.cc`; reference dmlc-core recordio / `src/io/`).
+* :class:`SharedMemoryArena` — named POSIX shm segments
+  (`src/arena.cc`; reference `cpu_shared_storage_manager.h`).
+"""
+from __future__ import annotations
+
+import ctypes
+import itertools
+import threading
+
+import numpy as np
+
+_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _bind(lib):
+    lib.rt_engine_create.restype = ctypes.c_void_p
+    lib.rt_engine_create.argtypes = [ctypes.c_int]
+    lib.rt_engine_destroy.argtypes = [ctypes.c_void_p]
+    lib.rt_engine_new_var.restype = ctypes.c_void_p
+    lib.rt_engine_new_var.argtypes = [ctypes.c_void_p]
+    lib.rt_engine_push.argtypes = [
+        ctypes.c_void_p, _CALLBACK, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+    lib.rt_engine_wait_all.argtypes = [ctypes.c_void_p]
+
+    lib.rt_recordio_open.restype = ctypes.c_void_p
+    lib.rt_recordio_open.argtypes = [ctypes.c_char_p]
+    lib.rt_recordio_close.argtypes = [ctypes.c_void_p]
+    lib.rt_recordio_size.restype = ctypes.c_uint64
+    lib.rt_recordio_size.argtypes = [ctypes.c_void_p]
+    lib.rt_recordio_count.restype = ctypes.c_int64
+    lib.rt_recordio_count.argtypes = [ctypes.c_void_p]
+    lib.rt_recordio_scan.restype = ctypes.c_int64
+    lib.rt_recordio_scan.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int64]
+    lib.rt_recordio_data.restype = ctypes.c_void_p
+    lib.rt_recordio_data.argtypes = [ctypes.c_void_p]
+
+    lib.rt_shm_create.restype = ctypes.c_void_p
+    lib.rt_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.rt_shm_attach.restype = ctypes.c_void_p
+    lib.rt_shm_attach.argtypes = [ctypes.c_char_p]
+    lib.rt_shm_ptr.restype = ctypes.c_void_p
+    lib.rt_shm_ptr.argtypes = [ctypes.c_void_p]
+    lib.rt_shm_size.restype = ctypes.c_uint64
+    lib.rt_shm_size.argtypes = [ctypes.c_void_p]
+    lib.rt_shm_detach.argtypes = [ctypes.c_void_p]
+    lib.rt_shm_unlink.restype = ctypes.c_int
+    lib.rt_shm_unlink.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+class NativeEngine:
+    """Host dependency engine (reference Engine::PushAsync semantics).
+
+    ONE shared CFUNCTYPE trampoline serves every op — the per-op python
+    payload travels as the integer id in the callback's void* argument.
+    A per-op closure would have to be freed eventually, and freeing a
+    libffi closure that a native thread is still returning through is a
+    use-after-free; the shared trampoline lives as long as the engine."""
+
+    def __init__(self, lib, num_threads=4):
+        self._lib = _bind(lib)
+        self._handle = self._lib.rt_engine_create(int(num_threads))
+        self._pending = {}  # op id -> (fn, args, kwargs)
+        self._ids = itertools.count(1)
+        self._mu = threading.Lock()
+
+        def trampoline(payload):
+            op_id = int(payload or 0)
+            with self._mu:
+                entry = self._pending.pop(op_id, None)
+            if entry is not None:
+                f, a, kw = entry
+                f(*a, **kw)
+
+        self._trampoline = _CALLBACK(trampoline)  # kept alive with the engine
+
+    def new_var(self):
+        """A fresh scheduling variable (engine.h NewVariable)."""
+        return self._lib.rt_engine_new_var(self._handle)
+
+    def push(self, fn, args=(), kwargs=None, const_vars=(), mutable_vars=()):
+        """Run ``fn(*args, **kwargs)`` on an engine thread once every
+        listed variable dependency clears."""
+        op_id = next(self._ids)
+        with self._mu:
+            self._pending[op_id] = (fn, args, kwargs or {})
+        carr = (ctypes.c_void_p * max(1, len(const_vars)))(*const_vars)
+        marr = (ctypes.c_void_p * max(1, len(mutable_vars)))(*mutable_vars)
+        self._lib.rt_engine_push(self._handle, self._trampoline,
+                                 ctypes.c_void_p(op_id),
+                                 carr, len(const_vars), marr, len(mutable_vars))
+        return op_id
+
+    def wait_all(self):
+        self._lib.rt_engine_wait_all(self._handle)
+
+
+class NativeRecordIO:
+    """mmap'd frame index over a RecordIO file; O(file) native scan, then
+    zero-copy `memoryview` reads per record."""
+
+    def __init__(self, lib, path):
+        self._lib = _bind(lib)
+        self._handle = self._lib.rt_recordio_open(path.encode())
+        if not self._handle:
+            raise IOError(f"cannot open recordio file {path}")
+        n = self._lib.rt_recordio_count(self._handle)
+        if n < 0:
+            self.close()
+            raise IOError(f"corrupt recordio framing in {path}")
+        offsets = (ctypes.c_uint64 * n)()
+        lengths = (ctypes.c_uint64 * n)()
+        cflags = (ctypes.c_uint32 * n)()
+        got = self._lib.rt_recordio_scan(self._handle, offsets, lengths,
+                                         cflags, n)
+        assert got == n
+        self.offsets = np.ctypeslib.as_array(offsets).copy()
+        self.lengths = np.ctypeslib.as_array(lengths).copy()
+        self.cflags = np.ctypeslib.as_array(cflags).copy()
+        size = self._lib.rt_recordio_size(self._handle)
+        base = self._lib.rt_recordio_data(self._handle)
+        self._buf = (ctypes.c_char * size).from_address(base)
+
+    def __len__(self):
+        return len(self.offsets)
+
+    def read_frame(self, i):
+        """Raw payload bytes of frame i (no split reassembly)."""
+        off, ln = int(self.offsets[i]), int(self.lengths[i])
+        return bytes(memoryview(self._buf)[off:off + ln])
+
+    def read_records(self):
+        """All LOGICAL records, reassembling split frames (dmlc-core
+        convention, same as `MXRecordIO.read`: cflag 0=whole, 1=first,
+        2=middle, 3=last)."""
+        out = []
+        parts = None
+        for i in range(len(self)):
+            c = int(self.cflags[i])
+            if c == 0:
+                out.append(self.read_frame(i))
+            elif c == 1:
+                parts = [self.read_frame(i)]
+            elif c == 2:
+                parts.append(self.read_frame(i))
+            elif c == 3:
+                parts.append(self.read_frame(i))
+                out.append(b"".join(parts))
+                parts = None
+        return out
+
+    def close(self):
+        if self._handle:
+            self._buf = None
+            self._lib.rt_recordio_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class SharedMemoryArena:
+    """Named POSIX shm segment usable as a numpy buffer across processes."""
+
+    def __init__(self, lib, name, size=None, create=False):
+        self._lib = _bind(lib)
+        self.name = name
+        if create:
+            self._handle = self._lib.rt_shm_create(name.encode(), int(size))
+        else:
+            self._handle = self._lib.rt_shm_attach(name.encode())
+        if not self._handle:
+            raise OSError(f"shm {'create' if create else 'attach'} failed: {name}")
+        self.size = self._lib.rt_shm_size(self._handle)
+        ptr = self._lib.rt_shm_ptr(self._handle)
+        self._buf = (ctypes.c_char * self.size).from_address(ptr)
+
+    def asarray(self, dtype=np.uint8, shape=None):
+        arr = np.frombuffer(self._buf, dtype=dtype)
+        return arr.reshape(shape) if shape is not None else arr
+
+    def detach(self):
+        if self._handle:
+            self._buf = None
+            self._lib.rt_shm_detach(self._handle)
+            self._handle = None
+
+    def unlink(self):
+        self._lib.rt_shm_unlink(self.name.encode())
+
+    def __del__(self):
+        try:
+            self.detach()
+        except Exception:
+            pass

@@ -127,6 +127,66 @@ class MXRecordIO:
         return data
 
 
+def read_all_records(uri):
+    """All logical records of a RecordIO file as a list of bytes.
+
+    Uses the native mmap scanner (`src/recordio.cc`) when `librt_tpu.so` is
+    built — one C pass over the file instead of a python loop per record —
+    and falls back to the python reader otherwise."""
+    from . import lib
+
+    try:
+        native = lib.native_recordio(uri)
+    except IOError:
+        native = None
+    if native is not None:
+        try:
+            return native.read_records()
+        finally:
+            native.close()
+    reader = MXRecordIO(uri, "r")
+    out = []
+    while True:
+        rec = reader.read()
+        if rec is None:
+            break
+        out.append(rec)
+    reader.close()
+    return out
+
+
+def list_record_offsets(uri):
+    """Byte offsets of every logical record's frame HEADER (what
+    MXIndexedRecordIO seeks to) — the index rec2idx builds (reference
+    `tools/rec2idx.py` IndexCreator). Native scan when available; python
+    re-scan otherwise. Returns a flat list of ints."""
+    from . import lib
+
+    try:
+        native = lib.native_recordio(uri)
+    except IOError:
+        native = None
+    if native is not None:
+        try:
+            offs = []
+            for i in range(len(native)):
+                c = int(native.cflags[i])
+                if c in (0, 1):  # whole record or first frame of a split
+                    offs.append(int(native.offsets[i]) - 8)
+            return offs
+        finally:
+            native.close()
+    reader = MXRecordIO(uri, "r")
+    offs = []
+    while True:
+        pos = reader.record.tell()
+        if reader.read() is None:
+            break
+        offs.append(pos)
+    reader.close()
+    return offs
+
+
 class MXIndexedRecordIO(MXRecordIO):
     """Random-access RecordIO via a .idx file of `key\\tposition` lines
     (parity recordio.py:160)."""

@@ -1,0 +1,77 @@
+// POSIX shared-memory arena — the CPUSharedStorageManager role
+// (reference: src/storage/cpu_shared_storage_manager.h): zero-copy transfer
+// of decoded batches between DataLoader worker processes and the trainer.
+// Workers write into a named shm segment; the parent maps the same name.
+
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Segment {
+  void* base = nullptr;
+  size_t size = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create (or replace) a named segment of `size` bytes; returns handle or null.
+void* rt_shm_create(const char* name, uint64_t size) {
+  ::shm_unlink(name);  // replace any stale segment
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  void* base = ::mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  Segment* s = new Segment{base, static_cast<size_t>(size)};
+  return s;
+}
+
+// Attach an existing named segment read-write; returns handle or null.
+void* rt_shm_attach(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = ::mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                      fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  Segment* s = new Segment{base, static_cast<size_t>(st.st_size)};
+  return s;
+}
+
+void* rt_shm_ptr(void* handle) { return static_cast<Segment*>(handle)->base; }
+
+uint64_t rt_shm_size(void* handle) {
+  return static_cast<Segment*>(handle)->size;
+}
+
+void rt_shm_detach(void* handle) {
+  Segment* s = static_cast<Segment*>(handle);
+  if (!s) return;
+  ::munmap(s->base, s->size);
+  delete s;
+}
+
+int rt_shm_unlink(const char* name) { return ::shm_unlink(name); }
+
+}  // extern "C"

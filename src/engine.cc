@@ -1,0 +1,237 @@
+// Host-side async dependency engine.
+//
+// The TPU-native scoping of the reference's threaded engine
+// (reference: src/engine/threaded_engine.cc, include/mxnet/engine.h:117):
+// on-device ordering is owned by XLA's runtime, so this engine schedules
+// HOST work only — file IO, checkpoint writes, record decoding, collective
+// issue — with the same dependency discipline: an operation declares const
+// (read) and mutable (write) variables; it runs when every dependency
+// clears; reads on a variable run concurrently, writes are exclusive and
+// ordered (the ThreadedVar pending-queue protocol, threaded_engine.h:119).
+//
+// Exposed as a flat C ABI (the c_api.cc role) consumed from Python via
+// ctypes (mxnet_tpu/native_engine.py).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace rt {
+
+typedef void (*rt_callback)(void* payload);
+
+struct Opr;
+
+// One scheduling variable (engine.h NewVariable role). Holds the pending
+// queue of operations in program order; reads coalesce, writes serialize.
+struct Var {
+  std::mutex mu;
+  // each entry: (op, is_write). Invariant: ops run in queue order except
+  // consecutive reads, which may run together.
+  std::deque<std::pair<Opr*, bool>> pending;
+  int running_reads = 0;
+  bool running_write = false;
+};
+
+struct Opr {
+  rt_callback fn;
+  void* payload;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  // number of vars that have not yet granted this op the right to run
+  std::atomic<int> wait{0};
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads) : shutdown_(false), inflight_(0) {
+    if (num_threads < 1) num_threads = 1;
+    for (int i = 0; i < num_threads; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    WaitAll();
+    {
+      std::lock_guard<std::mutex> lk(qmu_);
+      shutdown_ = true;
+    }
+    qcv_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (Var* v : all_vars_) delete v;
+  }
+
+  Var* NewVar() {
+    Var* v = new Var();
+    std::lock_guard<std::mutex> lk(vmu_);
+    all_vars_.push_back(v);
+    return v;
+  }
+
+  void Push(rt_callback fn, void* payload, Var** cvars, int n_const,
+            Var** mvars, int n_mut) {
+    Opr* op = new Opr();
+    op->fn = fn;
+    op->payload = payload;
+    op->const_vars.assign(cvars, cvars + n_const);
+    op->mutable_vars.assign(mvars, mvars + n_mut);
+    // dedup, and drop const vars that are also mutable — an op holding a
+    // read AND a write grant on the same var would deadlock it forever
+    // (the reference CHECKs this overlap, threaded_engine.cc Push)
+    auto uniq = [](std::vector<Var*>& v) {
+      std::sort(v.begin(), v.end());
+      v.erase(std::unique(v.begin(), v.end()), v.end());
+    };
+    uniq(op->const_vars);
+    uniq(op->mutable_vars);
+    {
+      std::vector<Var*> pure_const;
+      for (Var* v : op->const_vars)
+        if (!std::binary_search(op->mutable_vars.begin(),
+                                op->mutable_vars.end(), v))
+          pure_const.push_back(v);
+      op->const_vars.swap(pure_const);
+    }
+    inflight_.fetch_add(1);
+    // +1 sentinel keeps the op from dispatching while we are still
+    // enqueueing it on its variables (the reference's pending counter
+    // dance, threaded_engine.cc:288)
+    op->wait.store(1 + static_cast<int>(op->const_vars.size() +
+                                        op->mutable_vars.size()));
+    for (Var* v : op->const_vars) EnqueueOnVar(op, v, /*is_write=*/false);
+    for (Var* v : op->mutable_vars) EnqueueOnVar(op, v, /*is_write=*/true);
+    DecWait(op);  // drop the sentinel
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(donemu_);
+    donecv_.wait(lk, [this] { return inflight_.load() == 0; });
+  }
+
+ private:
+  void EnqueueOnVar(Opr* op, Var* v, bool is_write) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    bool can_run_now;
+    if (is_write) {
+      can_run_now = v->pending.empty() && !v->running_write &&
+                    v->running_reads == 0;
+    } else {
+      can_run_now = v->pending.empty() && !v->running_write;
+    }
+    if (can_run_now) {
+      if (is_write) v->running_write = true;
+      else ++v->running_reads;
+      DecWait(op);
+    } else {
+      v->pending.emplace_back(op, is_write);
+    }
+  }
+
+  void DecWait(Opr* op) {
+    if (op->wait.fetch_sub(1) == 1) {
+      {
+        std::lock_guard<std::mutex> lk(qmu_);
+        ready_.push(op);
+      }
+      qcv_.notify_one();
+    }
+  }
+
+  void OnComplete(Opr* op) {
+    for (Var* v : op->const_vars) ReleaseVar(v, /*was_write=*/false);
+    for (Var* v : op->mutable_vars) ReleaseVar(v, /*was_write=*/true);
+    delete op;
+    if (inflight_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(donemu_);
+      donecv_.notify_all();
+    }
+  }
+
+  void ReleaseVar(Var* v, bool was_write) {
+    std::vector<Opr*> to_grant;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (was_write) v->running_write = false;
+      else --v->running_reads;
+      if (v->running_write || v->running_reads > 0) {
+        // a concurrent read finished while others still run: only more
+        // reads could start, and those were granted when they arrived
+      }
+      while (!v->pending.empty()) {
+        auto [op, is_write] = v->pending.front();
+        if (is_write) {
+          if (v->running_write || v->running_reads > 0) break;
+          v->running_write = true;
+          v->pending.pop_front();
+          to_grant.push_back(op);
+          break;  // a write blocks everything behind it
+        } else {
+          if (v->running_write) break;
+          ++v->running_reads;
+          v->pending.pop_front();
+          to_grant.push_back(op);
+          // keep granting consecutive reads
+        }
+      }
+    }
+    for (Opr* op : to_grant) DecWait(op);
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* op;
+      {
+        std::unique_lock<std::mutex> lk(qmu_);
+        qcv_.wait(lk, [this] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop();
+      }
+      op->fn(op->payload);  // ctypes callback re-acquires the GIL
+      OnComplete(op);
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::queue<Opr*> ready_;
+  std::mutex qmu_;
+  std::condition_variable qcv_;
+  bool shutdown_;
+  std::atomic<int> inflight_;
+  std::mutex donemu_;
+  std::condition_variable donecv_;
+  std::mutex vmu_;
+  std::vector<Var*> all_vars_;
+};
+
+}  // namespace rt
+
+extern "C" {
+
+void* rt_engine_create(int num_threads) { return new rt::Engine(num_threads); }
+
+void rt_engine_destroy(void* e) { delete static_cast<rt::Engine*>(e); }
+
+void* rt_engine_new_var(void* e) {
+  return static_cast<rt::Engine*>(e)->NewVar();
+}
+
+void rt_engine_push(void* e, rt::rt_callback fn, void* payload, void** cvars,
+                    int n_const, void** mvars, int n_mut) {
+  static_cast<rt::Engine*>(e)->Push(
+      fn, payload, reinterpret_cast<rt::Var**>(cvars), n_const,
+      reinterpret_cast<rt::Var**>(mvars), n_mut);
+}
+
+void rt_engine_wait_all(void* e) { static_cast<rt::Engine*>(e)->WaitAll(); }
+
+}  // extern "C"

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Create a random-access .idx file for an existing .rec file.
+
+Parity: `tools/rec2idx.py` (IndexCreator) — reads the RecordIO framing and
+writes `key\\tbyte_offset` lines so `MXIndexedRecordIO` can seek. Uses the
+native mmap scanner (`src/recordio.cc`) when built: one C pass instead of a
+python loop per record.
+
+Usage:
+    python tools/rec2idx.py data/test.rec data/test.idx
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def create_index(rec_path, idx_path, key_type=int):
+    from mxnet_tpu.recordio import list_record_offsets
+
+    offsets = list_record_offsets(rec_path)
+    with open(idx_path, "w") as f:
+        for i, off in enumerate(offsets):
+            f.write(f"{key_type(i)}\t{off}\n")
+    return len(offsets)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an index file from a RecordIO file")
+    parser.add_argument("record", help="path to the .rec file")
+    parser.add_argument("index", help="path for the output .idx file")
+    args = parser.parse_args()
+    n = create_index(args.record, args.index)
+    print(f"wrote {n} entries to {args.index}")
+
+
+if __name__ == "__main__":
+    main()
