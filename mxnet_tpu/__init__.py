@@ -64,6 +64,7 @@ from . import monitor
 from . import profiler
 from . import util
 from . import visualization
+from . import contrib
 from . import attribute
 from .attribute import AttrScope
 from . import name

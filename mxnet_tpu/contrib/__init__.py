@@ -1,0 +1,3 @@
+"""contrib — experimental / auxiliary frontends (parity
+`python/mxnet/contrib/`): quantization, ONNX, text utilities."""
+from . import quantization  # noqa: F401

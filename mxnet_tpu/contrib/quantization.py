@@ -1,0 +1,269 @@
+"""Post-training INT8 quantization.
+
+Parity: `python/mxnet/contrib/quantization.py` (quantize_model /
+quantize_graph with naive + entropy calibration) over the graph rewrite
+the reference runs in `src/operator/quantization/quantize_graph_pass.cc`.
+
+TPU-native design: the rewrite is a :class:`SubgraphProperty` over the
+Symbol IR (the reference builds INT8 on its subgraph framework the same
+way, `subgraph/mkldnn/mkldnn_post_quantize_property.h`): every selected
+Convolution / FullyConnected becomes
+
+    quantize_v2(data) ─┐
+    quantize_v2(weight)┴→ quantized_op (int8×int8→int32) → dequantize (+bias)
+
+Calibration modes (`quantize_model` calib_mode):
+  * 'none'    — dynamic min/max per batch (no calib data needed)
+  * 'naive'   — min/max of each quantized input over the calib set
+  * 'entropy' — KL-divergence-optimal thresholds (the reference's
+    `_get_optimal_threshold`, contrib/quantization.py:241)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..symbol.subgraph import SubgraphProperty, SubgraphSelector, build_subgraph
+
+__all__ = ["quantize_symbol", "quantize_model", "QuantizeProperty"]
+
+_QUANTIZABLE = ("Convolution", "FullyConnected")
+
+
+class _QuantizeSelector(SubgraphSelector):
+    def __init__(self, excluded):
+        self._excluded = set(excluded or ())
+
+    def select(self, node):
+        return node.op in _QUANTIZABLE and node.name not in self._excluded
+
+
+class QuantizeProperty(SubgraphProperty):
+    """Rewrite each quantizable node into the int8 chain. ``calib_table``
+    maps node name → (min, max) float range of its DATA input; when absent
+    the quantize_v2 computes the range dynamically per batch."""
+
+    def __init__(self, excluded_sym_names=(), calib_table=None):
+        self._excluded = tuple(excluded_sym_names or ())
+        self._calib = dict(calib_table or {})
+
+    def create_subgraph_selector(self):
+        return _QuantizeSelector(self._excluded)
+
+    def create_subgraph_node(self, subgraph_sym, input_entries, subgraph_id):
+        from ..symbol.symbol import _apply_op
+
+        nodes = [n for n in subgraph_sym._nodes() if not n.is_variable]
+        if len(nodes) != 1:
+            return None
+        node = nodes[0]
+        names = (subgraph_sym.list_arguments()
+                 + subgraph_sym.list_auxiliary_states())
+        entry = dict(zip(names, input_entries))
+
+        def of(i):
+            if i >= len(node.inputs):
+                return None
+            return entry.get(node.inputs[i][0].name)
+
+        data, weight = of(0), of(1)
+        bias = of(2)
+        if data is None or weight is None:
+            return None
+        calib = self._calib.get(node.name)
+        q_attrs = {}
+        if calib is not None:
+            q_attrs = {"min_calib_range": float(calib[0]),
+                       "max_calib_range": float(calib[1])}
+        qd = _apply_op("_contrib_quantize_v2", data,
+                       name=f"{node.name}_data_quantize", **q_attrs)
+        qw = _apply_op("_contrib_quantize_v2", weight,
+                       name=f"{node.name}_weight_quantize")
+        if node.op == "Convolution":
+            attrs = {k: v for k, v in node.attrs.items()
+                     if k in ("kernel", "stride", "dilate", "pad",
+                              "num_filter", "num_group", "layout")}
+            qout = _apply_op("_contrib_quantized_conv", qd[0], qw[0],
+                             qd[1], qd[2], qw[1], qw[2],
+                             name=f"quantized_{node.name}", **attrs)
+        else:
+            attrs = {k: v for k, v in node.attrs.items()
+                     if k in ("num_hidden", "flatten")}
+            qout = _apply_op("_contrib_quantized_fully_connected",
+                             qd[0], qw[0], qd[1], qd[2], qw[1], qw[2],
+                             name=f"quantized_{node.name}", **attrs)
+        deq = _apply_op("_contrib_dequantize", qout[0], qout[1], qout[2],
+                        name=f"{node.name}_dequantize")
+        if bias is not None:
+            # bias sits outside the param back-fill rules now; pin its
+            # shape on the variable so inference still closes
+            n_out = int(node.attrs.get("num_filter",
+                                       node.attrs.get("num_hidden", 0)))
+            bnode = bias._outputs[0][0]
+            if bnode.is_variable and n_out:
+                bnode.attrs.setdefault("__shape__", (n_out,))
+            if node.op == "Convolution":
+                # channel axis broadcast for any spatial rank (1/2/3-D conv)
+                from ..symbol.symbol import _as_shape
+
+                nd_spatial = len(_as_shape(node.attrs.get("kernel")))
+                bias = _apply_op("Reshape", bias,
+                                 shape=(1, -1) + (1,) * nd_spatial,
+                                 name=f"{node.name}_bias_reshape")
+            deq = _apply_op("broadcast_add", deq, bias,
+                            name=f"{node.name}_bias_add")
+        return deq
+
+
+def quantize_symbol(sym, excluded_sym_names=(), calib_table=None):
+    """Insert the int8 chains (reference MXQuantizeSymbol)."""
+    return build_subgraph(sym, QuantizeProperty(excluded_sym_names,
+                                                calib_table))
+
+
+def _collect_layer_inputs(sym, nodes_to_calibrate, arg_dict, aux_dict,
+                          calib_data, max_batches, data_name):
+    """Run the fp32 graph over the calib set, returning
+    {node_name: [np arrays]} of each quantizable node's DATA input.
+    One executor per batch SHAPE (not per batch) — the compiled program
+    is reused across same-shaped batches."""
+    from ..symbol.symbol import Symbol
+    from .. import ndarray as nd
+
+    entries = {}
+    for node in nodes_to_calibrate:
+        entries[node.name] = node.inputs[0]
+    mon_names = list(entries)
+    mon_sym = Symbol([entries[n] for n in mon_names])
+    collected = {n: [] for n in mon_names}
+    executors = {}
+    n_done = 0
+    for batch in calib_data:
+        x = batch.data[0] if hasattr(batch, "data") else batch
+        shape = tuple(x.shape)
+        ex = executors.get(shape)
+        if ex is None:
+            ex = mon_sym.simple_bind(grad_req="null", **{data_name: shape})
+            for k, v in arg_dict.items():
+                if k in ex.arg_dict and k != data_name:
+                    ex.arg_dict[k][:] = v
+            for k, v in aux_dict.items():
+                if k in ex.aux_dict:
+                    ex.aux_dict[k][:] = v
+            executors[shape] = ex
+        feed = {data_name: x if isinstance(x, nd.NDArray) else nd.array(x)}
+        outs = ex.forward(is_train=False, **feed)
+        for name, out in zip(mon_names, outs):
+            collected[name].append(out.asnumpy())
+        n_done += 1
+        if max_batches is not None and n_done >= max_batches:
+            break
+    return collected
+
+
+def _smooth_distribution(p, eps=0.0001):
+    """Move a little mass onto zero bins so KL is defined (reference
+    `_smooth_distribution`, the TensorRT calibration recipe)."""
+    is_zeros = (p == 0).astype(np.float64)
+    is_nonzeros = (p != 0).astype(np.float64)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0:
+        raise ValueError("all-zero distribution")
+    eps1 = eps * n_zeros / n_nonzeros
+    hist = p.astype(np.float64)
+    hist += eps * is_zeros + (-eps1) * is_nonzeros
+    return hist
+
+
+def _kl_divergence(p, q):
+    p = p / p.sum()
+    q = q / q.sum()
+    mask = p > 0
+    return float(np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-300))))
+
+
+def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| (reference
+    contrib/quantization.py `_get_optimal_threshold`; the TensorRT 8-bit
+    recipe): the reference distribution p clips outliers into its edge
+    bins; the candidate q is p re-expressed in 255 merged bins WITHOUT the
+    outlier mass — so over-tight thresholds pay for their clipped tails."""
+    arr = np.asarray(arr).ravel()
+    maxabs = float(np.max(np.abs(arr))) if arr.size else 0.0
+    if maxabs == 0.0:
+        return 0.0
+    hist, hist_edges = np.histogram(arr, bins=num_bins, range=(-maxabs, maxabs))
+    zero_bin = num_bins // 2
+    best_kl, best_t = np.inf, maxabs
+    for i in range(num_quantized_bins // 2, num_bins // 2 + 1,
+                   max(1, (num_bins // 2) // 256)):
+        lo, hi = zero_bin - i, zero_bin + i + 1
+        sliced = hist[lo:hi].astype(np.float64)
+        p = sliced.copy()
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        if p.sum() == 0:
+            continue
+        is_nonzero = (p != 0)
+        num_merged = sliced.size // num_quantized_bins
+        q = np.zeros_like(sliced)
+        for j in range(num_quantized_bins):
+            start = j * num_merged
+            stop = sliced.size if j == num_quantized_bins - 1 \
+                else start + num_merged
+            total = sliced[start:stop].sum()
+            norm = is_nonzero[start:stop].sum()
+            if norm:
+                q[start:stop] = total / norm
+        q[~is_nonzero] = 0
+        try:
+            p_s = _smooth_distribution(p)
+            q_s = _smooth_distribution(q)
+        except ValueError:
+            continue
+        kl = _kl_divergence(p_s, q_s)
+        if kl < best_kl:
+            best_kl = kl
+            best_t = float(hist_edges[hi])
+    return best_t
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="none", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8",
+                   logger=None):
+    """Quantize a model (reference contrib/quantization.py quantize_model).
+
+    Returns (quantized_symbol, arg_params, aux_params) — parameters are
+    unchanged (weights quantize inside the graph; XLA folds the static
+    scales) so the fp32 checkpoint keeps working for both graphs."""
+    if quantized_dtype != "int8":
+        raise MXNetError(f"quantized_dtype {quantized_dtype} not supported; "
+                         f"the TPU build quantizes to signed int8 (MXU-native)")
+    prop = QuantizeProperty(excluded_sym_names)
+    selector = prop.create_subgraph_selector()
+    nodes_to_cal = [n for n in sym._nodes() if selector.select(n)]
+
+    calib_table = None
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXNetError(f"calib_mode={calib_mode} requires calib_data")
+        data_name = data_names[0] if not isinstance(data_names, str) \
+            else data_names
+        collected = _collect_layer_inputs(sym, nodes_to_cal, arg_params,
+                                          aux_params, calib_data,
+                                          num_calib_examples, data_name)
+        calib_table = {}
+        for name, arrs in collected.items():
+            flat = np.concatenate([a.ravel() for a in arrs])
+            if calib_mode == "naive":
+                calib_table[name] = (float(flat.min()), float(flat.max()))
+            else:
+                t = _get_optimal_threshold(flat)
+                calib_table[name] = (-t, t)
+    elif calib_mode != "none":
+        raise MXNetError(f"unknown calib_mode {calib_mode}")
+
+    qsym = quantize_symbol(sym, excluded_sym_names, calib_table)
+    return qsym, arg_params, aux_params

@@ -14,4 +14,6 @@ from . import nn            # noqa: F401
 from . import rnn           # noqa: F401
 from . import ctc           # noqa: F401
 from . import control_flow_ops  # noqa: F401
+from . import subgraph_ops   # noqa: F401
+from . import quantization_ops  # noqa: F401
 from . import optimizer_ops # noqa: F401

@@ -1,0 +1,145 @@
+"""INT8 quantization op family.
+
+Parity: `src/operator/quantization/` — quantize_v2, dequantize,
+requantize, quantized_conv, quantized_fully_connected. Same symmetric
+int8 scheme as the reference's `quantized_dtype='int8'` path: a tensor
+with calibrated float range [min, max] maps through
+scale = 127 / max(|min|, |max|); int8×int8 accumulates in int32 whose
+float range is ±(2^31-1)·scale_a·scale_b (the reference's
+`QuantizationRangeForMultiplication`).
+
+TPU-native: int8 matmul/conv lower to XLA dots with
+preferred_element_type=int32 — on TPU these feed the MXU at double
+throughput vs bf16; dequantize/requantize fuse into the surrounding
+program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, bound_fn
+
+_INT8_MAX = 127.0
+_INT32_MAX = float(2 ** 31 - 1)
+
+
+def _range_scale(mn, mx):
+    maxabs = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return jnp.where(maxabs > 0, _INT8_MAX / maxabs, jnp.ones_like(maxabs))
+
+
+@register("_contrib_quantize_v2", num_outputs=3)
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8", **kw):
+    """fp32 → int8 + the float range it represents
+    (`quantize_v2-inl.h`). With calib ranges the scale is static (folds
+    into the compiled program); without, min/max are computed on the fly."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(float(min_calib_range), jnp.float32)
+        mx = jnp.asarray(float(max_calib_range), jnp.float32)
+    else:
+        mn = data.min().astype(jnp.float32)
+        mx = data.max().astype(jnp.float32)
+    scale = _range_scale(mn, mx)
+    q = jnp.clip(jnp.rint(data.astype(jnp.float32) * scale),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    maxabs = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return q, -maxabs, maxabs
+
+
+@register("_contrib_dequantize")
+def _dequantize(data, min_range, max_range, out_type="float32", **kw):
+    """int8/int32 → fp32 (`dequantize-inl.h`). The range args are the
+    float values the integer extremes represent."""
+    if data.dtype == jnp.int8:
+        denom = _INT8_MAX
+    else:
+        denom = _INT32_MAX
+    maxabs = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (maxabs / denom)
+
+
+@register("_contrib_requantize", num_outputs=3)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None, **kw):
+    """int32 → int8 with a (possibly calibrated) narrower range
+    (`requantize-inl.h`)."""
+    f = _dequantize(data, min_range, max_range)
+    return _quantize_v2(f, min_calib_range=min_calib_range,
+                        max_calib_range=max_calib_range)
+
+
+def _qmul_range(min_a, max_a, min_b, max_b):
+    """Float range of the int32 accumulator
+    (`QuantizationRangeForMultiplication`, quantization_utils.h)."""
+    sa = jnp.maximum(jnp.abs(min_a), jnp.abs(max_a)) / _INT8_MAX
+    sb = jnp.maximum(jnp.abs(min_b), jnp.abs(max_b)) / _INT8_MAX
+    hi = sa * sb * _INT32_MAX
+    return -hi, hi
+
+
+@register("_contrib_quantized_conv", num_outputs=3)
+def _quantized_conv(data, weight, min_data, max_data, min_weight, max_weight,
+                    kernel=(1, 1), stride=(), dilate=(), pad=(),
+                    num_filter=0, num_group=1, layout="NCHW", **kw):
+    """int8 conv → int32 + its float range (`quantized_conv.cc`).
+    The MXU runs the int8 dot; bias stays on the fp32 side (added after
+    dequantize by the graph pass — exact, since bias addition commutes
+    with the linear map)."""
+    conv = bound_fn("_int_conv_impl", kernel=kernel, stride=stride,
+                    dilate=dilate, pad=pad, num_filter=num_filter,
+                    num_group=num_group, layout=layout)
+    out = conv(data, weight)
+    mn, mx = _qmul_range(min_data, max_data, min_weight, max_weight)
+    return out, mn, mx
+
+
+@register("_int_conv_impl")
+def _int_conv_impl(data, weight, kernel=(1, 1), stride=(), dilate=(),
+                   pad=(), num_filter=0, num_group=1, layout="NCHW", **kw):
+    from ._utils import as_tuple
+
+    kernel = as_tuple(kernel)
+    nd = len(kernel)
+    stride = as_tuple(stride) or (1,) * nd
+    dilate = as_tuple(dilate) or (1,) * nd
+    pad = as_tuple(pad) or (0,) * nd
+    dims = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, dims)
+    return lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32)
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3)
+def _quantized_fc(data, weight, min_data, max_data, min_weight, max_weight,
+                  num_hidden=0, flatten=True, **kw):
+    """int8 FC → int32 + float range (`quantized_fully_connected.cc`)."""
+    from ._utils import parse_bool
+
+    x = data
+    if parse_bool(flatten) and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    out = lax.dot_general(x.astype(jnp.int8), weight.astype(jnp.int8),
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    mn, mx = _qmul_range(min_data, max_data, min_weight, max_weight)
+    return out, mn, mx
+
+
+@register("_contrib_quantized_pooling", num_outputs=3)
+def _quantized_pooling(data, min_data, max_data, kernel=(2, 2), stride=(),
+                       pad=(), pool_type="max", global_pool=False, **kw):
+    """int8 pooling; range passes through unchanged
+    (`quantized_pooling.cc`)."""
+    pool = bound_fn("Pooling", kernel=kernel, stride=stride, pad=pad,
+                    pool_type=pool_type, global_pool=global_pool)
+    out = pool(data.astype(jnp.float32))
+    if str(pool_type) == "max":
+        out = jnp.rint(out)
+    return out.astype(data.dtype), min_data, max_data

@@ -1,0 +1,53 @@
+"""Ops backing the subgraph framework.
+
+`_subgraph_exec` — the opaque region node the default SubgraphProperty
+emits (reference: each subgraph backend registers an op executing its
+partitioned region, `build_subgraph.cc` CreateSubgraphNode). The region
+travels as Symbol JSON in an attribute (the control-flow convention) and
+is traced INTO the enclosing XLA program — no graph-executor re-entry.
+
+`_fused_conv_bn_relu` — the demo fusion kernel (the MKLDNN
+conv+bn+activation fusion role, `subgraph/mkldnn/mkldnn_conv.cc`):
+BatchNorm folds into the convolution weights at run time, then ReLU —
+one MXU conv instead of conv + 5 elementwise passes. Inference-only
+(uses the moving statistics, like the reference's deployment fusions).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, bound_fn
+
+
+@register("_subgraph_exec", needs_rng=True, needs_mode=True,
+          num_outputs=lambda attrs: int(attrs["n_out"]))
+def _subgraph_exec(key, *args, subgraph=None, arg_names="", n_out=1,
+                   _train=False, **kw):
+    from .control_flow_ops import _sub_fn
+
+    fn = _sub_fn(subgraph, arg_names, _train)
+    outs = fn(key, args)
+    outs = tuple(outs) if isinstance(outs, (tuple, list)) else (outs,)
+    return outs if int(n_out) > 1 else outs[0]
+
+
+@register("_fused_conv_bn_relu")
+def _fused_conv_bn_relu(data, weight, bias, gamma, beta, moving_mean,
+                        moving_var, kernel=(1, 1), stride=(), dilate=(),
+                        pad=(), num_filter=0, num_group=1, no_bias=False,
+                        layout="NCHW", eps=1e-5, fix_gamma=False,
+                        with_relu=True, **kw):
+    """relu(BN(conv(x))) with BN folded into the conv parameters:
+    w' = w * s, b' = (b - mean) * s + beta, s = gamma / sqrt(var + eps)."""
+    from ._utils import parse_bool
+
+    g = jnp.ones_like(moving_var) if parse_bool(fix_gamma) else gamma
+    s = g / jnp.sqrt(moving_var + float(eps))
+    w = weight * s.reshape((-1,) + (1,) * (weight.ndim - 1))
+    b = (bias - moving_mean) * s + beta
+    conv = bound_fn("Convolution", kernel=kernel, stride=stride,
+                    dilate=dilate, pad=pad, num_filter=num_filter,
+                    num_group=num_group, no_bias=False, layout=layout)
+    out = conv(data, w, b)
+    return jax.nn.relu(out) if parse_bool(with_relu) else out

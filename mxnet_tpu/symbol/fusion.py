@@ -1,0 +1,76 @@
+"""Conv+BN(+ReLU) fusion — the demo SubgraphProperty.
+
+Parity role: the MKLDNN conv fusion backend
+(`src/operator/subgraph/mkldnn/mkldnn_conv.cc` + its
+`MXNET_REGISTER_SUBGRAPH_PROPERTY(MKLDNN, ...)`): Convolution → BatchNorm
+(→ relu) chains collapse into one `_fused_conv_bn_relu` node with the BN
+folded into the convolution parameters at run time. Inference-only (the
+fused op consumes the moving statistics), like the reference's deployment
+fusions; registered as backend ``TPU_FUSE``:
+
+    fused = sym.get_backend_symbol("TPU_FUSE")
+"""
+from __future__ import annotations
+
+from .subgraph import (SubgraphProperty, SubgraphSelector,
+                       register_subgraph_property)
+
+
+class _ConvBNReLUSelector(SubgraphSelector):
+    def select(self, node):
+        return node.op == "Convolution"
+
+    def select_output(self, node, output_node):
+        if node.op == "Convolution" and output_node.op == "BatchNorm":
+            # BN must consume THIS conv's main output
+            return bool(output_node.inputs) and output_node.inputs[0][0] is node
+        if node.op == "BatchNorm" and output_node.op == "Activation":
+            return str(output_node.attrs.get("act_type", "")) == "relu" and \
+                bool(output_node.inputs) and output_node.inputs[0][0] is node
+        return False
+
+
+class ConvBNReLUProperty(SubgraphProperty):
+    def create_subgraph_selector(self):
+        return _ConvBNReLUSelector()
+
+    def create_subgraph_node(self, subgraph_sym, input_entries, subgraph_id):
+        from .symbol import _apply_op
+
+        nodes = subgraph_sym._nodes()
+        conv = next((n for n in nodes if n.op == "Convolution"), None)
+        bn = next((n for n in nodes if n.op == "BatchNorm"), None)
+        act = next((n for n in nodes if n.op == "Activation"), None)
+        if conv is None or bn is None or len(subgraph_sym._outputs) != 1:
+            return None  # not the exact shape this fusion handles
+        names = (subgraph_sym.list_arguments()
+                 + subgraph_sym.list_auxiliary_states())
+        entry = dict(zip(names, input_entries))
+
+        def of(node, i):
+            child, _ = node.inputs[i]
+            return entry.get(child.name)
+
+        data = of(conv, 0)
+        weight = of(conv, 1)
+        bias = of(conv, 2) if len(conv.inputs) > 2 else None
+        gamma, beta = of(bn, 1), of(bn, 2)
+        mean, variance = of(bn, 3), of(bn, 4)
+        if any(x is None for x in (data, weight, gamma, beta, mean, variance)):
+            return None  # a role is fed by an inner node — bail out
+        if bias is None:
+            bias = _apply_op("_zeros",
+                             shape=(int(conv.attrs.get("num_filter", 0)),),
+                             dtype="float32")
+        attrs = {k: v for k, v in conv.attrs.items()
+                 if k in ("kernel", "stride", "dilate", "pad", "num_filter",
+                          "num_group", "layout")}
+        attrs["eps"] = bn.attrs.get("eps", 1e-3)
+        attrs["fix_gamma"] = bn.attrs.get("fix_gamma", True)
+        attrs["with_relu"] = act is not None
+        return _apply_op(
+            "_fused_conv_bn_relu", data, weight, bias, gamma, beta, mean,
+            variance, name=f"fused_conv{subgraph_id}", **attrs)
+
+
+register_subgraph_property("TPU_FUSE", ConvBNReLUProperty)

@@ -368,12 +368,43 @@ class Symbol:
 
     # -- binding -------------------------------------------------------------
 
+    def get_backend_symbol(self, backend):
+        """Rewrite this symbol with a registered subgraph backend
+        (reference symbol.py get_backend_symbol → MXGenBackendSubgraph)."""
+        from .subgraph import build_subgraph
+
+        return build_subgraph(self, backend)
+
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from .executor import Executor
+        from .subgraph import apply_env_backend
 
-        return Executor(self, ctx, args=args, args_grad=args_grad,
-                        grad_req=grad_req, aux_states=aux_states)
+        new_sym = apply_env_backend(self)
+        if new_sym is not self:
+            # a rewrite may move values between arg and aux roles (TPU_FUSE
+            # turns BN moving stats into fused-op arguments): re-split the
+            # caller's values against the NEW symbol's listings
+            pool = {}
+            if isinstance(args, dict):
+                pool.update(args)
+            elif isinstance(args, (list, tuple)):
+                pool.update(zip(self.list_arguments(), args))
+            if isinstance(aux_states, dict):
+                pool.update(aux_states)
+            elif isinstance(aux_states, (list, tuple)):
+                pool.update(zip(self.list_auxiliary_states(), aux_states))
+            if pool:
+                args = {n: pool[n] for n in new_sym.list_arguments()
+                        if n in pool}
+                aux_states = {n: pool[n]
+                              for n in new_sym.list_auxiliary_states()
+                              if n in pool}
+            if isinstance(args_grad, (list, tuple)):
+                args_grad = dict(zip(self.list_arguments(), args_grad))
+        return Executor(new_sym, ctx, args=args,
+                        args_grad=args_grad, grad_req=grad_req,
+                        aux_states=aux_states)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
@@ -381,11 +412,13 @@ class Symbol:
         """Infer every argument shape from the given input shapes, allocate
         (zero-filled) arrays and bind (reference symbol.py:1376)."""
         from .executor import Executor
+        from .subgraph import apply_env_backend
         from ..ndarray import zeros
 
-        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
-        arg_names = self.list_arguments()
-        aux_names = self.list_auxiliary_states()
+        sym = apply_env_backend(self)
+        arg_shapes, _, aux_shapes = sym.infer_shape(**kwargs)
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
         type_dict = type_dict or {}
         args = {n: zeros(s, dtype=type_dict.get(n, "float32"))
                 for n, s in zip(arg_names, arg_shapes)}
@@ -394,7 +427,7 @@ class Symbol:
         args_grad = None
         if grad_req != "null":
             args_grad = {n: zeros(s) for n, s in zip(arg_names, arg_shapes)}
-        return Executor(self, ctx, args=args, args_grad=args_grad,
+        return Executor(sym, ctx, args=args, args_grad=args_grad,
                         grad_req=grad_req, aux_states=auxs)
 
     # -- eval ----------------------------------------------------------------
@@ -422,6 +455,8 @@ def _attr_to_str(v):
     if isinstance(v, bool):
         return str(v)
     if isinstance(v, (tuple, list)):
+        if len(v) == 1:
+            return f"({v[0]},)"  # "(64)" would literal_eval to a scalar
         return "(" + ", ".join(str(x) for x in v) + ")"
     return str(v)
 
@@ -750,6 +785,52 @@ def _prelu_rule(node, dsh):
     return {}
 
 
+def _through_quantize(entry):
+    """See through a _contrib_quantize_v2 node to its float input entry
+    (shape-preserving), so param back-fill reaches the weight variable."""
+    child, oi = entry
+    if not child.is_variable and child.op == "_contrib_quantize_v2" and oi == 0:
+        return child.inputs[0]
+    return entry
+
+
+def _quantized_rule(shape_fn):
+    """Back-fill rule for quantized conv/FC: data/weight arrive through
+    quantize_v2 nodes; the rule resolves both through them."""
+
+    def apply(node, shapes):
+        d_child, d_oi = _through_quantize(node.inputs[0])
+        key = (id(d_child), d_oi)
+        if key not in shapes:
+            return False
+        dsh = shapes[key]
+        filled = False
+        for idx, shape in shape_fn(node, dsh).items():
+            child, oi = _through_quantize(node.inputs[idx])
+            if child.is_variable and (id(child), oi) not in shapes:
+                shapes[(id(child), oi)] = tuple(int(x) for x in shape)
+                filled = True
+        return filled
+
+    return apply
+
+
+def _quantized_conv_shapes(node, dsh):
+    return {1: _conv_rule(node, dsh)[1]}  # one weight-shape formula only
+
+
+def _quantized_fc_shapes(node, dsh):
+    return {1: _fc_rule(node, dsh)[1]}
+
+
+def _fused_conv_rule(node, dsh):
+    per = {1: _conv_rule(node, dsh)[1]}
+    nf = int(node.attrs.get("num_filter"))
+    for i in range(2, 7):  # bias, gamma, beta, moving_mean, moving_var
+        per[i] = (nf,)
+    return per
+
+
 def _as_shape(v):
     if v is None:
         return ()
@@ -769,6 +850,9 @@ _PARAM_SHAPE_RULES = {
     "InstanceNorm": _rule(_in_rule),
     "Embedding": _rule(_embed_rule),
     "LeakyReLU": _rule(_prelu_rule),
+    "_fused_conv_bn_relu": _rule(_fused_conv_rule),
+    "_contrib_quantized_conv": _quantized_rule(_quantized_conv_shapes),
+    "_contrib_quantized_fully_connected": _quantized_rule(_quantized_fc_shapes),
 }
 
 
