@@ -122,7 +122,7 @@ def quantize_symbol(sym, excluded_sym_names=(), calib_table=None):
 
 
 def _collect_layer_inputs(sym, nodes_to_calibrate, arg_dict, aux_dict,
-                          calib_data, max_batches, data_name):
+                          calib_data, max_examples, data_name):
     """Run the fp32 graph over the calib set, returning
     {node_name: [np arrays]} of each quantizable node's DATA input.
     One executor per batch SHAPE (not per batch) — the compiled program
@@ -155,8 +155,10 @@ def _collect_layer_inputs(sym, nodes_to_calibrate, arg_dict, aux_dict,
         outs = ex.forward(is_train=False, **feed)
         for name, out in zip(mon_names, outs):
             collected[name].append(out.asnumpy())
-        n_done += 1
-        if max_batches is not None and n_done >= max_batches:
+        # counted in EXAMPLES, matching the reference's num_examples
+        # accounting (contrib/quantization.py _collect_layer_statistics)
+        n_done += int(x.shape[0]) if hasattr(x, "shape") and x.ndim else 1
+        if max_examples is not None and n_done >= max_examples:
             break
     return collected
 

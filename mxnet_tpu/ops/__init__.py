@@ -17,3 +17,4 @@ from . import control_flow_ops  # noqa: F401
 from . import subgraph_ops   # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import optimizer_ops # noqa: F401
+from . import vision        # noqa: F401

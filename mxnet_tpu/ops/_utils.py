@@ -50,6 +50,25 @@ def as_tuple(v, n=None, name="param"):
     return v
 
 
+def as_float_tuple(v, n=None):
+    """Parse MXNet-style float-tuple params: float | tuple | str '(0.1, 0.2)'
+    (the dmlc Tuple<float> fields, e.g. MultiBoxPrior sizes/ratios)."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        v = v.strip()
+        if v.startswith("(") or v.startswith("["):
+            v = v[1:-1]
+        v = tuple(float(x) for x in v.replace(",", " ").split() if x)
+    elif isinstance(v, (int, float, np.integer, np.floating)):
+        v = (float(v),) if n is None else (float(v),) * n
+    else:
+        v = tuple(float(x) for x in v)
+    if n is not None and len(v) == 1:
+        v = v * n
+    return v
+
+
 def parse_bool(v):
     if isinstance(v, str):
         return v not in ("0", "false", "False", "")

@@ -20,6 +20,7 @@ TPU-first design notes:
 from __future__ import annotations
 
 import math
+from functools import partial as _partial
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,65 @@ from ._utils import as_tuple, parse_bool
 
 def _acc(x):
     return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _conv_accum32(data, weight, stride, padding, lhs_dilation, rhs_dilation,
+                  dim_spec, groups):
+    """conv_general_dilated with explicit fp32 accumulation for half-dtype
+    inputs. jax 0.9's conv transpose rule cannot mix a fp32 cotangent with
+    half-dtype residuals (it rejects the dtype pair), so the backward here
+    re-derives the gradient convs at the INPUT dtype — gradients are linear
+    in the cotangent, and the MXU accumulates partial products in fp32 in
+    hardware either way."""
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, dim_spec)
+    return lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=padding,
+        lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+
+
+def _conv_accum32_fwd(data, weight, stride, padding, lhs_dilation, rhs_dilation,
+                      dim_spec, groups):
+    out = _conv_accum32(data, weight, stride, padding, lhs_dilation,
+                        rhs_dilation, dim_spec, groups)
+    return out, (data, weight)
+
+
+def _conv_accum32_bwd(stride, padding, lhs_dilation, rhs_dilation, dim_spec,
+                      groups, res, ct):
+    data, weight = res
+
+    def same_dtype_conv(d, w):
+        dn = lax.conv_dimension_numbers(d.shape, w.shape, dim_spec)
+        return lax.conv_general_dilated(
+            d, w, window_strides=stride, padding=padding,
+            lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+
+    _, vjp = jax.vjp(same_dtype_conv, data, weight)
+    return vjp(ct.astype(data.dtype))
+
+
+_conv_accum32.defvjp(_conv_accum32_fwd, _conv_accum32_bwd)
+
+
+def _conv_any(data, weight, stride, padding, lhs_dilation, rhs_dilation,
+              dim_spec, groups):
+    """Dispatch: fp32-accumulating custom-vjp path for half dtypes, plain
+    conv otherwise."""
+    if _acc(data) is not None:
+        return _conv_accum32(data, weight, tuple(stride), tuple(padding),
+                             tuple(lhs_dilation) if lhs_dilation else None,
+                             tuple(rhs_dilation) if rhs_dilation else None,
+                             dim_spec, int(groups))
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, dim_spec)
+    return lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=padding,
+        lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
+        dimension_numbers=dn, feature_group_count=groups)
 
 
 # ---------------------------------------------------------------------------
@@ -79,16 +139,8 @@ def _convolution(data, weight, *maybe_bias, kernel=None, stride=None, dilate=Non
     stride = as_tuple(stride, nd) or (1,) * nd
     dilate = as_tuple(dilate, nd) or (1,) * nd
     pad = as_tuple(pad, nd) or (0,) * nd
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(kernel))
-    out = lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=int(num_group),
-        preferred_element_type=_acc(data),
-    ).astype(data.dtype)
+    out = _conv_any(data, weight, stride, tuple((p, p) for p in pad),
+                    None, dilate, _conv_dims(kernel), int(num_group))
     if not parse_bool(no_bias) and maybe_bias:
         b = maybe_bias[0].reshape((1, -1) + (1,) * nd)
         out = out + b
@@ -118,17 +170,8 @@ def _deconvolution(data, weight, *maybe_bias, kernel=None, stride=None, dilate=N
         w = jnp.swapaxes(w, 1, 2).reshape((groups * cog, cin // groups) + kernel)
     pads = [(int(dilate[i]) * (kernel[i] - 1) - pad[i],
              int(dilate[i]) * (kernel[i] - 1) - pad[i] + adj[i]) for i in range(nd)]
-    dn = lax.conv_dimension_numbers(data.shape, w.shape, _conv_dims(kernel))
-    out = lax.conv_general_dilated(
-        data, w,
-        window_strides=(1,) * nd,
-        padding=pads,
-        lhs_dilation=stride,
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=_acc(data),
-    ).astype(data.dtype)
+    out = _conv_any(data, w, (1,) * nd, tuple(tuple(p) for p in pads),
+                    stride, dilate, _conv_dims(kernel), groups)
     if not parse_bool(no_bias) and maybe_bias:
         out = out + maybe_bias[0].reshape((1, -1) + (1,) * nd)
     return out
@@ -318,9 +361,6 @@ def _softmax_output_impl(data, label, grad_scale, ignore_label, multi_output, us
                          normalization):
     axis = 1 if multi_output else -1
     return jax.nn.softmax(data, axis=axis)
-
-
-from functools import partial as _partial
 
 
 @_partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))

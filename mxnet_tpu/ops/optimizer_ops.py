@@ -64,6 +64,95 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.
     return new_w32.astype(weight.dtype), new_mom, new_w32
 
 
+def _seq(v, n):
+    """Broadcast a scalar-or-sequence attr to a length-n list of floats
+    (handles string-serialized tuples from the Symbol/JSON path)."""
+    from ._utils import as_float_tuple
+
+    return list(as_float_tuple(v, n))
+
+
+# Fused multi-weight SGD family (reference `optimizer_op.cc` multi_sgd_update
+# / multi_sgd_mom_update / multi_mp_sgd_* — the aggregated-update ops behind
+# `MXNET_OPTIMIZER_AGGREGATION_SIZE`). Inputs are interleaved per weight;
+# outputs are the updated weights followed by the mutated states, so one XLA
+# program updates the whole group (frontend-dispatch cost amortized over
+# `num_weights` parameters — the TPU rendering of the reference's
+# MultiSGDKernel batching).
+
+@register("multi_sgd_update",
+          num_outputs=lambda attrs: int(attrs.get("num_weights", 1)))
+def _multi_sgd_update(*data, lrs=0.01, wds=0.0, rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=1, **kw):
+    n = int(num_weights)
+    lrs, wds = _seq(lrs, n), _seq(wds, n)
+    outs = []
+    for i in range(n):
+        w, g = data[2 * i], data[2 * i + 1]
+        gg = _rescale(g, rescale_grad, clip_gradient, wds[i], w)
+        outs.append((w.astype(jnp.float32) - lrs[i] * gg).astype(w.dtype))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update",
+          num_outputs=lambda attrs: 2 * int(attrs.get("num_weights", 1)),
+          mutate_aux=lambda attrs: tuple(
+              3 * i + 2 for i in range(int(attrs.get("num_weights", 1)))))
+def _multi_sgd_mom_update(*data, lrs=0.01, wds=0.0, momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1, **kw):
+    n = int(num_weights)
+    lrs, wds = _seq(lrs, n), _seq(wds, n)
+    new_ws, new_ms = [], []
+    for i in range(n):
+        w, g, m = data[3 * i], data[3 * i + 1], data[3 * i + 2]
+        gg = _rescale(g, rescale_grad, clip_gradient, wds[i], w)
+        nm = float(momentum) * m.astype(jnp.float32) - lrs[i] * gg
+        new_ws.append((w.astype(jnp.float32) + nm).astype(w.dtype))
+        new_ms.append(nm.astype(m.dtype))
+    return tuple(new_ws) + tuple(new_ms)
+
+
+@register("multi_mp_sgd_update",
+          num_outputs=lambda attrs: 2 * int(attrs.get("num_weights", 1)),
+          mutate_aux=lambda attrs: tuple(
+              3 * i + 2 for i in range(int(attrs.get("num_weights", 1)))))
+def _multi_mp_sgd_update(*data, lrs=0.01, wds=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1, **kw):
+    n = int(num_weights)
+    lrs, wds = _seq(lrs, n), _seq(wds, n)
+    new_ws, new_w32s = [], []
+    for i in range(n):
+        w, g, w32 = data[3 * i], data[3 * i + 1], data[3 * i + 2]
+        gg = _rescale(g, rescale_grad, clip_gradient, wds[i], w32)
+        nw32 = w32 - lrs[i] * gg
+        new_ws.append(nw32.astype(w.dtype))
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_w32s)
+
+
+@register("multi_mp_sgd_mom_update",
+          num_outputs=lambda attrs: 3 * int(attrs.get("num_weights", 1)),
+          mutate_aux=lambda attrs: tuple(
+              4 * i + o for i in range(int(attrs.get("num_weights", 1)))
+              for o in (2, 3)))
+def _multi_mp_sgd_mom_update(*data, lrs=0.01, wds=0.0, momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             num_weights=1, **kw):
+    n = int(num_weights)
+    lrs, wds = _seq(lrs, n), _seq(wds, n)
+    new_ws, new_aux = [], []
+    for i in range(n):
+        w, g, m, w32 = (data[4 * i], data[4 * i + 1], data[4 * i + 2],
+                        data[4 * i + 3])
+        gg = _rescale(g, rescale_grad, clip_gradient, wds[i], w32)
+        nm = float(momentum) * m - lrs[i] * gg
+        nw32 = w32 + nm
+        new_ws.append(nw32.astype(w.dtype))
+        new_aux.extend((nm, nw32))
+    return tuple(new_ws) + tuple(new_aux)
+
+
 @register("nag_mom_update", num_outputs=2, mutate_aux=(2,))
 def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
                     clip_gradient=-1.0, **kw):

@@ -1,0 +1,807 @@
+"""Detection / vision operator family.
+
+Parity targets (studied for behavior, re-designed for XLA):
+- `src/operator/contrib/roi_align.cc` (`_contrib_ROIAlign`)
+- `src/operator/roi_pooling.cc` (`ROIPooling`)
+- `src/operator/contrib/bounding_box.cc` (`_contrib_box_nms` /
+  `_contrib_box_iou` / `_contrib_bipartite_matching`)
+- `src/operator/contrib/deformable_convolution.cc`
+- `src/operator/spatial_transformer.cc` (`SpatialTransformer`)
+- `src/operator/correlation.cc` (`Correlation`)
+- `src/operator/svm_output.cc` (`SVMOutput`)
+- `src/operator/contrib/adaptive_avg_pooling.cc`
+- `src/operator/contrib/fft.cc` / `ifft.cc`
+- `src/operator/contrib/count_sketch.cc`
+- `src/operator/contrib/multibox_prior.cc` / `multibox_target.cc` /
+  `multibox_detection.cc`
+- `src/operator/tensor/ravel.cc` (`_ravel_multi_index` / `_unravel_index`)
+
+TPU-first notes: every kernel is expressed as dense gathers / masked
+reductions / `lax.scan` greedy passes over STATIC shapes — no data-dependent
+shapes, so everything jits and fuses. Sequential dependence (greedy NMS,
+bipartite matching) rides `lax.scan`; bilinear sampling is a 4-corner gather
+exactly like the reference's CPU kernel but vectorized over
+(roi, bin, sample) instead of looped.
+
+Documented divergence: ROIAlign with `sample_ratio<=0` uses a fixed 2x2
+sampling grid per bin instead of the reference's data-dependent
+ceil(roi_size/bin) grid (`roi_align.cc:190` adaptive grid) — XLA requires a
+static sample count; sample_ratio>0 matches the reference exactly.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial as _partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ._utils import as_tuple, as_float_tuple, parse_bool
+
+
+# ---------------------------------------------------------------------------
+# Bilinear sampling helper (shared by ROIAlign / DeformableConvolution)
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_gather(img, ys, xs):
+    """Sample img (H, W) at fractional coords ys/xs (any shape) with the
+    reference's boundary rule (`roi_align.cc:166-180`): coords outside
+    [-1, H] contribute zero; inside coords clamp to the border."""
+    h, w = img.shape
+    valid = (ys >= -1.0) & (ys <= h) & (xs >= -1.0) & (xs <= w)
+    y = jnp.clip(ys, 0.0, h - 1.0)
+    x = jnp.clip(xs, 0.0, w - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    ly = y - y0
+    lx = x - x0
+    v00 = img[y0, x0]
+    v01 = img[y0, x1]
+    v10 = img[y1, x0]
+    v11 = img[y1, x1]
+    val = ((1 - ly) * (1 - lx) * v00 + (1 - ly) * lx * v01 +
+           ly * (1 - lx) * v10 + ly * lx * v11)
+    return jnp.where(valid, val, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign / ROIPooling
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_ROIAlign")
+def _roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False, **kw):
+    """ROIAlign (`roi_align.cc:519`): average of bilinear samples on a
+    regular grid inside each bin; rois are (R, 5) rows of
+    [batch_idx, x1, y1, x2, y2] in image coords."""
+    ph, pw = as_tuple(pooled_size)
+    s = int(sample_ratio) if int(sample_ratio) > 0 else 2
+    scale = float(spatial_scale)
+    ps = parse_bool(position_sensitive)
+
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+    bidx = rois[:, 0].astype(jnp.int32)
+    x1 = rois[:, 1] * scale
+    y1 = rois[:, 2] * scale
+    x2 = rois[:, 3] * scale
+    y2 = rois[:, 4] * scale
+    rw = jnp.maximum(x2 - x1, 1.0)
+    rh = jnp.maximum(y2 - y1, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+
+    frac = (jnp.arange(s, dtype=data.dtype) + 0.5) / s
+    # ys: (R, ph, s)   xs: (R, pw, s)
+    ys = y1[:, None, None] + (jnp.arange(ph)[None, :, None] + frac[None, None, :]) * bin_h[:, None, None]
+    xs = x1[:, None, None] + (jnp.arange(pw)[None, :, None] + frac[None, None, :]) * bin_w[:, None, None]
+
+    imgs = data[bidx]  # (R, C, H, W)
+
+    def per_roi(img_c, ys_r, xs_r):                    # (C,H,W), (ph,s), (pw,s)
+        yy = jnp.broadcast_to(ys_r[:, :, None, None], (ph, s, pw, s))
+        xx = jnp.broadcast_to(xs_r[None, None, :, :], (ph, s, pw, s))
+
+        def per_chan(img):
+            return _bilinear_gather(img, yy, xx)
+        return jax.vmap(per_chan)(img_c)               # (C, ph, s, pw, s)
+
+    vals = jax.vmap(per_roi)(imgs, ys, xs)
+    # vals: (R, C, ph, s, pw, s) → mean over the sampling grid
+    pooled = vals.mean(axis=(3, 5))                    # (R, C, ph, pw)
+
+    if ps:
+        # position-sensitive (R-FCN): input channel c_out*ph*pw + i*pw + j
+        # feeds output channel c_out at bin (i, j)
+        c_out = c // (ph * pw)
+        pooled = pooled.reshape(r, c_out, ph, pw, ph, pw)
+        ii = jnp.arange(ph)[:, None]
+        jj = jnp.arange(pw)[None, :]
+        pooled = pooled[:, :, ii, jj, ii, jj]          # (R, c_out, ph, pw)
+    return pooled
+
+
+@register("ROIPooling")
+def _roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0, **kw):
+    """ROIPooling (`roi_pooling.cc:251`): quantized-bin max pooling. Empty
+    bins produce 0 (reference writes 0 with max_idx=-1)."""
+    ph, pw = as_tuple(pooled_size)
+    scale = float(spatial_scale)
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+
+    bidx = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    x2 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    y2 = jnp.round(rois[:, 4] * scale).astype(jnp.int32)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+
+    def bounds(start, extent, p, idx):
+        lo = start + jnp.floor(idx * extent / p).astype(jnp.int32)
+        hi = start + jnp.ceil((idx + 1) * extent / p).astype(jnp.int32)
+        return lo, hi
+
+    iy = jnp.arange(ph)
+    hs, he = bounds(y1[:, None], rh[:, None], ph, iy[None, :])   # (R, ph)
+    ix = jnp.arange(pw)
+    ws, we = bounds(x1[:, None], rw[:, None], pw, ix[None, :])   # (R, pw)
+
+    hh = jnp.arange(h)
+    mask_h = (hh[None, None, :] >= jnp.clip(hs, 0, h)[:, :, None]) & \
+             (hh[None, None, :] < jnp.clip(he, 0, h)[:, :, None])    # (R, ph, H)
+    wwv = jnp.arange(w)
+    mask_w = (wwv[None, None, :] >= jnp.clip(ws, 0, w)[:, :, None]) & \
+             (wwv[None, None, :] < jnp.clip(we, 0, w)[:, :, None])   # (R, pw, W)
+
+    imgs = data[bidx]                                   # (R, C, H, W)
+    neg = jnp.asarray(-jnp.inf, data.dtype)
+    m1 = jnp.where(mask_h[:, None, :, :, None], imgs[:, :, None, :, :], neg)
+    m1 = m1.max(axis=3)                                 # (R, C, ph, W)
+    m2 = jnp.where(mask_w[:, None, None, :, :], m1[:, :, :, None, :], neg)
+    out = m2.max(axis=4)                                # (R, C, ph, pw)
+    return jnp.where(jnp.isfinite(out), out, 0.0).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Bounding-box ops
+# ---------------------------------------------------------------------------
+
+
+def _to_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    x, y, bw, bh = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate([x - bw / 2, y - bh / 2, x + bw / 2, y + bh / 2], axis=-1)
+
+
+def _from_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def _pair_iou(a, b):
+    """IoU of every box in a (..., N, 4) vs b (..., M, 4), corner format."""
+    ax1, ay1, ax2, ay2 = jnp.split(a[..., :, None, :], 4, axis=-1)
+    bx1, by1, bx2, by2 = jnp.split(b[..., None, :, :], 4, axis=-1)
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = (iw * ih)[..., 0]
+    area_a = ((ax2 - ax1) * (ay2 - ay1))[..., 0]
+    area_b = ((bx2 - bx1) * (by2 - by1))[..., 0]
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _box_nms_core(data, overlap_thresh, valid_thresh, topk, coord_start,
+                  score_index, id_index, background_id, force_suppress,
+                  in_format, out_format):
+    """Returns (out, orig_index): out sorted by score desc with suppressed
+    rows filled -1; orig_index (..., N) maps each output row to its source
+    row (-1 where suppressed) for the gradient scatter."""
+    shape = data.shape
+    n, k = shape[-2], shape[-1]
+    flat = data.reshape((-1, n, k))
+    b = flat.shape[0]
+    cs, si = int(coord_start), int(score_index)
+
+    scores = flat[:, :, si]
+    valid = scores > float(valid_thresh)
+    if int(id_index) >= 0 and int(background_id) >= 0:
+        valid &= flat[:, :, int(id_index)] != float(background_id)
+
+    # sort by score descending (invalid rows sink to the end)
+    sort_key = jnp.where(valid, scores, -jnp.inf)
+    order = jnp.argsort(-sort_key, axis=1)              # (B, N)
+    sorted_rows = jnp.take_along_axis(flat, order[:, :, None], axis=1)
+    sorted_valid = jnp.take_along_axis(valid, order, axis=1)
+    if int(topk) > 0:
+        sorted_valid &= jnp.arange(n)[None, :] < int(topk)
+
+    boxes = _to_corner(sorted_rows[:, :, cs:cs + 4], in_format)
+    iou = _pair_iou(boxes, boxes)                       # (B, N, N)
+    same_class = jnp.ones((b, n, n), bool)
+    if not force_suppress and int(id_index) >= 0:
+        ids = sorted_rows[:, :, int(id_index)]
+        same_class = ids[:, :, None] == ids[:, None, :]
+    suppress_pair = (iou > float(overlap_thresh)) & same_class
+
+    def step(keep, i):
+        # box i survives iff no kept earlier box suppresses it
+        earlier = (jnp.arange(n) < i)[None, :] & keep
+        dead = jnp.any(suppress_pair[:, :, i] & earlier, axis=1)
+        ki = sorted_valid[:, i] & ~dead
+        keep = keep.at[:, i].set(ki)
+        return keep, None
+
+    keep0 = jnp.zeros((b, n), bool)
+    keep, _ = lax.scan(step, keep0, jnp.arange(n))
+
+    out_rows = sorted_rows
+    if out_format != in_format:
+        conv = _from_corner(_to_corner(sorted_rows[:, :, cs:cs + 4], in_format),
+                            out_format)
+        out_rows = sorted_rows.at[:, :, cs:cs + 4].set(conv)
+    out = jnp.where(keep[:, :, None], out_rows, -1.0)
+    orig = jnp.where(keep, order, -1)
+    return out.reshape(shape), orig.reshape(shape[:-1])
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=tuple(range(1, 11)))
+def _box_nms_diff(data, overlap_thresh, valid_thresh, topk, coord_start,
+                  score_index, id_index, background_id, force_suppress,
+                  in_format, out_format):
+    out, _ = _box_nms_core(data, overlap_thresh, valid_thresh, topk,
+                           coord_start, score_index, id_index, background_id,
+                           force_suppress, in_format, out_format)
+    return out
+
+
+def _box_nms_fwd(data, *attrs):
+    out, orig = _box_nms_core(data, *attrs)
+    return out, (orig, data.shape)
+
+
+def _box_nms_bwd(*args):
+    res, ct = args[-2], args[-1]
+    orig, shape = res
+    n, k = shape[-2], shape[-1]
+    flat_ct = ct.reshape((-1, n, k))
+    flat_orig = orig.reshape((-1, n))
+    b = flat_ct.shape[0]
+    grad = jnp.zeros((b, n, k), flat_ct.dtype)
+    rows = jnp.clip(flat_orig, 0, n - 1)
+    contrib = jnp.where((flat_orig >= 0)[:, :, None], flat_ct, 0.0)
+    grad = grad.at[jnp.arange(b)[:, None], rows].add(contrib)
+    return (grad.reshape(shape),)
+
+
+_box_nms_diff.defvjp(_box_nms_fwd, _box_nms_bwd)
+
+
+@register("_contrib_box_nms", aliases=["_contrib_box_non_maximum_suppression"])
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, background_id=-1,
+             force_suppress=False, in_format="corner", out_format="corner", **kw):
+    """Greedy NMS (`bounding_box.cc:36`): output sorted by score desc,
+    suppressed/invalid rows are -1; the gradient returns each surviving
+    row's cotangent to its original position (`_backward_contrib_box_nms`)."""
+    return _box_nms_diff(data, float(overlap_thresh), float(valid_thresh),
+                         int(topk), int(coord_start), int(score_index),
+                         int(id_index), int(background_id),
+                         bool(parse_bool(force_suppress)), str(in_format),
+                         str(out_format))
+
+
+@register("_contrib_box_iou")
+def _box_iou(lhs, rhs, format="corner", **kw):
+    """Pairwise IoU (`bounding_box.cc:117`): lhs (..., N, 4) x rhs (..., M, 4)
+    → (..., N, M)."""
+    return _pair_iou(_to_corner(lhs, format), _to_corner(rhs, format))
+
+
+@register("_contrib_bipartite_matching", num_outputs=2)
+def _bipartite_matching(data, is_ascend=False, threshold=None, topk=-1, **kw):
+    """Greedy bipartite matching on a (…, N, M) score matrix
+    (`bounding_box.cc:158`): repeatedly take the globally best unmatched
+    (row, col) pair passing `threshold`. Returns (row→col, col→row), -1 for
+    unmatched. Gradient is zero (reference: ElemwiseGradUseNone)."""
+    if threshold is None:
+        from ..base import MXNetError
+
+        raise MXNetError("operator _contrib_bipartite_matching: required "
+                         "parameter 'threshold' is missing (reference "
+                         "bounding_box-inl.h:652 declares it without default)")
+    asc = parse_bool(is_ascend)
+    thr = float(threshold)
+    shape = data.shape
+    n, m = shape[-2], shape[-1]
+    flat = data.reshape((-1, n, m))
+    b = flat.shape[0]
+    scores = -flat if asc else flat
+    thr_s = -thr if asc else thr
+    k = n if int(topk) <= 0 else min(int(topk), n)
+
+    def match_one(s):
+        def step(carry, _):
+            s_cur, row_match, col_match = carry
+            idx = jnp.argmax(s_cur)
+            i, j = idx // m, idx % m
+            ok = s_cur[i, j] >= thr_s
+            row_match = jnp.where(ok, row_match.at[i].set(j), row_match)
+            col_match = jnp.where(ok, col_match.at[j].set(i), col_match)
+            s_cur = jnp.where(ok, s_cur.at[i, :].set(-jnp.inf), s_cur)
+            s_cur = jnp.where(ok, s_cur.at[:, j].set(-jnp.inf), s_cur)
+            return (s_cur, row_match, col_match), None
+
+        init = (s, jnp.full((n,), -1, jnp.int32), jnp.full((m,), -1, jnp.int32))
+        (_, rm, cm), _ = lax.scan(step, init, None, length=min(k, m))
+        return rm, cm
+
+    rm, cm = jax.vmap(match_one)(scores)
+    return (rm.reshape(shape[:-1]).astype(data.dtype),
+            cm.reshape(shape[:-2] + (m,)).astype(data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_DeformableConvolution")
+def _deformable_convolution(data, offset, weight, *maybe_bias, kernel=None,
+                            stride=None, dilate=None, pad=None, num_filter=None,
+                            num_group=1, num_deformable_group=1, no_bias=False,
+                            layout=None, workspace=1024, **kw):
+    """Deformable conv v1 (`deformable_convolution.cc:57`): each kernel tap
+    samples the input at its integer position plus a learned fractional
+    offset (bilinear), then a dense conv contraction — rendered as
+    offset-gather im2col (the reference's deformable_im2col) followed by one
+    MXU matmul."""
+    kh, kw_ = as_tuple(kernel)
+    sh, sw = as_tuple(stride, 2) or (1, 1)
+    dh, dw = as_tuple(dilate, 2) or (1, 1)
+    ph_, pw_ = as_tuple(pad, 2) or (0, 0)
+    groups = int(num_group)
+    dgroups = int(num_deformable_group)
+
+    n, c, h, w = data.shape
+    hout = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+    wout = (w + 2 * pw_ - dw * (kw_ - 1) - 1) // sw + 1
+
+    # base sampling grid per output position and tap: (kh*kw, Hout, Wout)
+    oy = jnp.arange(hout) * sh - ph_
+    ox = jnp.arange(wout) * sw - pw_
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw_) * dw
+    base_y = oy[None, None, :, None] + ky[:, None, None, None]   # (kh,1,Hout,1)
+    base_x = ox[None, None, None, :] + kx[None, :, None, None]   # (1,kw,1,Wout)
+    base_y = jnp.broadcast_to(base_y, (kh, kw_, hout, wout)).reshape(kh * kw_, hout, wout)
+    base_x = jnp.broadcast_to(base_x, (kh, kw_, hout, wout)).reshape(kh * kw_, hout, wout)
+
+    # offset: (N, 2*dg*kh*kw, Hout, Wout) — per tap (y, x) pairs
+    off = offset.reshape(n, dgroups, kh * kw_, 2, hout, wout)
+    samp_y = base_y[None, None] + off[:, :, :, 0]       # (N, dg, kh*kw, Hout, Wout)
+    samp_x = base_x[None, None] + off[:, :, :, 1]
+
+    cpg = c // dgroups                                   # channels per deformable group
+
+    def per_image(img, sy, sx):                          # img (C,H,W)
+        img_g = img.reshape(dgroups, cpg, h, w)
+
+        def per_dgroup(img_c, sy_g, sx_g):               # (cpg,H,W),(kh*kw,Ho,Wo)
+            def per_chan(im):
+                return _bilinear_gather(im, sy_g, sx_g)  # (kh*kw, Ho, Wo)
+            return jax.vmap(per_chan)(img_c)             # (cpg, kh*kw, Ho, Wo)
+
+        return jax.vmap(per_dgroup)(img_g, sy, sx)       # (dg, cpg, kh*kw, Ho, Wo)
+
+    cols = jax.vmap(per_image)(data, samp_y, samp_x)
+    cols = cols.reshape(n, c, kh * kw_, hout, wout)      # deformed im2col
+
+    # contraction: weight (num_filter, C/g, kh, kw)
+    f = int(num_filter)
+    wmat = weight.reshape(groups, f // groups, (c // groups) * kh * kw_)
+    cols_g = cols.reshape(n, groups, (c // groups) * kh * kw_, hout * wout)
+    out = jnp.einsum("gfk,ngkp->ngfp", wmat, cols_g,
+                     preferred_element_type=jnp.float32).astype(data.dtype)
+    out = out.reshape(n, f, hout, wout)
+    if not parse_bool(no_bias) and maybe_bias:
+        out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer
+# ---------------------------------------------------------------------------
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=None, transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=None, **kw):
+    """STN (`spatial_transformer.cc:170`): affine grid from loc (N, 6), then
+    bilinear sampling of data at the grid (normalized [-1,1] coords)."""
+    th, tw = as_tuple(target_shape)
+    n, c, h, w = data.shape
+    theta = loc.reshape(n, 2, 3)
+    # normalized target grid, endpoints inclusive in [-1, 1]
+    # (spatial_transformer-inl.h:98-101: -1 + i*2/(dim-1))
+    xs = -1.0 + jnp.arange(tw) * 2.0 / max(tw - 1, 1)
+    ys = -1.0 + jnp.arange(th) * 2.0 / max(th - 1, 1)
+    gx, gy = jnp.meshgrid(xs, ys)                       # (th, tw)
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=0).reshape(3, th * tw)
+    src = jnp.einsum("nij,jp->nip", theta, grid)        # (N, 2, th*tw)
+    sx = (src[:, 0] + 1.0) * (w - 1.0) / 2.0
+    sy = (src[:, 1] + 1.0) * (h - 1.0) / 2.0
+    sx = sx.reshape(n, th, tw)
+    sy = sy.reshape(n, th, tw)
+
+    def per_image(img, yy, xx):
+        return jax.vmap(lambda im: _bilinear_gather(im, yy, xx))(img)
+
+    return jax.vmap(per_image)(data, sy, sx)            # (N, C, th, tw)
+
+
+# ---------------------------------------------------------------------------
+# Correlation
+# ---------------------------------------------------------------------------
+
+
+@register("Correlation")
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True, **kw):
+    """FlowNet correlation (`correlation.cc:163`): for each displacement in
+    the neighborhood grid, sum (multiply or |diff|) over a kernel window and
+    all channels, normalized by kernel_size^2 * C."""
+    ks, md = int(kernel_size), int(max_displacement)
+    s1, s2, p = int(stride1), int(stride2), int(pad_size)
+    mult = parse_bool(is_multiply)
+    n, c, h, w = data1.shape
+    kr = (ks - 1) // 2
+    border = md + kr
+    ph_, pw_ = h + 2 * p, w + 2 * p
+    top_h = int(math.ceil(float(ph_ - 2 * border) / s1))
+    top_w = int(math.ceil(float(pw_ - 2 * border) / s1))
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    norm = float(ks * ks * c)
+
+    # centers of output positions in padded coords
+    cy = border + jnp.arange(top_h) * s1
+    cx = border + jnp.arange(top_w) * s1
+
+    outs = []
+    for dy in range(-ngr, ngr + 1):
+        for dx in range(-ngr, ngr + 1):
+            oy, ox = dy * s2, dx * s2
+            acc = 0.0
+            for uy in range(-kr, kr + 1):
+                for ux in range(-kr, kr + 1):
+                    a = d1[:, :, cy[:, None] + uy, cx[None, :] + ux]
+                    bidx_y = cy[:, None] + oy + uy
+                    bidx_x = cx[None, :] + ox + ux
+                    bval = d2[:, :, jnp.clip(bidx_y, 0, ph_ - 1),
+                              jnp.clip(bidx_x, 0, pw_ - 1)]
+                    inb = ((bidx_y >= 0) & (bidx_y < ph_) &
+                           (bidx_x >= 0) & (bidx_x < pw_))
+                    bval = jnp.where(inb[None, None], bval, 0.0)
+                    acc = acc + (a * bval if mult else jnp.abs(a - bval))
+            outs.append(acc.sum(axis=1) / norm)          # (N, top_h, top_w)
+    return jnp.stack(outs, axis=1)                       # (N, ngw*ngw, th, tw)
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput
+# ---------------------------------------------------------------------------
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, reg, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg, use_linear, res, ct):
+    data, label = res
+    n, k = data.shape
+    lab = label.astype(jnp.int32)
+    sign = jnp.where(jax.nn.one_hot(lab, k, dtype=data.dtype) > 0, 1.0, -1.0)
+    viol = sign * data < margin
+    if use_linear:
+        g = jnp.where(viol, -reg * sign, 0.0)
+    else:
+        g = jnp.where(viol, -2.0 * reg * sign * (margin - sign * data), 0.0)
+    return g.astype(data.dtype), jnp.zeros_like(label)
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput")
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False, **kw):
+    """SVM output layer (`svm_output.cc:89`): forward is identity; backward
+    is the one-vs-all hinge gradient (L1 when use_linear, else squared
+    hinge), scaled by regularization_coefficient."""
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient),
+                     bool(parse_bool(use_linear)))
+
+
+# ---------------------------------------------------------------------------
+# AdaptiveAvgPooling2D
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pooling(data, output_size=None, **kw):
+    """Adaptive average pooling (`adaptive_avg_pooling.cc:203`): bin i spans
+    [floor(i*H/H'), ceil((i+1)*H/H')) — a LINEAR map, so it's two matmuls
+    with per-axis averaging matrices (MXU-friendly, trivially differentiable)."""
+    n, c, h, w = data.shape
+    if output_size is None or output_size == [] or output_size == ():
+        oh, ow = h, w
+    else:
+        t = as_tuple(output_size)
+        oh, ow = (t[0], t[0]) if len(t) == 1 else (t[0], t[1])
+
+    def avg_matrix(out_dim, in_dim):
+        i = jnp.arange(out_dim)
+        lo = jnp.floor(i * in_dim / out_dim).astype(jnp.int32)
+        hi = jnp.ceil((i + 1) * in_dim / out_dim).astype(jnp.int32)
+        idx = jnp.arange(in_dim)
+        m = ((idx[None, :] >= lo[:, None]) & (idx[None, :] < hi[:, None]))
+        m = m.astype(data.dtype)
+        return m / m.sum(axis=1, keepdims=True)
+
+    mh = avg_matrix(oh, h)                               # (oh, H)
+    mw = avg_matrix(ow, w)                               # (ow, W)
+    return jnp.einsum("oh,nchw,pw->ncop", mh, data, mw)
+
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT (contrib)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_fft")
+def _fft(data, compute_size=128, **kw):
+    """contrib.fft (`fft.cc:43`): real input (..., d) → interleaved
+    [re, im, re, im, ...] (..., 2d)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register("_contrib_ifft")
+def _ifft(data, compute_size=128, **kw):
+    """contrib.ifft (`ifft.cc:44`): interleaved complex (..., 2d) → real
+    (..., d); reference does NOT normalize by d (cuFFT inverse is unscaled)."""
+    d = data.shape[-1] // 2
+    x = data.astype(jnp.float32).reshape(data.shape[:-1] + (d, 2))
+    comp = x[..., 0] + 1j * x[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1).real * d
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# count_sketch
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_count_sketch")
+def _count_sketch(data, h, s, out_dim=None, processing_batch_size=32, **kw):
+    """Count sketch projection (`count_sketch.cc:45`): out[:, h[i]] +=
+    s[i] * data[:, i] — a signed scatter-add over the feature axis."""
+    od = int(out_dim)
+    n, d = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((n, od), data.dtype)
+    return out.at[:, hh].add(data * ss[None, :])
+
+
+# ---------------------------------------------------------------------------
+# ravel / unravel
+# ---------------------------------------------------------------------------
+
+
+@register("_ravel_multi_index", aliases=["ravel_multi_index"])
+def _ravel_multi_index_op(data, shape=None, **kw):
+    """(`src/operator/tensor/ravel.cc`): data (k, n) of k-dim indices →
+    flat indices (n,) under row-major `shape`."""
+    dims = as_tuple(shape)
+    strides = []
+    acc = 1
+    for d in reversed(dims):
+        strides.append(acc)
+        acc *= int(d)
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return (data * strides[:, None]).sum(axis=0)
+
+
+@register("_unravel_index", aliases=["unravel_index"])
+def _unravel_index_op(data, shape=None, **kw):
+    """Flat indices (n,) → multi-indices (k, n) under row-major `shape`."""
+    dims = as_tuple(shape)
+    idx = data.astype(jnp.int32)
+    outs = []
+    for d in reversed(dims):
+        outs.append(idx % int(d))
+        idx = idx // int(d)
+    return jnp.stack(list(reversed(outs)), axis=0).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# MultiBox (SSD) family
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_MultiBoxPrior")
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """Anchor generation (`multibox_prior.cc:98`): for a (N, C, H, W) feature
+    map emit (1, H*W*(S+R-1), 4) corner-format anchors; first size with every
+    ratio, remaining sizes with ratio[0]."""
+    sizes = list(as_float_tuple(sizes))
+    ratios = list(as_float_tuple(ratios))
+    st = list(as_float_tuple(steps, 2))
+    off = list(as_float_tuple(offsets, 2))
+    h, w = data.shape[2], data.shape[3]
+    step_y = st[0] if st[0] > 0 else 1.0 / h
+    step_x = st[1] if st[1] > 0 else 1.0 / w
+
+    cy = (jnp.arange(h) + off[0]) * step_y
+    cx = (jnp.arange(w) + off[1]) * step_x
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")         # (H, W)
+
+    # reference ordering (multibox_prior.cc:49-72): all sizes with ratio[0]
+    # first, then ratios[1:] with size[0]; width carries the H/W aspect
+    # correction (w = size * H/W * sqrt(r), h = size / sqrt(r))
+    aspect = h / w
+    whs = []
+    r0 = math.sqrt(ratios[0]) if ratios else 1.0
+    for s in sizes:
+        whs.append((s * aspect * r0, s / r0))
+    for r in ratios[1:]:
+        sr = math.sqrt(r)
+        whs.append((sizes[0] * aspect * sr, sizes[0] / sr))
+    ws = jnp.asarray([p[0] for p in whs]) / 2.0          # half-extents
+    hs = jnp.asarray([p[1] for p in whs]) / 2.0
+
+    x1 = gx[:, :, None] - ws[None, None, :]
+    y1 = gy[:, :, None] - hs[None, None, :]
+    x2 = gx[:, :, None] + ws[None, None, :]
+    y2 = gy[:, :, None] + hs[None, None, :]
+    anchors = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(1, -1, 4)
+    if parse_bool(clip):
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors.astype(data.dtype)
+
+
+@register("_contrib_MultiBoxTarget", num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """SSD target matching (`multibox_target.cc`): per batch, match each
+    anchor to ground truth (best-anchor-per-gt forced + IoU threshold),
+    emit (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N)).
+    Negative mining keeps the top (ratio * #pos) hardest negatives by
+    background confidence; others get ignore_label."""
+    var = list(as_float_tuple(variances, 4))
+    na = anchor.shape[1]
+    b, ng = label.shape[0], label.shape[1]
+    anc = anchor.reshape(na, 4)
+    anc_cx = (anc[:, 0] + anc[:, 2]) / 2
+    anc_cy = (anc[:, 1] + anc[:, 3]) / 2
+    anc_w = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-8)
+    anc_h = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-8)
+
+    def one(lab, cpred):
+        gt_valid = lab[:, 0] >= 0                        # (ng,)
+        gt_boxes = lab[:, 1:5]
+        iou = _pair_iou(anc[None], gt_boxes[None])[0]    # (na, ng)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        # anchor's best gt
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.take_along_axis(iou, best_gt[:, None], axis=1)[:, 0]
+        matched = best_iou >= float(overlap_threshold)
+        # force best anchor per gt
+        best_anchor = jnp.argmax(iou, axis=0)            # (ng,)
+        forced = jnp.zeros((na,), bool).at[best_anchor].set(gt_valid)
+        forced_gt = jnp.zeros((na,), jnp.int32).at[best_anchor].set(
+            jnp.arange(ng, dtype=jnp.int32))
+        use_gt = jnp.where(forced, forced_gt, best_gt)
+        pos = matched | forced
+
+        g = gt_boxes[use_gt]                             # (na, 4)
+        g_cx = (g[:, 0] + g[:, 2]) / 2
+        g_cy = (g[:, 1] + g[:, 3]) / 2
+        g_w = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+        g_h = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+        tx = (g_cx - anc_cx) / anc_w / var[0]
+        ty = (g_cy - anc_cy) / anc_h / var[1]
+        tw = jnp.log(g_w / anc_w) / var[2]
+        th = jnp.log(g_h / anc_h) / var[3]
+        bt = jnp.stack([tx, ty, tw, th], axis=-1)        # (na, 4)
+        bt = jnp.where(pos[:, None], bt, 0.0)
+        bm = jnp.broadcast_to(pos[:, None], (na, 4)).astype(bt.dtype)
+
+        cls_t = jnp.where(pos, lab[use_gt, 0] + 1.0, 0.0)
+        if float(negative_mining_ratio) > 0:
+            # hardest negatives = highest non-background max-prob... the
+            # reference ranks by background confidence ascending; emulate
+            # with -cpred[0] (background score) as hardness
+            bg_conf = cpred[0]                           # (na,)
+            hardness = jnp.where(pos, -jnp.inf, -bg_conf)
+            n_pos = pos.sum()
+            n_neg = jnp.maximum(
+                (float(negative_mining_ratio) * n_pos).astype(jnp.int32),
+                int(minimum_negative_samples))
+            order = jnp.argsort(-hardness)
+            rank = jnp.zeros((na,), jnp.int32).at[order].set(jnp.arange(na))
+            keep_neg = (~pos) & (rank < n_neg)
+            cls_t = jnp.where(pos | keep_neg, cls_t, float(ignore_label))
+        return bt.reshape(-1), bm.reshape(-1), cls_t
+
+    bt, bm, ct = jax.vmap(one)(label, cls_pred)
+    return bt.astype(anchor.dtype), bm.astype(anchor.dtype), ct.astype(anchor.dtype)
+
+
+@register("_contrib_MultiBoxDetection")
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kw):
+    """SSD decode + NMS (`multibox_detection.cc`): decode loc_pred against
+    anchors with variances, take per-anchor argmax class (excluding
+    background), threshold, NMS → (B, N, 6) rows [cls, score, x1, y1, x2, y2]."""
+    var = list(as_float_tuple(variances, 4))
+    b, nc, na = cls_prob.shape
+    anc = anchor.reshape(na, 4)
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-8)
+    ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-8)
+
+    loc = loc_pred.reshape(b, na, 4)
+    cx = loc[:, :, 0] * var[0] * aw + acx
+    cy = loc[:, :, 1] * var[1] * ah + acy
+    bw = jnp.exp(loc[:, :, 2] * var[2]) * aw / 2
+    bh = jnp.exp(loc[:, :, 3] * var[3]) * ah / 2
+    x1, y1, x2, y2 = cx - bw, cy - bh, cx + bw, cy + bh
+    if parse_bool(clip):
+        x1, y1 = jnp.clip(x1, 0, 1), jnp.clip(y1, 0, 1)
+        x2, y2 = jnp.clip(x2, 0, 1), jnp.clip(y2, 0, 1)
+
+    # per-anchor best foreground class
+    probs = cls_prob.at[:, int(background_id), :].set(-1.0)
+    best_c = jnp.argmax(probs, axis=1)                   # (B, na)
+    best_p = jnp.take_along_axis(probs, best_c[:, None, :], axis=1)[:, 0]
+    fg = best_p > float(threshold)
+    # reference reports class index minus one UNCONDITIONALLY
+    # (multibox_detection.cc:126 `outputs[i*6] = id - 1`) — with a nonzero
+    # background_id, class 0 collides with the -1 sentinel there too; we
+    # reproduce the reference contract exactly
+    cls_id = jnp.where(fg, best_c.astype(cls_prob.dtype) - 1.0, -1.0)
+
+    rows = jnp.stack([cls_id, jnp.where(fg, best_p, -1.0), x1, y1, x2, y2], axis=-1)
+    return _box_nms_diff(rows, float(nms_threshold), 0.0, int(nms_topk), 2, 1,
+                         0, -1, bool(parse_bool(force_suppress)), "corner",
+                         "corner")

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import pickle
 import warnings
 
@@ -22,6 +23,14 @@ import numpy
 from ..base import MXNetError
 from ..ndarray import NDArray, zeros, ones, full
 from .. import ndarray as nd
+
+
+def _is_low_precision(dtype):
+    """True for dtypes that want a fp32 master copy under multi_precision.
+    The reference checks fp16 only (`optimizer.py:230`); on TPU the native
+    half type is bfloat16, so it gets the same master-copy treatment."""
+    name = numpy.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    return name in ("float16", "bfloat16")
 
 __all__ = [
     "Optimizer", "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD", "Adam", "AdaGrad",
@@ -89,10 +98,10 @@ class Optimizer:
         """Create aux state + fp32 master copy when multi_precision and
         weight is fp16 (parity :230)."""
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             weight_master_copy = weight.astype(numpy.float32)
             return (weight_master_copy,) + (self.create_state(index, weight_master_copy),)
-        if weight.dtype == numpy.float16 and not self.multi_precision:
+        if _is_low_precision(weight.dtype) and not self.multi_precision:
             warnings.warn("Accumulating with float16 in optimizer can lead to "
                           "poor accuracy or slow convergence. "
                           "Consider using multi_precision=True option of the "
@@ -104,7 +113,7 @@ class Optimizer:
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             weight_master_copy = state[0]
             original_state = state[1]
             grad32 = grad.astype(numpy.float32)
@@ -268,13 +277,17 @@ class SGD(Optimizer):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
+        # fused multi-weight updates (reference optimizer.py:530: aggregation
+        # over MXNET_OPTIMIZER_AGGREGATION_SIZE weights per multi_sgd_* call)
+        self.aggregate_num = max(1, min(
+            60, int(os.getenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4"))))
 
     def create_state_multi_precision(self, index, weight):
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             weight_master_copy = weight.astype(numpy.float32)
             return (self.create_state(index, weight_master_copy), weight_master_copy)
-        if weight.dtype == numpy.float16 and not self.multi_precision:
+        if _is_low_precision(weight.dtype) and not self.multi_precision:
             warnings.warn("Accumulating with float16 in optimizer can lead to "
                           "poor accuracy or slow convergence. "
                           "Consider using multi_precision=True option of the "
@@ -288,6 +301,10 @@ class SGD(Optimizer):
         return momentum
 
     def _update_impl(self, index, weight, grad, state, multi_precision=False):
+        aggregate = isinstance(index, (list, tuple))
+        if aggregate:
+            return self._update_aggregate(index, weight, grad, state,
+                                          multi_precision)
         use_multi_precision = multi_precision and isinstance(state, (list, tuple))
         self._update_count(index)
         lr = self._get_lr(index)
@@ -321,11 +338,48 @@ class SGD(Optimizer):
                 nd.mp_sgd_update(weight, grad, state[1], out=weight,
                                  lazy_update=self.lazy_update, **kwargs)
 
+    def _update_aggregate(self, indices, weights, grads, states,
+                          multi_precision):
+        """One fused multi_sgd_* call over a group of weights (reference
+        optimizer.py:559-595 aggregate branch → `optimizer_op.cc`
+        MultiSGDUpdate): a single XLA program streams every (weight, grad,
+        state) through HBM, amortizing dispatch over the group."""
+        self._update_count(indices)
+        lrs = self._get_lrs(indices)
+        wds = self._get_wds(indices)
+        kwargs = {"rescale_grad": self.rescale_grad, "lrs": lrs, "wds": wds,
+                  "num_weights": len(indices)}
+        if self.momentum > 0:
+            kwargs["momentum"] = self.momentum
+        if self.clip_gradient:
+            kwargs["clip_gradient"] = self.clip_gradient
+        if not multi_precision:
+            if self.momentum > 0:
+                data = _flatten_list(zip(weights, grads, states))
+                nd.multi_sgd_mom_update(*data, out=list(weights), **kwargs)
+            else:
+                data = _flatten_list(zip(weights, grads))
+                nd.multi_sgd_update(*data, out=list(weights), **kwargs)
+        else:
+            if self.momentum > 0:
+                data = _flatten_list(
+                    (w, g, s[0], s[1]) for w, g, s in zip(weights, grads, states))
+                nd.multi_mp_sgd_mom_update(*data, out=list(weights), **kwargs)
+            else:
+                data = _flatten_list(
+                    (w, g, s[1]) for w, g, s in zip(weights, grads, states))
+                nd.multi_mp_sgd_update(*data, out=list(weights), **kwargs)
+
     def update(self, index, weight, grad, state):
         self._update_impl(index, weight, grad, state, multi_precision=False)
 
     def update_multi_precision(self, index, weight, grad, state):
-        use_multi_precision = self.multi_precision and weight.dtype == numpy.float16
+        if isinstance(index, (list, tuple)):
+            use_multi_precision = self.multi_precision and \
+                _is_low_precision(weight[0].dtype)
+        else:
+            use_multi_precision = self.multi_precision and \
+                _is_low_precision(weight.dtype)
         self._update_impl(index, weight, grad, state,
                           multi_precision=use_multi_precision)
 
@@ -935,8 +989,41 @@ class Updater:
                 self.states[idx] = self.sync_state_context(self.states[idx],
                                                            weights[i].context)
                 self.states_synced[idx] = True
+        if self.aggregate_updates and len(indices) > 1:
+            self._aggregated_update(indices, grads, weights)
+            return
+        for i, idx in enumerate(indices):
             self.optimizer.update_multi_precision(idx, weights[i], grads[i],
                                                   self.states[idx])
+
+    def _aggregated_update(self, indices, grads, weights):
+        """Group same-dtype dense updates into multi_sgd_*-sized chunks
+        (parity optimizer.py:1637-1664: the aggregate_updates branch of
+        Updater.__call__; dtype segregation then aggregate_num chunking)."""
+        from ..ndarray.sparse import RowSparseNDArray
+
+        by_type = {}
+        order = []
+        for idx, g, w in zip(indices, grads, weights):
+            if isinstance(g, RowSparseNDArray):
+                # sparse updates keep the per-key lazy path
+                self.optimizer.update_multi_precision(idx, w, g,
+                                                      self.states[idx])
+                continue
+            key = str(w.dtype)
+            if key not in by_type:
+                by_type[key] = []
+                order.append(key)
+            by_type[key].append((idx, g, w))
+        step = self.optimizer.aggregate_num
+        for key in order:
+            group = by_type[key]
+            for start in range(0, len(group), step):
+                chunk = group[start:start + step]
+                idxs = [c[0] for c in chunk]
+                self.optimizer.update_multi_precision(
+                    idxs, [c[2] for c in chunk], [c[1] for c in chunk],
+                    [self.states[i] for i in idxs])
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):

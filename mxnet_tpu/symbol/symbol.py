@@ -56,7 +56,7 @@ def _op_input_spec(op):
                 open_varargs = True
     if op.needs_rng and required and required[0] == "key":
         required = required[1:]
-    aux = tuple(op.mutate_aux or ())
+    aux = () if callable(op.mutate_aux) else tuple(op.mutate_aux or ())
     return required, optional, open_varargs, aux
 
 
@@ -84,7 +84,10 @@ class _Node:
     def aux_input_indices(self):
         if self.is_variable:
             return ()
-        return tuple(_reg.get_op(self.op).mutate_aux or ())
+        aux = _reg.get_op(self.op).mutate_aux
+        if callable(aux):
+            aux = aux({k: v for k, v in self.attrs.items()})
+        return tuple(aux or ())
 
 
 def _topo_order(head_nodes):

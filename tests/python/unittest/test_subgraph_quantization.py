@@ -84,9 +84,9 @@ def test_env_backend_applied_at_bind(monkeypatch):
     net = _conv_bn_relu_net()
     monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "TPU_FUSE")
     ex = net.simple_bind(grad_req="null", data=(1, 3, 8, 8))
-    ops = [n.op for n in ex._symbol._nodes() if n.op] \
-        if hasattr(ex, "_symbol") else None
-    # binding must succeed and produce finite output either way
+    # the bound executor must be running the REWRITTEN graph
+    bound_ops = [n.op for n in ex._symbol._nodes() if n.op]
+    assert "_fused_conv_bn_relu" in bound_ops, bound_ops
     out = ex.forward(is_train=False,
                      data=nd.ones((1, 3, 8, 8)))[0].asnumpy()
     assert np.isfinite(out).all()

@@ -1,0 +1,310 @@
+"""Detection/vision op family tests (reference corpus:
+`tests/python/unittest/test_operator.py` test_roi_align / test_box_nms /
+test_bipartite_matching / test_correlation etc.)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_roi_align_forward_uniform():
+    # constant feature map → every pooled value equals the constant
+    data = mx.nd.ones((1, 2, 8, 8)) * 3.0
+    rois = mx.nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    out = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                 spatial_scale=1.0, sample_ratio=2)
+    assert out.shape == (1, 2, 2, 2)
+    assert np.allclose(out.asnumpy(), 3.0, atol=1e-5)
+
+
+def test_roi_align_gradient():
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 1, 1, 4, 4]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(3, 3),
+                                     spatial_scale=1.0, sample_ratio=2)
+        loss = (out * out).sum()
+    loss.backward()
+    g = data.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_roi_align_position_sensitive():
+    # C = c_out * ph * pw with distinct per-channel constants: PS pooling
+    # must read channel c*ph*pw + i*pw + j at bin (i, j)
+    ph = pw = 2
+    c_out = 1
+    c = c_out * ph * pw
+    data = np.zeros((1, c, 4, 4), np.float32)
+    for ch in range(c):
+        data[0, ch] = ch + 1
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = mx.nd.contrib.ROIAlign(mx.nd.array(data), mx.nd.array(rois),
+                                 pooled_size=(ph, pw), spatial_scale=1.0,
+                                 sample_ratio=2, position_sensitive=True)
+    got = out.asnumpy()[0, 0]
+    assert np.allclose(got, [[1, 2], [3, 4]], atol=1e-4), got
+
+
+def test_roi_pooling_forward():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0)
+    # max over each 2x2 quadrant
+    assert np.allclose(out.asnumpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_roi_pooling_gradient_flows():
+    rng = np.random.RandomState(1)
+    data = mx.nd.array(rng.randn(1, 2, 4, 4).astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+        out.sum().backward()
+    g = data.grad.asnumpy()
+    # exactly one max location per bin per channel gets gradient 1
+    assert g.sum() == pytest.approx(2 * 4)
+
+
+def test_box_nms_reference_example():
+    # the documented example from bounding_box.cc:36
+    x = np.array([[0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                  [1, 0.4, 0.1, 0.1, 0.2, 0.2],
+                  [0, 0.3, 0.1, 0.1, 0.14, 0.14],
+                  [2, 0.6, 0.5, 0.5, 0.7, 0.8]], np.float32)
+    out = mx.nd.contrib.box_nms(mx.nd.array(x), overlap_thresh=0.1,
+                                coord_start=2, score_index=1, id_index=0,
+                                force_suppress=True)
+    expect = np.array([[2, 0.6, 0.5, 0.5, 0.7, 0.8],
+                       [0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                       [-1, -1, -1, -1, -1, -1],
+                       [-1, -1, -1, -1, -1, -1]], np.float32)
+    assert np.allclose(out.asnumpy(), expect, atol=1e-5)
+
+
+def test_box_nms_gradient_scatter():
+    # gradients ride back to the ORIGINAL rows (bounding_box.cc example)
+    x = np.array([[0, 0.5, 0.1, 0.1, 0.2, 0.2],
+                  [1, 0.4, 0.1, 0.1, 0.2, 0.2],
+                  [0, 0.3, 0.1, 0.1, 0.14, 0.14],
+                  [2, 0.6, 0.5, 0.5, 0.7, 0.8]], np.float32)
+    xa = mx.nd.array(x)
+    xa.attach_grad()
+    og = np.tile(np.array([[0.1], [0.2], [0.3], [0.4]], np.float32), (1, 6))
+    with autograd.record():
+        out = mx.nd.contrib.box_nms(xa, overlap_thresh=0.1, coord_start=2,
+                                    score_index=1, id_index=0,
+                                    force_suppress=True)
+    out.backward(mx.nd.array(og))
+    expect = np.tile(np.array([[0.2], [0.0], [0.0], [0.1]], np.float32), (1, 6))
+    assert np.allclose(xa.grad.asnumpy(), expect, atol=1e-6)
+
+
+def test_box_iou():
+    a = np.array([[0.0, 0.0, 2.0, 2.0]], np.float32)
+    b = np.array([[1.0, 1.0, 3.0, 3.0], [0.0, 0.0, 2.0, 2.0]], np.float32)
+    out = mx.nd.contrib.box_iou(mx.nd.array(a), mx.nd.array(b))
+    assert np.allclose(out.asnumpy(), [[1.0 / 7.0, 1.0]], atol=1e-5)
+
+
+def test_bipartite_matching_reference_example():
+    s = np.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]], np.float32)
+    x, y = mx.nd.contrib.bipartite_matching(mx.nd.array(s), threshold=1e-12,
+                                            is_ascend=False)
+    assert np.allclose(x.asnumpy(), [1, -1, 0])
+    assert np.allclose(y.asnumpy(), [2, 0])
+
+
+def test_deformable_convolution_zero_offset_matches_conv():
+    rng = np.random.RandomState(2)
+    data = rng.randn(1, 3, 7, 7).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 5, 5), np.float32)
+    out_d = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(data), mx.nd.array(off), mx.nd.array(w),
+        kernel=(3, 3), num_filter=4, no_bias=True)
+    out_c = mx.nd.Convolution(mx.nd.array(data), mx.nd.array(w),
+                              kernel=(3, 3), num_filter=4, no_bias=True)
+    assert np.allclose(out_d.asnumpy(), out_c.asnumpy(), atol=1e-4)
+
+
+def test_deformable_convolution_gradient():
+    rng = np.random.RandomState(3)
+    data = mx.nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+    off = mx.nd.array(0.1 * rng.randn(1, 8, 2, 2).astype(np.float32))
+    w = mx.nd.array(rng.randn(2, 2, 2, 2).astype(np.float32))
+    for v in (data, off, w):
+        v.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.DeformableConvolution(
+            data, off, w, kernel=(2, 2), stride=(2, 2), num_filter=2,
+            no_bias=True)
+        (out * out).sum().backward()
+    for v in (data, off, w):
+        assert np.isfinite(v.grad.asnumpy()).all()
+        assert np.abs(v.grad.asnumpy()).sum() > 0
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(4)
+    data = rng.randn(2, 3, 6, 6).astype(np.float32)
+    # identity affine
+    loc = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(data), mx.nd.array(loc),
+                                   target_shape=(6, 6),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    assert np.allclose(out.asnumpy(), data, atol=1e-4)
+
+
+def test_spatial_transformer_gradient():
+    rng = np.random.RandomState(5)
+    data = mx.nd.array(rng.randn(1, 1, 5, 5).astype(np.float32))
+    loc = mx.nd.array(np.array([[0.9, 0.1, 0.05, -0.1, 0.8, 0.0]], np.float32))
+    data.attach_grad()
+    loc.attach_grad()
+    with autograd.record():
+        out = mx.nd.SpatialTransformer(data, loc, target_shape=(4, 4),
+                                       transform_type="affine",
+                                       sampler_type="bilinear")
+        (out * out).sum().backward()
+    assert np.abs(loc.grad.asnumpy()).sum() > 0
+    assert np.abs(data.grad.asnumpy()).sum() > 0
+
+
+def test_correlation_self_is_squared_norm():
+    rng = np.random.RandomState(6)
+    a = rng.randn(1, 4, 8, 8).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(a), mx.nd.array(a), kernel_size=1,
+                            max_displacement=0, stride1=1, stride2=1,
+                            pad_size=0, is_multiply=True)
+    # zero displacement, k=1: out = mean_c a^2
+    expect = (a * a).mean(axis=1, keepdims=True)
+    assert out.shape == (1, 1, 8, 8)
+    assert np.allclose(out.asnumpy(), expect, atol=1e-4)
+
+
+def test_correlation_shapes_and_grad():
+    rng = np.random.RandomState(7)
+    a = mx.nd.array(rng.randn(1, 2, 8, 8).astype(np.float32))
+    b = mx.nd.array(rng.randn(1, 2, 8, 8).astype(np.float32))
+    a.attach_grad(); b.attach_grad()
+    with autograd.record():
+        out = mx.nd.Correlation(a, b, kernel_size=3, max_displacement=2,
+                                stride1=1, stride2=1, pad_size=3,
+                                is_multiply=True)
+        out.sum().backward()
+    assert out.shape[1] == 25  # (2*2+1)^2 displacement channels
+    assert np.abs(a.grad.asnumpy()).sum() > 0
+    assert np.abs(b.grad.asnumpy()).sum() > 0
+
+
+def test_svm_output():
+    x = mx.nd.array(np.array([[0.2, 0.8, -0.5], [1.5, -0.3, 0.1]], np.float32))
+    y = mx.nd.array(np.array([1, 0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = mx.nd.SVMOutput(x, y, margin=1.0,
+                              regularization_coefficient=0.5, use_linear=True)
+    assert np.allclose(out.asnumpy(), x.asnumpy())  # forward identity
+    out.backward(mx.nd.ones(x.shape))
+    g = x.grad.asnumpy()
+    # class 1 of row 0: sign=+1, x=0.8 < 1 → violation → grad -0.5
+    assert g[0, 1] == pytest.approx(-0.5)
+    # class 0 of row 0: sign=-1, -x=-0.2 < 1 → violation → grad +0.5
+    assert g[0, 0] == pytest.approx(0.5)
+
+
+def test_adaptive_avg_pooling():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(mx.nd.array(data), output_size=(2, 2))
+    expect = np.array([[[[2.5, 4.5], [10.5, 12.5]]]], np.float32)
+    assert np.allclose(out.asnumpy(), expect)
+    # adaptive to same size = identity
+    out2 = mx.nd.contrib.AdaptiveAvgPooling2D(mx.nd.array(data), output_size=(4, 4))
+    assert np.allclose(out2.asnumpy(), data)
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(8)
+    x = rng.randn(3, 8).astype(np.float32)
+    f = mx.nd.contrib.fft(mx.nd.array(x))
+    assert f.shape == (3, 16)
+    # interleaved layout vs numpy oracle
+    ref = np.fft.fft(x, axis=-1)
+    got = f.asnumpy().reshape(3, 8, 2)
+    assert np.allclose(got[..., 0], ref.real, atol=1e-3)
+    assert np.allclose(got[..., 1], ref.imag, atol=1e-3)
+    # reference ifft is unscaled (cuFFT): ifft(fft(x)) == n * x
+    back = mx.nd.contrib.ifft(f)
+    assert np.allclose(back.asnumpy(), 8 * x, atol=1e-2)
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    h = np.array([0, 1, 0], np.float32)
+    s = np.array([1, -1, 1], np.float32)
+    out = mx.nd.contrib.count_sketch(mx.nd.array(x), mx.nd.array(h),
+                                     mx.nd.array(s), out_dim=2)
+    assert np.allclose(out.asnumpy(), [[4.0, -2.0]])
+
+
+def test_ravel_unravel():
+    idx = np.array([[0, 1, 2], [3, 2, 1]], np.float32)  # (k=2, n=3)
+    flat = mx.nd.ravel_multi_index(mx.nd.array(idx), shape=(4, 5))
+    ref = np.ravel_multi_index(idx.astype(np.int64), (4, 5))
+    assert np.allclose(flat.asnumpy(), ref)
+    back = mx.nd.unravel_index(flat, shape=(4, 5))
+    assert np.allclose(back.asnumpy(), idx)
+
+
+def test_multibox_prior():
+    data = mx.nd.zeros((1, 3, 4, 4))
+    anchors = mx.nd.contrib.MultiBoxPrior(data, sizes=[0.5, 0.25],
+                                          ratios=[1, 2], clip=True)
+    # H*W*(S+R-1) = 16*3 anchors
+    assert anchors.shape == (1, 48, 4)
+    a = anchors.asnumpy()
+    assert (a >= 0).all() and (a <= 1).all()
+    # unclipped: first anchor centered at (0.5/4, 0.5/4) with size 0.5
+    raw = mx.nd.contrib.MultiBoxPrior(data, sizes=[0.5, 0.25],
+                                      ratios=[1, 2], clip=False).asnumpy()
+    first = raw[0, 0]
+    assert np.allclose(first, [0.125 - 0.25, 0.125 - 0.25,
+                               0.125 + 0.25, 0.125 + 0.25], atol=1e-5)
+
+
+def test_multibox_target_and_detection():
+    anchors = mx.nd.contrib.MultiBoxPrior(mx.nd.zeros((1, 3, 4, 4)),
+                                          sizes=[0.4], ratios=[1])
+    na = anchors.shape[1]
+    # one gt box matching the center anchor
+    label = np.full((1, 2, 5), -1.0, np.float32)
+    label[0, 0] = [0, 0.3, 0.3, 0.7, 0.7]
+    cls_pred = np.zeros((1, 3, na), np.float32)
+    bt, bm, ct = mx.nd.contrib.MultiBoxTarget(anchors, mx.nd.array(label),
+                                              mx.nd.array(cls_pred))
+    assert bt.shape == (1, na * 4) and bm.shape == (1, na * 4)
+    assert ct.shape == (1, na)
+    ctn = ct.asnumpy()
+    assert (ctn == 1).sum() >= 1          # at least the forced match
+    assert bm.asnumpy().sum() >= 4        # its 4 coords unmasked
+
+    # decode back through MultiBoxDetection: perfect loc_pred reconstructs gt
+    cls_prob = np.zeros((1, 3, na), np.float32)
+    cls_prob[0, 1, :] = 0.9               # class 0 foreground everywhere
+    loc_pred = bt.asnumpy().copy()
+    out = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred.reshape(1, -1)), anchors,
+        nms_threshold=0.99)
+    dets = out.asnumpy()[0]
+    kept = dets[dets[:, 0] >= 0]
+    assert len(kept) >= 1
+    # the matched anchor decodes exactly to the gt box
+    err = np.abs(kept[:, 2:6] - np.array([0.3, 0.3, 0.7, 0.7])).min(axis=0 if kept.ndim == 1 else 0)
+    assert (np.abs(kept[:, 2:6] - np.array([0.3, 0.3, 0.7, 0.7])).sum(axis=1).min()) < 1e-3
