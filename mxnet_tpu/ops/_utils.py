@@ -32,41 +32,35 @@ def reduce_axes(axis, ndim, exclude=False):
     return axes
 
 
-def as_tuple(v, n=None, name="param"):
-    """Parse MXNet-style Shape params: int | tuple | str '(1, 2)'."""
+def _parse_tuple(v, n, cast, scalars):
+    """Shared parser for MXNet tuple params: scalar | sequence | str
+    '(a, b)'; broadcasts a scalar/length-1 value to length n."""
     if v is None:
         return None
     if isinstance(v, str):
         v = v.strip()
         if v.startswith("(") or v.startswith("["):
             v = v[1:-1]
-        v = tuple(int(x) for x in v.replace(",", " ").split() if x)
-    elif isinstance(v, (int, np.integer)):
-        v = (int(v),) if n is None else (int(v),) * n
+        v = tuple(cast(x) for x in v.replace(",", " ").split() if x)
+    elif isinstance(v, scalars):
+        v = (cast(v),) if n is None else (cast(v),) * n
     else:
-        v = tuple(int(x) for x in v)
+        v = tuple(cast(x) for x in v)
     if n is not None and len(v) == 1:
         v = v * n
     return v
+
+
+def as_tuple(v, n=None, name="param"):
+    """Parse MXNet-style Shape params: int | tuple | str '(1, 2)'."""
+    return _parse_tuple(v, n, int, (int, np.integer))
 
 
 def as_float_tuple(v, n=None):
     """Parse MXNet-style float-tuple params: float | tuple | str '(0.1, 0.2)'
     (the dmlc Tuple<float> fields, e.g. MultiBoxPrior sizes/ratios)."""
-    if v is None:
-        return None
-    if isinstance(v, str):
-        v = v.strip()
-        if v.startswith("(") or v.startswith("["):
-            v = v[1:-1]
-        v = tuple(float(x) for x in v.replace(",", " ").split() if x)
-    elif isinstance(v, (int, float, np.integer, np.floating)):
-        v = (float(v),) if n is None else (float(v),) * n
-    else:
-        v = tuple(float(x) for x in v)
-    if n is not None and len(v) == 1:
-        v = v * n
-    return v
+    return _parse_tuple(v, n, float,
+                        (int, float, np.integer, np.floating))
 
 
 def parse_bool(v):

@@ -112,16 +112,18 @@ def _where(condition, x, y, **kw):
     return jnp.where(condition.astype(bool), x, y)
 
 
-@register("_contrib_boolean_mask", aliases=["contrib_boolean_mask"])
+@register("_contrib_boolean_mask", aliases=["contrib_boolean_mask"],
+          eager_only=True)
 def _boolean_mask(data, index, axis=0, **kw):
-    # Dynamic-shape op: XLA needs static shapes, so we return a dense result
-    # compacted to the front with zero padding plus count is not exposed;
-    # eager-only op (documented divergence; reference boolean_mask.cc).
+    # Dynamic-shape op: the output extent is data-dependent, which XLA
+    # cannot compile — so this op runs EAGERLY (eager_only skips the one-op
+    # jit cache) and is rejected inside traced graphs (documented
+    # divergence; reference boolean_mask.cc).
     mask = index.astype(bool)
     return jnp.compress(mask, data, axis=int(axis))
 
 
-@register("ravel_multi_index")
+@register("ravel_multi_index", aliases=["_ravel_multi_index"])
 def _ravel_multi_index(data, shape=None, **kw):
     from ._utils import as_tuple
 
@@ -134,7 +136,7 @@ def _ravel_multi_index(data, shape=None, **kw):
     return out
 
 
-@register("unravel_index")
+@register("unravel_index", aliases=["_unravel_index"])
 def _unravel_index(data, shape=None, **kw):
     from ._utils import as_tuple
 

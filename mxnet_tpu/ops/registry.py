@@ -28,10 +28,10 @@ class Op:
     """A registered operator."""
 
     __slots__ = ("name", "fn", "num_outputs", "mutate_aux", "wrap_kwargs", "doc", "needs_rng",
-                 "needs_mode", "tensor_opts", "sparse_vjp", "_schema_cache")
+                 "needs_mode", "tensor_opts", "sparse_vjp", "eager_only", "_schema_cache")
 
     def __init__(self, name, fn, num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
-                 needs_mode=False, tensor_opts=(), sparse_vjp=None):
+                 needs_mode=False, tensor_opts=(), sparse_vjp=None, eager_only=False):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
@@ -62,6 +62,11 @@ class Op:
         # cotangents for this op instead of dense ones; returning None keeps
         # the dense jax.vjp path.
         self.sparse_vjp = sparse_vjp
+        # data-dependent output shape (boolean_mask): XLA cannot compile it,
+        # so the op runs un-jitted on concrete arrays and raises inside
+        # traced graphs (documented divergence from the reference's
+        # dynamic-shape support on CPU)
+        self.eager_only = eager_only
         self._schema_cache = None
         self.doc = fn.__doc__
 
@@ -75,13 +80,13 @@ class Op:
 
 
 def register(name, aliases=(), num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
-             needs_mode=False, tensor_opts=(), sparse_vjp=None):
+             needs_mode=False, tensor_opts=(), sparse_vjp=None, eager_only=False):
     """Decorator: register a jax fn as operator ``name`` (+ aliases)."""
 
     def deco(fn):
         op = Op(name, fn, num_outputs=num_outputs, mutate_aux=mutate_aux, wrap_kwargs=wrap_kwargs,
                 needs_rng=needs_rng, needs_mode=needs_mode, tensor_opts=tensor_opts,
-                sparse_vjp=sparse_vjp)
+                sparse_vjp=sparse_vjp, eager_only=eager_only)
         _OPS[name] = op
         for a in aliases:
             _OPS[a] = op
@@ -254,6 +259,17 @@ def invoke_with_vjp(name, *arrays, **attrs):
     op = get_op(name)
     if op.wrap_kwargs is not None:
         attrs = op.wrap_kwargs(attrs)
+    if op.eager_only and not _in_trace(arrays):
+        # differentiate wrt the data arg only, closing over the rest as
+        # CONCRETE values — a dynamic-shape op (boolean_mask) traces fine
+        # once its shape-determining inputs are constants. Host pullback
+        # (not run through the jitted run_vjp).
+        from ..autograd import _PyPullback
+
+        fn, rest = op.fn, arrays[1:]
+        out, vjp1 = jax.vjp(lambda a0: fn(a0, *rest, **attrs), arrays[0])
+        return out, _PyPullback(
+            lambda cts: vjp1(cts) + tuple(None for _ in rest))
     if _in_trace(arrays):
         fn = op.fn
         return jax.vjp(lambda *a: fn(*a, **attrs), *arrays)
@@ -266,7 +282,7 @@ def invoke_raw(name, *arrays, **attrs):
     op = get_op(name)
     if op.wrap_kwargs is not None:
         attrs = op.wrap_kwargs(attrs)
-    if _in_trace(arrays):
+    if _in_trace(arrays) or op.eager_only:
         return op.fn(*arrays, **attrs)
     jfn = _jitted(op.name, _freeze(attrs), None)
     return jfn(*arrays)

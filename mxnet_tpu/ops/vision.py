@@ -88,43 +88,59 @@ def _roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
     n, c, h, w = data.shape
     r = rois.shape[0]
     bidx = rois[:, 0].astype(jnp.int32)
-    x1 = rois[:, 1] * scale
-    y1 = rois[:, 2] * scale
-    x2 = rois[:, 3] * scale
-    y2 = rois[:, 4] * scale
+    # sampling coordinates ALWAYS in fp32 — under bf16 data the coordinate
+    # spacing near x~200 would be a whole pixel and sub-pixel alignment
+    # (the point of ROIAlign) would be lost
+    roi32 = rois.astype(jnp.float32)
+    x1 = roi32[:, 1] * scale
+    y1 = roi32[:, 2] * scale
+    x2 = roi32[:, 3] * scale
+    y2 = roi32[:, 4] * scale
     rw = jnp.maximum(x2 - x1, 1.0)
     rh = jnp.maximum(y2 - y1, 1.0)
     bin_h = rh / ph
     bin_w = rw / pw
 
-    frac = (jnp.arange(s, dtype=data.dtype) + 0.5) / s
+    frac = (jnp.arange(s, dtype=jnp.float32) + 0.5) / s
     # ys: (R, ph, s)   xs: (R, pw, s)
     ys = y1[:, None, None] + (jnp.arange(ph)[None, :, None] + frac[None, None, :]) * bin_h[:, None, None]
     xs = x1[:, None, None] + (jnp.arange(pw)[None, :, None] + frac[None, None, :]) * bin_w[:, None, None]
 
     imgs = data[bidx]  # (R, C, H, W)
 
-    def per_roi(img_c, ys_r, xs_r):                    # (C,H,W), (ph,s), (pw,s)
-        yy = jnp.broadcast_to(ys_r[:, :, None, None], (ph, s, pw, s))
-        xx = jnp.broadcast_to(xs_r[None, None, :, :], (ph, s, pw, s))
+    if not ps:
+        def per_roi(img_c, ys_r, xs_r):                # (C,H,W), (ph,s), (pw,s)
+            yy = jnp.broadcast_to(ys_r[:, :, None, None], (ph, s, pw, s))
+            xx = jnp.broadcast_to(xs_r[None, None, :, :], (ph, s, pw, s))
 
-        def per_chan(img):
-            return _bilinear_gather(img, yy, xx)
-        return jax.vmap(per_chan)(img_c)               # (C, ph, s, pw, s)
+            def per_chan(img):
+                return _bilinear_gather(img, yy, xx)
+            return jax.vmap(per_chan)(img_c)           # (C, ph, s, pw, s)
 
-    vals = jax.vmap(per_roi)(imgs, ys, xs)
-    # vals: (R, C, ph, s, pw, s) → mean over the sampling grid
-    pooled = vals.mean(axis=(3, 5))                    # (R, C, ph, pw)
+        vals = jax.vmap(per_roi)(imgs, ys, xs)
+        # vals: (R, C, ph, s, pw, s) → mean over the sampling grid
+        return vals.mean(axis=(3, 5)).astype(data.dtype)   # (R, C, ph, pw)
 
-    if ps:
-        # position-sensitive (R-FCN): input channel c_out*ph*pw + i*pw + j
-        # feeds output channel c_out at bin (i, j)
-        c_out = c // (ph * pw)
-        pooled = pooled.reshape(r, c_out, ph, pw, ph, pw)
-        ii = jnp.arange(ph)[:, None]
-        jj = jnp.arange(pw)[None, :]
-        pooled = pooled[:, :, ii, jj, ii, jj]          # (R, c_out, ph, pw)
-    return pooled
+    # position-sensitive (R-FCN): input channel c_out*ph*pw + i*pw + j feeds
+    # output channel c_out at bin (i, j) — gather ONLY that channel group
+    # per bin (sampling all C channels at every bin would be ph*pw times
+    # the work, discarded off-diagonal)
+    c_out = c // (ph * pw)
+    imgs_ps = imgs.reshape(r, c_out, ph, pw, h, w)
+
+    def per_roi_ps(img6, ys_r, xs_r):                  # (c_out,ph,pw,H,W)
+        def per_bin_i(img_i, y_i):                     # (c_out,pw,H,W), (s,)
+            def per_bin_j(img_ij, x_j):                # (c_out,H,W), (s,)
+                yy = jnp.broadcast_to(y_i[:, None], (s, s))
+                xx = jnp.broadcast_to(x_j[None, :], (s, s))
+                sampled = jax.vmap(
+                    lambda im: _bilinear_gather(im, yy, xx))(img_ij)
+                return sampled.mean(axis=(1, 2))       # (c_out,)
+            return jax.vmap(per_bin_j, in_axes=(1, 0))(img_i, xs_r)  # (pw, c_out)
+        return jax.vmap(per_bin_i, in_axes=(1, 0))(img6, ys_r)       # (ph, pw, c_out)
+
+    vals = jax.vmap(per_roi_ps)(imgs_ps, ys, xs)       # (R, ph, pw, c_out)
+    return jnp.transpose(vals, (0, 3, 1, 2)).astype(data.dtype)
 
 
 @register("ROIPooling")
@@ -136,11 +152,17 @@ def _roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0, **kw):
     n, c, h, w = data.shape
     r = rois.shape[0]
 
+    def _round_half_away(v):
+        # the reference uses C++ std::round (half away from zero);
+        # jnp.round is half-to-even and shifts bins at exact .5 coords
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
     bidx = rois[:, 0].astype(jnp.int32)
-    x1 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
-    y1 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
-    x2 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
-    y2 = jnp.round(rois[:, 4] * scale).astype(jnp.int32)
+    roi32 = rois.astype(jnp.float32)
+    x1 = _round_half_away(roi32[:, 1] * scale).astype(jnp.int32)
+    y1 = _round_half_away(roi32[:, 2] * scale).astype(jnp.int32)
+    x2 = _round_half_away(roi32[:, 3] * scale).astype(jnp.int32)
+    y2 = _round_half_away(roi32[:, 4] * scale).astype(jnp.int32)
     rh = jnp.maximum(y2 - y1 + 1, 1)
     rw = jnp.maximum(x2 - x1 + 1, 1)
 
@@ -378,18 +400,20 @@ def _deformable_convolution(data, offset, weight, *maybe_bias, kernel=None,
     hout = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
     wout = (w + 2 * pw_ - dw * (kw_ - 1) - 1) // sw + 1
 
-    # base sampling grid per output position and tap: (kh*kw, Hout, Wout)
-    oy = jnp.arange(hout) * sh - ph_
-    ox = jnp.arange(wout) * sw - pw_
-    ky = jnp.arange(kh) * dh
-    kx = jnp.arange(kw_) * dw
+    # base sampling grid per output position and tap: (kh*kw, Hout, Wout).
+    # Coordinates in fp32 regardless of data dtype (bf16 cannot resolve
+    # sub-pixel offsets at large indices).
+    oy = jnp.arange(hout, dtype=jnp.float32) * sh - ph_
+    ox = jnp.arange(wout, dtype=jnp.float32) * sw - pw_
+    ky = jnp.arange(kh, dtype=jnp.float32) * dh
+    kx = jnp.arange(kw_, dtype=jnp.float32) * dw
     base_y = oy[None, None, :, None] + ky[:, None, None, None]   # (kh,1,Hout,1)
     base_x = ox[None, None, None, :] + kx[None, :, None, None]   # (1,kw,1,Wout)
     base_y = jnp.broadcast_to(base_y, (kh, kw_, hout, wout)).reshape(kh * kw_, hout, wout)
     base_x = jnp.broadcast_to(base_x, (kh, kw_, hout, wout)).reshape(kh * kw_, hout, wout)
 
     # offset: (N, 2*dg*kh*kw, Hout, Wout) — per tap (y, x) pairs
-    off = offset.reshape(n, dgroups, kh * kw_, 2, hout, wout)
+    off = offset.astype(jnp.float32).reshape(n, dgroups, kh * kw_, 2, hout, wout)
     samp_y = base_y[None, None] + off[:, :, :, 0]       # (N, dg, kh*kw, Hout, Wout)
     samp_x = base_x[None, None] + off[:, :, :, 1]
 
@@ -432,11 +456,12 @@ def _spatial_transformer(data, loc, target_shape=None, transform_type="affine",
     bilinear sampling of data at the grid (normalized [-1,1] coords)."""
     th, tw = as_tuple(target_shape)
     n, c, h, w = data.shape
-    theta = loc.reshape(n, 2, 3)
+    theta = loc.astype(jnp.float32).reshape(n, 2, 3)
     # normalized target grid, endpoints inclusive in [-1, 1]
-    # (spatial_transformer-inl.h:98-101: -1 + i*2/(dim-1))
-    xs = -1.0 + jnp.arange(tw) * 2.0 / max(tw - 1, 1)
-    ys = -1.0 + jnp.arange(th) * 2.0 / max(th - 1, 1)
+    # (spatial_transformer-inl.h:98-101: -1 + i*2/(dim-1));
+    # grid math in fp32 for sub-pixel precision under half dtypes
+    xs = -1.0 + jnp.arange(tw, dtype=jnp.float32) * 2.0 / max(tw - 1, 1)
+    ys = -1.0 + jnp.arange(th, dtype=jnp.float32) * 2.0 / max(th - 1, 1)
     gx, gy = jnp.meshgrid(xs, ys)                       # (th, tw)
     ones = jnp.ones_like(gx)
     grid = jnp.stack([gx, gy, ones], axis=0).reshape(3, th * tw)
@@ -449,7 +474,8 @@ def _spatial_transformer(data, loc, target_shape=None, transform_type="affine",
     def per_image(img, yy, xx):
         return jax.vmap(lambda im: _bilinear_gather(im, yy, xx))(img)
 
-    return jax.vmap(per_image)(data, sy, sx)            # (N, C, th, tw)
+    out = jax.vmap(per_image)(data, sy, sx)             # (N, C, th, tw)
+    return out.astype(data.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -617,35 +643,8 @@ def _count_sketch(data, h, s, out_dim=None, processing_batch_size=32, **kw):
     return out.at[:, hh].add(data * ss[None, :])
 
 
-# ---------------------------------------------------------------------------
-# ravel / unravel
-# ---------------------------------------------------------------------------
-
-
-@register("_ravel_multi_index", aliases=["ravel_multi_index"])
-def _ravel_multi_index_op(data, shape=None, **kw):
-    """(`src/operator/tensor/ravel.cc`): data (k, n) of k-dim indices →
-    flat indices (n,) under row-major `shape`."""
-    dims = as_tuple(shape)
-    strides = []
-    acc = 1
-    for d in reversed(dims):
-        strides.append(acc)
-        acc *= int(d)
-    strides = jnp.asarray(list(reversed(strides)), data.dtype)
-    return (data * strides[:, None]).sum(axis=0)
-
-
-@register("_unravel_index", aliases=["unravel_index"])
-def _unravel_index_op(data, shape=None, **kw):
-    """Flat indices (n,) → multi-indices (k, n) under row-major `shape`."""
-    dims = as_tuple(shape)
-    idx = data.astype(jnp.int32)
-    outs = []
-    for d in reversed(dims):
-        outs.append(idx % int(d))
-        idx = idx // int(d)
-    return jnp.stack(list(reversed(outs)), axis=0).astype(data.dtype)
+# ravel_multi_index / unravel_index live in ops/indexing.py (aliases
+# _ravel_multi_index / _unravel_index registered there)
 
 
 # ---------------------------------------------------------------------------
@@ -723,11 +722,14 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         best_gt = jnp.argmax(iou, axis=1)
         best_iou = jnp.take_along_axis(iou, best_gt[:, None], axis=1)[:, 0]
         matched = best_iou >= float(overlap_threshold)
-        # force best anchor per gt
+        # force best anchor per VALID gt — computed as a dense one-hot
+        # (na, ng) membership matrix, not a scatter: scatter-set with the
+        # duplicate indices padded gt rows produce is order-undefined
         best_anchor = jnp.argmax(iou, axis=0)            # (ng,)
-        forced = jnp.zeros((na,), bool).at[best_anchor].set(gt_valid)
-        forced_gt = jnp.zeros((na,), jnp.int32).at[best_anchor].set(
-            jnp.arange(ng, dtype=jnp.int32))
+        member = (best_anchor[None, :] == jnp.arange(na)[:, None]) & \
+            gt_valid[None, :]                            # (na, ng)
+        forced = member.any(axis=1)
+        forced_gt = jnp.argmax(member, axis=1).astype(jnp.int32)
         use_gt = jnp.where(forced, forced_gt, best_gt)
         pos = matched | forced
 
@@ -746,18 +748,20 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 
         cls_t = jnp.where(pos, lab[use_gt, 0] + 1.0, 0.0)
         if float(negative_mining_ratio) > 0:
-            # hardest negatives = highest non-background max-prob... the
-            # reference ranks by background confidence ascending; emulate
-            # with -cpred[0] (background score) as hardness
+            # hard negatives ranked by background confidence ascending
+            # (reference multibox_target: least-confident-background first);
+            # anchors above negative_mining_thresh IoU are near-matches and
+            # may NOT serve as negatives — they get ignore_label
             bg_conf = cpred[0]                           # (na,)
-            hardness = jnp.where(pos, -jnp.inf, -bg_conf)
+            candidate = (~pos) & (best_iou < float(negative_mining_thresh))
+            hardness = jnp.where(candidate, -bg_conf, -jnp.inf)
             n_pos = pos.sum()
             n_neg = jnp.maximum(
                 (float(negative_mining_ratio) * n_pos).astype(jnp.int32),
                 int(minimum_negative_samples))
             order = jnp.argsort(-hardness)
             rank = jnp.zeros((na,), jnp.int32).at[order].set(jnp.arange(na))
-            keep_neg = (~pos) & (rank < n_neg)
+            keep_neg = candidate & (rank < n_neg)
             cls_t = jnp.where(pos | keep_neg, cls_t, float(ignore_label))
         return bt.reshape(-1), bm.reshape(-1), cls_t
 

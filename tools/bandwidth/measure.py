@@ -1,0 +1,157 @@
+"""KVStore bandwidth benchmark — the north-star metric harness.
+
+Parity: `tools/bandwidth/measure.py` in the reference (the BASELINE.md
+allreduce-bandwidth probe): init one kvstore key per parameter of a
+model-zoo network, push per-device gradients / pull weights for N batches,
+report effective ring-allreduce bandwidth per device
+
+    GB/s = size_MB * 2 * (ndev - 1) / ndev / seconds / 1e3
+
+and the numerical error of the reduced result against a host oracle.
+
+TPU-native notes: devices come from the jax platform — on one real chip
+pass --ndev 1 (latency probe); for the 8-device virtual CPU mesh run
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/bandwidth/measure.py --kv-store device --ndev 8
+
+--kv-store dist_tpu_sync exercises the SPMD collective store
+(`mxnet_tpu/parallel/dist.py`) instead of the local reducer; with one
+process it degenerates to the local path but drives the same code the
+multi-process launcher uses (tools/launch.py).
+"""
+import argparse
+import logging
+import time
+from collections import namedtuple
+
+import numpy as np
+
+logger = logging.getLogger()
+logger.setLevel(logging.INFO)
+logging.basicConfig(format="%(asctime)s %(message)s")
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="benchmark kv-store bandwidth")
+    p.add_argument("--network", type=str, default="resnet152_v1",
+                   help="model-zoo network supplying the parameter shapes")
+    p.add_argument("--ndev", type=int, default=0,
+                   help="number of devices (0 = all available)")
+    p.add_argument("--kv-store", type=str, default="device")
+    p.add_argument("--num-batches", type=int, default=5)
+    p.add_argument("--disp-batches", type=int, default=1)
+    p.add_argument("--test-results", type=int, default=1)
+    p.add_argument("--image-shape", type=str, default="3,224,224")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--optimizer", type=str, default="None")
+    p.add_argument("--gc-type", type=str, default="none",
+                   help="gradient compression type (2bit)")
+    return p.parse_args()
+
+
+def get_shapes(network, image_shape, num_classes):
+    """Parameter shapes of the network (reference get_shapes: weight/bias
+    arguments of the bound symbol)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    net = get_model(network, classes=num_classes)
+    net.initialize()
+    c, h, w = (int(s) for s in image_shape.split(","))
+    net(mx.nd.zeros((1, c, h, w)))
+    return [tuple(p.shape) for p in net.collect_params().values()
+            if p.grad_req != "null"]
+
+
+def run(args):
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu import optimizer as opt
+
+    import jax
+
+    n_avail = jax.device_count()
+    ndev = args.ndev or n_avail
+    if ndev > n_avail:
+        raise SystemExit(f"--ndev {ndev} but only {n_avail} devices")
+    devs = [mx.Context("cpu" if jax.default_backend() == "cpu" else "gpu", i)
+            for i in range(ndev)]
+
+    kv = kvs.create(args.kv_store)
+    if args.gc_type != "none":
+        kv.set_gradient_compression({"type": args.gc_type})
+    updater = None
+    if args.optimizer not in (None, "None"):
+        kv.set_optimizer(opt.create(args.optimizer))
+        updater = opt.get_updater(opt.create(args.optimizer))
+
+    shapes = get_shapes(args.network, args.image_shape, args.num_classes)
+    size_mb = sum(float(np.prod(s)) for s in shapes) * 4 / 1e6
+    logging.info("num of arrays = %d, total size = %f MB", len(shapes), size_mb)
+
+    for i, s in enumerate(shapes):
+        kv.init(i, mx.nd.zeros(s))
+
+    rng = np.random.RandomState(0)
+    grads_np = [[rng.uniform(-1, 1, s).astype(np.float32) for _ in devs]
+                for s in shapes]
+    grads = [[mx.nd.array(g, ctx=d) for g, d in zip(gs, devs)]
+             for gs in grads_np]
+    weights = [[mx.nd.zeros(s, ctx=d) for d in devs] for s in shapes]
+
+    # host oracle: sum over devices x num_workers
+    cpu_grads = [mx.nd.array(sum(gs) * kv.num_workers) for gs in grads_np]
+    cpu_weights = [mx.nd.zeros(s) for s in shapes]
+
+    def error():
+        num = 0.0
+        den = 0.0
+        oracle = cpu_weights if updater is not None else cpu_grads
+        for ws, o in zip(weights, oracle):
+            on = o.asnumpy()
+            den += np.abs(on).sum()
+            for w in ws:
+                num += np.abs(w.asnumpy() - on).sum()
+        return num / max(den, 1e-12)
+
+    Results = namedtuple("Results", ["iter", "time", "bandwidth", "error"])
+    res = []
+    toc = 0.0
+    for b in range(args.num_batches + 1):
+        tic = time.time()
+        for i, g in enumerate(grads):
+            kv.push(i, g, priority=i)
+        for i, w in enumerate(weights):
+            kv.pull(i, w, priority=i)
+        for ws in weights:
+            for w in ws:
+                w.wait_to_read()
+        toc += time.time() - tic
+
+        if args.test_results:
+            if updater is not None:
+                for i, (cw, cg) in enumerate(zip(cpu_weights, cpu_grads)):
+                    updater(i, cg, cw)
+            err = error()
+        else:
+            err = -1.0
+
+        if b % args.disp_batches == 0:
+            toc /= args.disp_batches
+            if b != 0:  # iteration 0 is warmup (compile), ignored
+                r = Results(iter=b, time=toc, error=err,
+                            bandwidth=size_mb * 2 * (ndev - 1) / max(ndev, 1)
+                            / max(toc, 1e-12) / 1e3)
+                logging.info("iter %d, %f sec, %f GB/sec per device, error %f",
+                             r.iter, r.time, r.bandwidth, r.error)
+                res.append(r)
+            toc = 0.0
+    if res:
+        avg = sum(r.bandwidth for r in res) / len(res)
+        logging.info("average %f GB/sec per device over %d iters", avg, len(res))
+    return res
+
+
+if __name__ == "__main__":
+    run(parse_args())
