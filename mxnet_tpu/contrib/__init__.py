@@ -1,3 +1,16 @@
 """contrib — experimental / auxiliary frontends (parity
-`python/mxnet/contrib/`): quantization, ONNX, text utilities."""
+`python/mxnet/contrib/`): quantization, ONNX, text utilities, SVRG."""
 from . import quantization  # noqa: F401
+from . import text          # noqa: F401
+
+
+def __getattr__(name):
+    # onnx / svrg_optimization import lazily (protobuf + Module deps);
+    # importlib (not `from . import`) — the latter re-enters this hook
+    if name in ("onnx", "svrg_optimization"):
+        import importlib
+
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

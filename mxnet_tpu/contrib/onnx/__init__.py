@@ -1,0 +1,15 @@
+"""ONNX interop (parity: `python/mxnet/contrib/onnx/` mx2onnx + onnx2mx).
+
+Implemented WITHOUT the `onnx` pip package (not in this image): the minimal
+public ONNX IR schema lives in `onnx_ir.proto` (field numbers follow the
+public specification, so emitted files load in standard ONNX tooling) and
+is compiled to `onnx_ir_pb2.py` with protoc.
+
+API (reference `contrib/onnx/__init__.py`):
+  export_model(sym, params, input_shape, ..., onnx_file_path)
+  import_model(model_file) -> (sym, arg_params, aux_params)
+"""
+from .export_onnx import export_model
+from .import_onnx import import_model
+
+__all__ = ["export_model", "import_model"]

@@ -1,0 +1,384 @@
+"""Symbol graph → ONNX export (parity: `contrib/onnx/mx2onnx/export_model.py`
++ `_op_translations.py`).
+
+Walks the Symbol DAG in topo order and emits one (or a few) ONNX node(s)
+per op. Parameters become initializers; the data variable becomes the graph
+input. Tensors are serialized as raw little-endian bytes (ONNX TensorProto
+raw_data), fp32.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ops._utils import as_tuple, as_float_tuple, parse_bool
+from . import onnx_ir_pb2 as P
+
+# AttributeProto.type enum values (public ONNX spec)
+_AT_FLOAT, _AT_INT, _AT_STRING, _AT_TENSOR = 1, 2, 3, 4
+_AT_FLOATS, _AT_INTS, _AT_STRINGS = 6, 7, 8
+_DT_FLOAT, _DT_INT64 = 1, 7
+
+OPSET = 13
+
+
+def _attr(name, value):
+    a = P.AttributeProto(name=name)
+    if isinstance(value, bool):
+        a.type, a.i = _AT_INT, int(value)
+    elif isinstance(value, int):
+        a.type, a.i = _AT_INT, value
+    elif isinstance(value, float):
+        a.type, a.f = _AT_FLOAT, value
+    elif isinstance(value, str):
+        a.type, a.s = _AT_STRING, value.encode()
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            a.type = _AT_FLOATS
+            a.floats.extend(value)
+        else:
+            a.type = _AT_INTS
+            a.ints.extend(int(v) for v in value)
+    else:
+        raise MXNetError(f"unsupported ONNX attr {name}={value!r}")
+    return a
+
+
+def _tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    t = P.TensorProto(name=name)
+    t.dims.extend(arr.shape)
+    if arr.dtype == np.int64:
+        t.data_type = _DT_INT64
+    else:
+        arr = arr.astype("<f4")
+        t.data_type = _DT_FLOAT
+    t.raw_data = arr.tobytes()
+    return t
+
+
+def _vinfo(name, shape, elem_type=_DT_FLOAT):
+    v = P.ValueInfoProto(name=name)
+    v.type.tensor_type.elem_type = elem_type
+    for d in shape:
+        v.type.tensor_type.shape.dim.add().dim_value = int(d)
+    return v
+
+
+class _Ctx:
+    """Per-export state: emitted nodes, initializers, name map."""
+
+    def __init__(self, params):
+        self.nodes = []
+        self.initializers = []
+        self.params = params
+        self.extra = 0
+
+    def node(self, op_type, inputs, outputs, name, **attrs):
+        n = P.NodeProto(op_type=op_type, name=name)
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            if v is not None:
+                n.attribute.append(_attr(k, v))
+        self.nodes.append(n)
+
+    def const(self, name, arr):
+        self.initializers.append(_tensor(name, np.asarray(arr)))
+        return name
+
+    def tmp(self, base):
+        self.extra += 1
+        return f"{base}__t{self.extra}"
+
+
+def _conv(ctx, n, ins, out):
+    kernel = as_tuple(n.attrs.get("kernel"))
+    nd = len(kernel)
+    pad = as_tuple(n.attrs.get("pad"), nd) or (0,) * nd
+    ctx.node("Conv", ins, [out], n.name,
+             kernel_shape=list(kernel),
+             strides=list(as_tuple(n.attrs.get("stride"), nd) or (1,) * nd),
+             dilations=list(as_tuple(n.attrs.get("dilate"), nd) or (1,) * nd),
+             pads=list(pad) * 2,
+             group=int(n.attrs.get("num_group", 1)))
+
+
+def _deconv(ctx, n, ins, out):
+    kernel = as_tuple(n.attrs.get("kernel"))
+    nd = len(kernel)
+    pad = as_tuple(n.attrs.get("pad"), nd) or (0,) * nd
+    ctx.node("ConvTranspose", ins, [out], n.name,
+             kernel_shape=list(kernel),
+             strides=list(as_tuple(n.attrs.get("stride"), nd) or (1,) * nd),
+             dilations=list(as_tuple(n.attrs.get("dilate"), nd) or (1,) * nd),
+             pads=list(pad) * 2,
+             group=int(n.attrs.get("num_group", 1)))
+
+
+def _fc(ctx, n, ins, out):
+    data = ins[0]
+    if parse_bool(n.attrs.get("flatten", True)):
+        flat = ctx.tmp(n.name)
+        ctx.node("Flatten", [data], [flat], n.name + "_flatten", axis=1)
+        data = flat
+    ctx.node("Gemm", [data] + ins[1:], [out], n.name,
+             alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+def _pool(ctx, n, ins, out):
+    ptype = n.attrs.get("pool_type", "max")
+    if parse_bool(n.attrs.get("global_pool", False)):
+        ctx.node("GlobalMaxPool" if ptype == "max" else "GlobalAveragePool",
+                 ins, [out], n.name)
+        return
+    kernel = as_tuple(n.attrs.get("kernel"))
+    nd = len(kernel)
+    pad = as_tuple(n.attrs.get("pad"), nd) or (0,) * nd
+    kw = dict(kernel_shape=list(kernel),
+              strides=list(as_tuple(n.attrs.get("stride"), nd) or (1,) * nd),
+              pads=list(pad) * 2)
+    if n.attrs.get("pooling_convention", "valid") == "full":
+        kw["ceil_mode"] = 1
+    if ptype == "max":
+        ctx.node("MaxPool", ins, [out], n.name, **kw)
+    elif ptype == "avg":
+        kw["count_include_pad"] = int(parse_bool(
+            n.attrs.get("count_include_pad", True)))
+        ctx.node("AveragePool", ins, [out], n.name, **kw)
+    else:
+        raise MXNetError(f"ONNX export: unsupported pool_type {ptype}")
+
+
+def _batchnorm(ctx, n, ins, out):
+    # fix_gamma: the gamma argument is semantically frozen to 1
+    if parse_bool(n.attrs.get("fix_gamma", True)):
+        gname = ins[1]
+        garr = ctx.params.get(gname)
+        if garr is not None:
+            ones = np.ones_like(np.asarray(garr))
+            ctx.params = dict(ctx.params)
+            ctx.params[gname] = ones
+    ctx.node("BatchNormalization", ins, [out], n.name,
+             epsilon=float(n.attrs.get("eps", 1e-3)),
+             momentum=float(n.attrs.get("momentum", 0.9)))
+
+
+def _activation(ctx, n, ins, out):
+    act = n.attrs.get("act_type", "relu")
+    m = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+         "softrelu": "Softplus", "softsign": "Softsign"}
+    if act not in m:
+        raise MXNetError(f"ONNX export: unsupported act_type {act}")
+    ctx.node(m[act], ins, [out], n.name)
+
+
+def _leaky(ctx, n, ins, out):
+    act = n.attrs.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.node("LeakyRelu", ins, [out], n.name,
+                 alpha=float(n.attrs.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.node("Elu", ins, [out], n.name,
+                 alpha=float(n.attrs.get("slope", 0.25)))
+    elif act == "prelu":
+        ctx.node("PRelu", ins, [out], n.name)
+    else:
+        raise MXNetError(f"ONNX export: unsupported LeakyReLU {act}")
+
+
+def _reshape(ctx, n, ins, out):
+    shape = as_tuple(n.attrs.get("shape"))
+    sname = ctx.const(ctx.tmp(n.name), np.asarray(shape, np.int64))
+    ctx.node("Reshape", [ins[0], sname], [out], n.name)
+
+
+def _simple(op_type, **fixed):
+    def emit(ctx, n, ins, out):
+        ctx.node(op_type, ins, [out], n.name, **fixed)
+    return emit
+
+
+def _softmax(ctx, n, ins, out):
+    ctx.node("Softmax", ins, [out], n.name,
+             axis=int(n.attrs.get("axis", -1)))
+
+
+def _concat(ctx, n, ins, out):
+    ctx.node("Concat", ins, [out], n.name, axis=int(n.attrs.get("dim", 1)))
+
+
+def _dropout(ctx, n, ins, out):
+    ratio = ctx.const(ctx.tmp(n.name), np.asarray(
+        float(n.attrs.get("p", 0.5)), np.float32))
+    ctx.node("Dropout", [ins[0], ratio], [out], n.name)
+
+
+def _transpose(ctx, n, ins, out):
+    axes = as_tuple(n.attrs.get("axes"))
+    ctx.node("Transpose", ins, [out], n.name,
+             perm=list(axes) if axes else None)
+
+
+def _clip(ctx, n, ins, out):
+    lo = ctx.const(ctx.tmp(n.name), np.asarray(
+        float(n.attrs.get("a_min")), np.float32))
+    hi = ctx.const(ctx.tmp(n.name), np.asarray(
+        float(n.attrs.get("a_max")), np.float32))
+    ctx.node("Clip", [ins[0], lo, hi], [out], n.name)
+
+
+def _embedding(ctx, n, ins, out):
+    # MXNet Embedding(data, weight); ONNX Gather(weight, indices)
+    ctx.node("Gather", [ins[1], ins[0]], [out], n.name, axis=0)
+
+
+def _lrn(ctx, n, ins, out):
+    ctx.node("LRN", ins, [out], n.name,
+             alpha=float(n.attrs.get("alpha", 1e-4)),
+             beta=float(n.attrs.get("beta", 0.75)),
+             bias=float(n.attrs.get("knorm", 2.0)),
+             size=int(n.attrs.get("nsize")))
+
+
+def _mean(ctx, n, ins, out):
+    axis = as_tuple(n.attrs.get("axis"))
+    ctx.node("ReduceMean", ins, [out], n.name,
+             axes=list(axis) if axis else None,
+             keepdims=int(parse_bool(n.attrs.get("keepdims", False))))
+
+
+_EXPORTERS = {
+    "Convolution": _conv,
+    "Deconvolution": _deconv,
+    "FullyConnected": _fc,
+    "Pooling": _pool,
+    "BatchNorm": _batchnorm,
+    "Activation": _activation,
+    "LeakyReLU": _leaky,
+    "relu": _simple("Relu"),
+    "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"),
+    "exp": _simple("Exp"),
+    "log": _simple("Log"),
+    "sqrt": _simple("Sqrt"),
+    "Flatten": _simple("Flatten", axis=1),
+    "flatten": _simple("Flatten", axis=1),
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "softmax": _softmax,
+    "log_softmax": lambda ctx, n, ins, out: ctx.node(
+        "LogSoftmax", ins, [out], n.name, axis=int(n.attrs.get("axis", -1))),
+    # output-layer ops: drop the label input (reference mx2onnx does the
+    # same — inference graphs have no labels)
+    "SoftmaxOutput": lambda ctx, n, ins, out: ctx.node(
+        "Softmax", ins[:1], [out], n.name, axis=1),
+    "LinearRegressionOutput": lambda ctx, n, ins, out: ctx.node(
+        "Identity", ins[:1], [out], n.name),
+    "MAERegressionOutput": lambda ctx, n, ins, out: ctx.node(
+        "Identity", ins[:1], [out], n.name),
+    "LogisticRegressionOutput": lambda ctx, n, ins, out: ctx.node(
+        "Sigmoid", ins[:1], [out], n.name),
+    "MakeLoss": lambda ctx, n, ins, out: ctx.node(
+        "Identity", ins[:1], [out], n.name),
+    "Concat": _concat,
+    "concat": _concat,
+    "elemwise_add": _simple("Add"), "broadcast_add": _simple("Add"),
+    "_plus_scalar": None,  # handled specially below
+    "elemwise_sub": _simple("Sub"), "broadcast_sub": _simple("Sub"),
+    "elemwise_mul": _simple("Mul"), "broadcast_mul": _simple("Mul"),
+    "elemwise_div": _simple("Div"), "broadcast_div": _simple("Div"),
+    "dot": _simple("MatMul"),
+    "Dropout": _dropout,
+    "transpose": _transpose,
+    "clip": _clip,
+    "Embedding": _embedding,
+    "LRN": _lrn,
+    "mean": _mean,
+    "identity": _simple("Identity"),
+    "BlockGrad": _simple("Identity"),
+}
+
+
+def _scalar_op(ctx, n, ins, out, onnx_op):
+    s = ctx.const(ctx.tmp(n.name),
+                  np.asarray(float(n.attrs.get("scalar", 0.0)), np.float32))
+    ctx.node(onnx_op, [ins[0], s], [out], n.name)
+
+
+_SCALAR_OPS = {"_plus_scalar": "Add", "_minus_scalar": "Sub",
+               "_mul_scalar": "Mul", "_div_scalar": "Div"}
+
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False,
+                 input_name="data"):
+    """Export (sym, params) to an ONNX file (reference
+    `mx2onnx/export_model.py:export_model`). `params` maps arg/aux name →
+    NDArray or np array. Returns the file path."""
+    from ...ndarray import NDArray
+
+    np_params = {k: (v.asnumpy() if isinstance(v, NDArray) else np.asarray(v))
+                 for k, v in params.items()}
+
+    nodes = sym._nodes()
+    out_entry = {}          # (node id, out idx) -> onnx name
+    ctx = _Ctx(np_params)
+
+    graph_inputs = []
+    for n in nodes:
+        if n.is_variable:
+            out_entry[(id(n), 0)] = n.name
+            continue
+        ins = [out_entry[(id(c), oi)] for c, oi in n.inputs]
+        n_out = n.num_outputs()
+        outs = [n.name if i == 0 else f"{n.name}_out{i}"
+                for i in range(n_out)]
+        if n.op in _SCALAR_OPS:
+            _scalar_op(ctx, n, ins, outs[0], _SCALAR_OPS[n.op])
+        else:
+            fn = _EXPORTERS.get(n.op)
+            if fn is None:
+                raise MXNetError(
+                    f"ONNX export: operator {n.op} (node {n.name}) has no "
+                    f"ONNX translation")
+            fn(ctx, n, ins, outs[0])
+        for i in range(n_out):
+            out_entry[(id(n), i)] = outs[i]
+
+    model = P.ModelProto(ir_version=8, producer_name="mxnet_tpu",
+                         producer_version="0.1")
+    op_set = model.opset_import.add()
+    op_set.version = OPSET
+    g = model.graph
+    g.name = "mxnet_tpu_exported"
+
+    # only variables the emitted nodes actually reference matter — label
+    # vars of output heads (SoftmaxOutput etc.) were dropped above
+    referenced = set()
+    for nd_ in ctx.nodes:
+        referenced.update(nd_.input)
+    var_names = [n.name for n in nodes if n.is_variable
+                 and n.name in referenced]
+    for name in var_names:
+        if name in ctx.params:
+            g.initializer.append(_tensor(name, ctx.params[name]))
+        else:
+            shape = input_shape if name == input_name else None
+            if shape is None:
+                raise MXNetError(
+                    f"ONNX export: variable {name} has no parameter value "
+                    f"and is not the input '{input_name}'")
+            g.input.append(_vinfo(name, shape))
+    g.initializer.extend(ctx.initializers)
+    g.node.extend(ctx.nodes)
+
+    for node, oi in sym._outputs:
+        g.output.append(_vinfo(out_entry[(id(node), oi)], ()))
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    if verbose:
+        print(f"exported {len(ctx.nodes)} nodes, "
+              f"{len(g.initializer)} initializers → {onnx_file_path}")
+    return onnx_file_path

@@ -1,0 +1,293 @@
+"""ONNX file → Symbol graph import (parity: `contrib/onnx/onnx2mx/
+import_model.py` + `import_onnx.py` GraphProto handler +
+`_op_translations.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import onnx_ir_pb2 as P
+
+_DT_NP = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32, 7: np.int64,
+          10: np.float16, 11: np.float64}
+
+
+def _tensor_to_np(t):
+    dtype = _DT_NP.get(t.data_type)
+    if dtype is None:
+        raise MXNetError(f"ONNX import: unsupported tensor dtype {t.data_type}")
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=np.dtype(dtype).newbyteorder("<"))
+    elif t.float_data:
+        arr = np.asarray(list(t.float_data), np.float32)
+    elif t.double_data:
+        arr = np.asarray(list(t.double_data), np.float64)
+    elif t.int64_data:
+        arr = np.asarray(list(t.int64_data), np.int64)
+    elif t.int32_data:
+        arr = np.asarray(list(t.int32_data), np.int32)
+    else:
+        arr = np.zeros(0, dtype)
+    return arr.astype(dtype).reshape(tuple(t.dims))
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:
+            out[a.name] = float(a.f)
+        elif a.type == 2:
+            out[a.name] = int(a.i)
+        elif a.type == 3:
+            out[a.name] = a.s.decode()
+        elif a.type == 4:
+            out[a.name] = _tensor_to_np(a.t)
+        elif a.type == 6:
+            out[a.name] = [float(v) for v in a.floats]
+        elif a.type == 7:
+            out[a.name] = [int(v) for v in a.ints]
+        elif a.type == 8:
+            out[a.name] = [s.decode() for s in a.strings]
+    return out
+
+
+def _sym_pads(pads, nd):
+    """ONNX pads [b0..bn, e0..en] → symmetric MXNet pad; asymmetric pads are
+    rejected (the reference importer does the same for most ops)."""
+    if not pads:
+        return (0,) * nd
+    begin, end = pads[:nd], pads[nd:]
+    if list(begin) != list(end):
+        raise MXNetError(f"ONNX import: asymmetric pads {pads} unsupported")
+    return tuple(begin)
+
+
+class _Importer:
+    def __init__(self):
+        from ...symbol import symbol as S
+
+        self.S = S
+        self.env = {}        # onnx name -> Symbol
+        self.consts = {}     # onnx name -> np array (initializers)
+        self.arg_params = {}
+        self.aux_params = {}
+
+    def sym_of(self, name):
+        if name in self.env:
+            return self.env[name]
+        if name in self.consts:
+            # materialize a constant initializer as a variable + param
+            v = self.S.var(name)
+            self.env[name] = v
+            self.arg_params[name] = self.consts[name]
+            return v
+        raise MXNetError(f"ONNX import: undefined input {name}")
+
+    def const_of(self, name):
+        if name in self.consts:
+            return self.consts[name]
+        raise MXNetError(f"ONNX import: expected constant input {name}")
+
+    # -- per-op handlers -----------------------------------------------------
+
+    def conv(self, node, a, transpose=False):
+        ins = [self.sym_of(node.input[0]), self.sym_of(node.input[1])]
+        w = self.const_of(node.input[1])
+        no_bias = len(node.input) < 3
+        if not no_bias:
+            ins.append(self.sym_of(node.input[2]))
+        kernel = tuple(a.get("kernel_shape", w.shape[2:]))
+        nd = len(kernel)
+        nf = w.shape[1] * int(a.get("group", 1)) if transpose else w.shape[0]
+        return self.S._apply_op(
+            "Deconvolution" if transpose else "Convolution", *ins,
+            name=node.name or node.output[0],
+            kernel=kernel, num_filter=int(nf),
+            stride=tuple(a.get("strides", (1,) * nd)),
+            dilate=tuple(a.get("dilations", (1,) * nd)),
+            pad=_sym_pads(a.get("pads"), nd),
+            num_group=int(a.get("group", 1)), no_bias=no_bias)
+
+    def gemm(self, node, a):
+        if a.get("transA", 0):
+            raise MXNetError("ONNX import: Gemm transA unsupported")
+        data = self.sym_of(node.input[0])
+        w = self.sym_of(node.input[1])
+        wv = self.const_of(node.input[1])
+        if not a.get("transB", 0):
+            wv = wv.T.copy()
+            self.arg_params[node.input[1]] = wv
+        num_hidden = wv.shape[0]
+        ins = [data, w]
+        no_bias = len(node.input) < 3
+        if not no_bias:
+            ins.append(self.sym_of(node.input[2]))
+        return self.S._apply_op("FullyConnected", *ins,
+                                name=node.name or node.output[0],
+                                num_hidden=int(num_hidden), no_bias=no_bias,
+                                flatten=False)
+
+    def pool(self, node, a, ptype, global_pool):
+        kw = {"pool_type": ptype, "global_pool": global_pool}
+        if not global_pool:
+            kernel = tuple(a["kernel_shape"])
+            nd = len(kernel)
+            kw.update(kernel=kernel,
+                      stride=tuple(a.get("strides", (1,) * nd)),
+                      pad=_sym_pads(a.get("pads"), nd))
+            if a.get("ceil_mode"):
+                kw["pooling_convention"] = "full"
+            if ptype == "avg":
+                kw["count_include_pad"] = bool(a.get("count_include_pad", 0))
+        else:
+            kw["kernel"] = (1, 1)
+        return self.S._apply_op("Pooling", self.sym_of(node.input[0]),
+                                name=node.name or node.output[0], **kw)
+
+    def batchnorm(self, node, a):
+        ins = [self.sym_of(n) for n in node.input]
+        # moving mean/var become aux params automatically (BatchNorm
+        # mutate_aux); seed them from the initializers
+        for aux_name in node.input[3:5]:
+            if aux_name in self.arg_params:
+                self.aux_params[aux_name] = self.arg_params.pop(aux_name)
+        return self.S._apply_op(
+            "BatchNorm", *ins, name=node.name or node.output[0],
+            eps=float(a.get("epsilon", 1e-5)),
+            momentum=float(a.get("momentum", 0.9)), fix_gamma=False)
+
+    def handle(self, node):
+        a = _attrs(node)
+        op = node.op_type
+        S = self.S
+        name = node.name or node.output[0]
+
+        def ins(k=None):
+            names = node.input if k is None else node.input[:k]
+            return [self.sym_of(n) for n in names]
+
+        simple = {"Relu": ("Activation", {"act_type": "relu"}),
+                  "Sigmoid": ("Activation", {"act_type": "sigmoid"}),
+                  "Tanh": ("Activation", {"act_type": "tanh"}),
+                  "Softplus": ("Activation", {"act_type": "softrelu"}),
+                  "Softsign": ("softsign", {}),
+                  "Exp": ("exp", {}), "Log": ("log", {}),
+                  "Sqrt": ("sqrt", {}),
+                  "Identity": ("identity", {}),
+                  "Add": ("broadcast_add", {}), "Sub": ("broadcast_sub", {}),
+                  "Mul": ("broadcast_mul", {}), "Div": ("broadcast_div", {}),
+                  "MatMul": ("dot", {})}
+        if op in simple:
+            mx_op, kw = simple[op]
+            return S._apply_op(mx_op, *ins(), name=name, **kw)
+        if op == "Conv":
+            return self.conv(node, a)
+        if op == "ConvTranspose":
+            return self.conv(node, a, transpose=True)
+        if op == "Gemm":
+            return self.gemm(node, a)
+        if op == "MaxPool":
+            return self.pool(node, a, "max", False)
+        if op == "AveragePool":
+            return self.pool(node, a, "avg", False)
+        if op == "GlobalMaxPool":
+            return self.pool(node, a, "max", True)
+        if op == "GlobalAveragePool":
+            return self.pool(node, a, "avg", True)
+        if op == "BatchNormalization":
+            return self.batchnorm(node, a)
+        if op == "Flatten":
+            return S._apply_op("Flatten", *ins(), name=name)
+        if op == "Reshape":
+            shape = tuple(int(v) for v in self.const_of(node.input[1]))
+            return S._apply_op("Reshape", *ins(1), name=name, shape=shape)
+        if op == "Softmax":
+            return S._apply_op("softmax", *ins(), name=name,
+                               axis=int(a.get("axis", -1)))
+        if op == "LogSoftmax":
+            return S._apply_op("log_softmax", *ins(), name=name,
+                               axis=int(a.get("axis", -1)))
+        if op == "Concat":
+            return S._apply_op("Concat", *ins(), name=name,
+                               dim=int(a.get("axis", 1)),
+                               num_args=len(node.input))
+        if op == "Dropout":
+            p = 0.5
+            if len(node.input) > 1:
+                p = float(self.const_of(node.input[1]))
+            return S._apply_op("Dropout", *ins(1), name=name, p=p)
+        if op == "Transpose":
+            return S._apply_op("transpose", *ins(), name=name,
+                               axes=tuple(a["perm"]) if "perm" in a else None)
+        if op == "Clip":
+            lo = float(self.const_of(node.input[1])) if len(node.input) > 1 \
+                else a.get("min", -3.4e38)
+            hi = float(self.const_of(node.input[2])) if len(node.input) > 2 \
+                else a.get("max", 3.4e38)
+            return S._apply_op("clip", *ins(1), name=name, a_min=lo, a_max=hi)
+        if op == "Gather":
+            if int(a.get("axis", 0)) != 0:
+                raise MXNetError(
+                    f"ONNX import: Gather axis={a['axis']} unsupported "
+                    f"(only axis=0 row gathers map to Embedding)")
+            w = self.const_of(node.input[0])
+            return S._apply_op("Embedding",
+                               self.sym_of(node.input[1]),
+                               self.sym_of(node.input[0]), name=name,
+                               input_dim=int(w.shape[0]),
+                               output_dim=int(w.shape[1]))
+        if op == "LeakyRelu":
+            return S._apply_op("LeakyReLU", *ins(), name=name,
+                               act_type="leaky",
+                               slope=float(a.get("alpha", 0.01)))
+        if op == "Elu":
+            return S._apply_op("LeakyReLU", *ins(), name=name,
+                               act_type="elu",
+                               slope=float(a.get("alpha", 1.0)))
+        if op == "LRN":
+            return S._apply_op("LRN", *ins(), name=name,
+                               alpha=float(a.get("alpha", 1e-4)),
+                               beta=float(a.get("beta", 0.75)),
+                               knorm=float(a.get("bias", 1.0)),
+                               nsize=int(a["size"]))
+        if op == "ReduceMean":
+            return S._apply_op("mean", *ins(), name=name,
+                               axis=tuple(a["axes"]) if "axes" in a else None,
+                               keepdims=bool(a.get("keepdims", 1)))
+        raise MXNetError(f"ONNX import: unsupported operator {op}")
+
+
+def import_model(model_file):
+    """Load an ONNX file → (sym, arg_params, aux_params) (reference
+    `onnx2mx/import_model.py:import_model`)."""
+    from ...ndarray import NDArray
+    from ...symbol import symbol as S
+    import jax.numpy as jnp
+
+    model = P.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+
+    imp = _Importer()
+    for t in g.initializer:
+        imp.consts[t.name] = _tensor_to_np(t)
+    for vi in g.input:
+        if vi.name not in imp.consts:
+            imp.env[vi.name] = S.var(vi.name)
+
+    for node in g.node:
+        out_sym = imp.handle(node)
+        outs = list(out_sym) if len(out_sym) > 1 else [out_sym]
+        for i, oname in enumerate(node.output):
+            if i < len(outs):
+                imp.env[oname] = outs[i]
+
+    outputs = [imp.env[o.name] for o in g.output]
+    sym = outputs[0] if len(outputs) == 1 else S.Group(outputs)
+
+    arg_params = {k: NDArray(jnp.asarray(v))
+                  for k, v in imp.arg_params.items()}
+    aux_params = {k: NDArray(jnp.asarray(v))
+                  for k, v in imp.aux_params.items()}
+    return sym, arg_params, aux_params

@@ -1,0 +1,3 @@
+"""SVRG optimization (parity: `python/mxnet/contrib/svrg_optimization/`)."""
+from .svrg_module import SVRGModule  # noqa: F401
+from .svrg_optimizer import SVRGOptimizer  # noqa: F401

@@ -1,0 +1,168 @@
+"""SVRGModule (parity: `python/mxnet/contrib/svrg_optimization/
+svrg_module.py:30`): Module with Stochastic Variance-Reduced Gradient
+updates — every `update_freq` epochs a snapshot w~ of the weights is taken
+and the FULL-dataset gradient mu = (1/N) Σ ∇f_i(w~) computed; each step
+then descends along  ∇f_i(w) − ∇f_i(w~) + mu  (reference
+`_svrg_grads_update_rule`:360)."""
+from __future__ import annotations
+
+import logging
+
+from ...module.module import Module
+from ... import metric as metric_mod
+from ... import ndarray as nd
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, **kwargs)
+        if not isinstance(update_freq, int) or update_freq < 1:
+            raise ValueError("update_freq must be a positive integer")
+        self.update_freq = update_freq
+        # the "special" module evaluates gradients at the snapshot w~
+        # (reference svrg_module.py:88 _mod_aux)
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context, **kwargs)
+        self._param_dict = None  # name -> mu (full grads at w~)
+
+    # -- lifecycle (both modules in lockstep) --------------------------------
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind, shared_module,
+                               grad_req)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        super().init_params(initializer, arg_params, aux_params,
+                            allow_missing, force_init, allow_extra)
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(initializer, arg, aux,
+                                  allow_missing=True, force_init=True,
+                                  allow_extra=True)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        super().init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        self._param_dict = {name: nd.zeros(arr.shape)
+                            for name, arr in self._exec.grad_dict.items()
+                            if arr is not None}
+
+    # -- SVRG core -----------------------------------------------------------
+
+    def update_full_grads(self, train_data):
+        """Snapshot w~ := w and compute mu over the whole dataset
+        (reference svrg_module.py:292)."""
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg_params=arg, aux_params=aux)
+        train_data.reset()
+        nbatch = 0
+        accum = {k: None for k in self._param_dict}
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            gd = self._mod_aux._exec.grad_dict
+            for name in accum:
+                g = gd.get(name)
+                if g is None:
+                    continue
+                accum[name] = g.copy() if accum[name] is None \
+                    else accum[name] + g
+            nbatch += 1
+        for name, g in accum.items():
+            if g is not None:
+                self._param_dict[name][:] = g / nbatch
+
+    def _update_svrg_gradients(self):
+        """grad ← ∇f_i(w) − ∇f_i(w~) + mu in place (reference :382)."""
+        cur = self._exec.grad_dict
+        spc = self._mod_aux._exec.grad_dict
+        for name, mu in self._param_dict.items():
+            g, gs = cur.get(name), spc.get(name)
+            if g is None or gs is None:
+                continue
+            g[:] = g - gs + mu
+
+    def forward_backward(self, data_batch):
+        """Forward+backward on BOTH weight sets, then apply the SVRG
+        gradient rule (reference svrg_module.py fit loop)."""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        self._mod_aux.forward(data_batch, is_train=True)
+        self._mod_aux.backward()
+        self._update_svrg_gradients()
+
+    # -- fit -----------------------------------------------------------------
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_init=False, begin_epoch=0,
+            num_epoch=None, validation_metric=None):
+        """Training loop with a full-gradient refresh every `update_freq`
+        epochs (reference svrg_module.py:395)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        from ... import initializer as init_mod
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_init)
+        self.init_params(initializer or init_mod.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    cbs = batch_end_callback if isinstance(
+                        batch_end_callback, (list, tuple)) \
+                        else [batch_end_callback]
+                    from ...model import BatchEndParam
+
+                    for cb in cbs:
+                        cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                         eval_metric=eval_metric, locals=None))
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                for cb in (epoch_end_callback if isinstance(
+                        epoch_end_callback, (list, tuple))
+                        else [epoch_end_callback]):
+                    cb(epoch, self.symbol, arg, aux)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)

@@ -1,0 +1,37 @@
+"""SVRG optimizer wrapper (parity: `python/mxnet/contrib/
+svrg_optimization/svrg_optimizer.py` `_SVRGOptimizer`).
+
+Holds the user's base optimizer and routes keys: full-gradient accumulator
+keys (prefixed `_full_`) are plain assignments (the kvstore uses them to
+store mu), everything else goes through the base optimizer's update."""
+from __future__ import annotations
+
+from ... import optimizer as opt
+
+__all__ = ["SVRGOptimizer"]
+
+
+@opt.register
+class SVRGOptimizer(opt.Optimizer):
+    MU_PREFIX = "_full_"
+
+    def __init__(self, default_optimizer="sgd", **kwargs):
+        super().__init__(**{k: v for k, v in kwargs.items()
+                            if k in ("learning_rate", "rescale_grad", "wd",
+                                     "clip_gradient", "param_idx2name",
+                                     "lr_scheduler", "multi_precision")})
+        if isinstance(default_optimizer, opt.Optimizer):
+            self.default_opt = default_optimizer
+        else:
+            self.default_opt = opt.create(default_optimizer, **kwargs)
+
+    def create_state(self, index, weight):
+        if isinstance(index, str) and index.startswith(self.MU_PREFIX):
+            return None
+        return self.default_opt.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        if isinstance(index, str) and index.startswith(self.MU_PREFIX):
+            weight[:] = grad  # mu accumulator: plain assignment
+            return
+        self.default_opt.update(index, weight, grad, state)

@@ -1,0 +1,189 @@
+"""Token embeddings (parity: `python/mxnet/contrib/text/embedding.py` —
+`_TokenEmbedding` base, `CustomEmbedding` text-file loader, registry,
+`CompositeEmbedding`). Pretrained downloads (glove/fasttext) keep the same
+file format; `from_file` loads any 'token v1 v2 ...' text file, which is
+how the reference reads them once fetched (zero-egress image: no
+downloader)."""
+from __future__ import annotations
+
+import io
+import logging
+
+import numpy as np
+
+from ...base import MXNetError
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "list_embedding_names", "TokenEmbedding",
+           "CustomEmbedding", "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    """Register a TokenEmbedding subclass under its lowercase name
+    (reference embedding.py register)."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if name.lower() not in _REGISTRY:
+        raise MXNetError(f"unknown embedding {name}; "
+                         f"registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+def list_embedding_names():
+    return sorted(_REGISTRY)
+
+
+class TokenEmbedding:
+    """Map tokens to vectors; unknown tokens get `init_unknown_vec`
+    (reference `_TokenEmbedding`)."""
+
+    def __init__(self, unknown_token="<unk>", init_unknown_vec=np.zeros):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_token = [unknown_token]
+        self._idx_to_vec = None
+        self._vec_len = 0
+
+    # -- loading -------------------------------------------------------------
+
+    def _load_embedding_lines(self, lines, elem_delim=" ", encoding="utf8"):
+        vectors = []
+        for line_num, line in enumerate(lines):
+            if isinstance(line, bytes):
+                line = line.decode(encoding)
+            parts = line.rstrip().split(elem_delim)
+            if len(parts) < 2:
+                continue
+            if len(parts) == 2 and line_num == 0 and \
+                    all(p.lstrip("-").isdigit() for p in parts):
+                # fastText .vec header line '<count> <dim>' (reference
+                # embedding.py skips likely-header lines)
+                logging.info("skipped likely header line %r", line.rstrip())
+                continue
+            token, elems = parts[0], parts[1:]
+            if token in self._token_to_idx:
+                logging.warning("duplicate token %r (line %d) skipped",
+                                token, line_num + 1)
+                continue
+            vec = np.asarray([float(e) for e in elems], np.float32)
+            if self._vec_len == 0:
+                self._vec_len = len(vec)
+            elif len(vec) != self._vec_len:
+                raise MXNetError(
+                    f"line {line_num + 1}: vector length {len(vec)} != "
+                    f"{self._vec_len}")
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            vectors.append(vec)
+        try:
+            unk = self._init_unknown_vec(shape=(self._vec_len,))
+        except TypeError:
+            unk = self._init_unknown_vec((self._vec_len,))
+        self._idx_to_vec = np.vstack([np.asarray(unk, np.float32).reshape(1, -1),
+                                      np.stack(vectors)]) if vectors else \
+            np.zeros((1, max(self._vec_len, 1)), np.float32)
+
+    @classmethod
+    def from_file(cls, file_path, elem_delim=" ", encoding="utf8", **kwargs):
+        emb = cls(**kwargs) if cls is not TokenEmbedding else TokenEmbedding(**kwargs)
+        with io.open(file_path, "rb") as f:
+            emb._load_embedding_lines(f, elem_delim, encoding)
+        return emb
+
+    # -- accessors -----------------------------------------------------------
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        from ... import ndarray as nd
+
+        return nd.array(self._idx_to_vec)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); OOV → unknown vector (reference
+        embedding.py get_vecs_by_tokens)."""
+        from ... import ndarray as nd
+
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        rows = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            rows.append(self._idx_to_vec[i if i is not None else 0])
+        out = np.stack(rows)
+        return nd.array(out[0] if single else out)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (reference
+        update_token_vectors; unknown tokens are an error)."""
+        from ...ndarray import NDArray
+
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        vecs = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else np.asarray(new_vectors, np.float32)
+        vecs = vecs.reshape(len(tokens), -1)
+        for t, v in zip(tokens, vecs):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is unknown; only known-token "
+                                 f"vectors can be updated")
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user file of 'token v1 v2 ...' lines (reference
+    embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path=None, elem_delim=" ",
+                 encoding="utf8", **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_path is not None:
+            with io.open(pretrained_file_path, "rb") as f:
+                self._load_embedding_lines(f, elem_delim, encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, Vocabulary):
+            raise MXNetError("vocabulary must be a Vocabulary")
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._vocab = vocabulary
+        self._token_to_idx = vocabulary.token_to_idx
+        self._idx_to_token = vocabulary.idx_to_token
+        self._vec_len = sum(e.vec_len for e in token_embeddings)
+        parts = [np.asarray(emb.get_vecs_by_tokens(self._idx_to_token).asnumpy())
+                 for emb in token_embeddings]
+        self._idx_to_vec = np.concatenate(parts, axis=1).astype(np.float32)
+        self._unknown_token = vocabulary.unknown_token
+        self._init_unknown_vec = np.zeros
+
+    @property
+    def vocabulary(self):
+        return self._vocab

@@ -1,0 +1,96 @@
+"""Text vocabulary (parity: `python/mxnet/contrib/text/vocab.py:30`
+Vocabulary — frequency-sorted indexing with unknown/reserved tokens)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Index tokens by frequency.
+
+    Index 0 is the unknown token (when set); reserved tokens follow; then
+    counter keys sorted by (-frequency, token) subject to `most_freq_count`
+    and `min_freq` (reference vocab.py:109 `_index_counter_keys`).
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if unknown_token in rset:
+                raise MXNetError("unknown_token must not be reserved")
+            if len(rset) != len(reserved_tokens):
+                raise MXNetError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens else None)
+        self._idx_to_token = []
+        if unknown_token is not None:
+            self._idx_to_token.append(unknown_token)
+        if reserved_tokens:
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        existing = set(self._idx_to_token)
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        budget = most_freq_count if most_freq_count is not None else len(pairs)
+        taken = 0
+        for token, freq in pairs:
+            if freq < min_freq or taken >= budget:
+                break
+            if token in existing:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            taken += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index/indices; unknown tokens map to index 0 (the
+        unknown token) or raise when no unknown token exists."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = []
+        for t in toks:
+            if t in self._token_to_idx:
+                out.append(self._token_to_idx[t])
+            elif self._unknown_token is not None:
+                out.append(self._token_to_idx[self._unknown_token])
+            else:
+                raise MXNetError(f"token {t!r} unknown and no unknown_token")
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"index {i} out of range")
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

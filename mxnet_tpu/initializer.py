@@ -11,6 +11,11 @@ import re
 
 import numpy as _np
 
+# Library-owned RNG for host-side parameter initialization: reseeded by
+# `mxnet_tpu.random.seed` WITHOUT touching the user's global numpy stream
+# (the reference seeds per-context mxnet RNGs, likewise isolated).
+_INIT_RNG = _np.random.RandomState()
+
 from .base import MXNetError
 
 __all__ = ["InitDesc", "Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
@@ -168,7 +173,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, _, arr):
-        self._set(arr, _np.random.uniform(-self.scale, self.scale, arr.shape))
+        self._set(arr, _INIT_RNG.uniform(-self.scale, self.scale, arr.shape))
 
 
 @register
@@ -178,7 +183,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        self._set(arr, _np.random.normal(0, self.sigma, arr.shape))
+        self._set(arr, _INIT_RNG.normal(0, self.sigma, arr.shape))
 
 
 @register
@@ -192,9 +197,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(_np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _INIT_RNG.uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _INIT_RNG.normal(0.0, 1.0, (nout, nin))
         u, _, v = _np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         self._set(arr, self.scale * q.reshape(arr.shape))
@@ -227,9 +232,9 @@ class Xavier(Initializer):
             raise MXNetError("Incorrect factor type")
         scale = _np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            self._set(arr, _np.random.uniform(-scale, scale, shape))
+            self._set(arr, _INIT_RNG.uniform(-scale, scale, shape))
         elif self.rnd_type == "gaussian":
-            self._set(arr, _np.random.normal(0, scale, shape))
+            self._set(arr, _INIT_RNG.normal(0, scale, shape))
         else:
             raise MXNetError("Unknown random type")
 

@@ -141,9 +141,47 @@ def _shuffle(key, data, **kw):
 
 @register("_sample_unique_zipfian", needs_rng=True, num_outputs=2)
 def _sample_unique_zipfian(key, range_max=1, shape=(), **kw):
+    """Zipfian sampling WITHOUT replacement (reference
+    `unique_sample_op.cc:44`): P(class) = (log(class+2) - log(class+1)) /
+    log(range_max+1) over [0, range_max); output (batch, n) unique per row
+    plus per-row trial counts.
+
+    TPU rendering: the reference rejection-samples until n unique values
+    appear (data-dependent trip count). Here sampling is EXACT via the
+    Gumbel-top-k trick (top-n of logp + Gumbel == weighted sampling without
+    replacement); the `trials` output is the EXPECTED trial count solved
+    from E[#unique after t draws] = Σ_k (1 − (1−p_k)^t) = n by Newton —
+    deterministic rather than per-run (documented divergence; downstream
+    sampled-softmax corrections use it as an estimate either way)."""
     shape = as_tuple(shape) or ()
-    u = jax.random.uniform(key, shape)
-    rm = float(range_max)
-    out = (jnp.exp(u * jnp.log(rm + 1.0)) - 1.0).astype(jnp.int32)
-    cnt = jnp.ones(shape, dtype=jnp.int32)
-    return out, cnt
+    batch, n = (shape if len(shape) == 2 else (1, shape[-1] if shape else 1))
+    rm = int(range_max)
+    ks = jnp.arange(rm, dtype=jnp.float32)
+    logp = jnp.log(jnp.log(ks + 2.0) - jnp.log(ks + 1.0)) - \
+        jnp.log(jnp.log(float(rm) + 1.0))
+
+    keys = jax.random.split(key, batch)
+
+    def row(k):
+        g = jax.random.gumbel(k, (rm,))
+        _, idx = jax.lax.top_k(logp + g, n)
+        return idx.astype(jnp.int32)
+
+    samples = jax.vmap(row)(keys).reshape(shape if len(shape) == 2 else (n,))
+
+    # Newton solve for expected trials t: f(t) = Σ(1 - (1-p)^t) - n = 0.
+    # Clamp: a class with p == 1 (range_max == 1) makes log1p(-1) = -inf
+    # and the iteration NaN; the clamp keeps the degenerate case finite
+    # (trials ≈ n, which is exact there).
+    log1mp = jnp.maximum(jnp.log1p(-jnp.exp(logp)), -30.0)
+
+    def newton(t, _):
+        e = jnp.exp(t * log1mp)
+        f = jnp.sum(1.0 - e) - n
+        fp = jnp.sum(-log1mp * e)
+        return t - f / jnp.maximum(fp, 1e-12), None
+
+    t0 = jnp.asarray(float(n), jnp.float32)
+    t_est, _ = jax.lax.scan(newton, t0, None, length=25)
+    trials = jnp.full((batch,), jnp.ceil(t_est), jnp.float32).astype(jnp.int32)
+    return samples, trials

@@ -81,7 +81,13 @@ class Op:
 
 def register(name, aliases=(), num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
              needs_mode=False, tensor_opts=(), sparse_vjp=None, eager_only=False):
-    """Decorator: register a jax fn as operator ``name`` (+ aliases)."""
+    """Decorator: register a jax fn as operator ``name`` (+ aliases).
+
+    ``eager_only`` (dynamic-shape ops, e.g. boolean_mask): the op bypasses
+    the one-op jit cache and runs on concrete arrays. Such an op MUST be
+    differentiable in its FIRST tensor input only — the autograd path
+    closes over inputs 1.. as constants and returns None cotangents for
+    them (they are shape-determining indices/masks by construction)."""
 
     def deco(fn):
         op = Op(name, fn, num_outputs=num_outputs, mutate_aux=mutate_aux, wrap_kwargs=wrap_kwargs,
@@ -260,10 +266,12 @@ def invoke_with_vjp(name, *arrays, **attrs):
     if op.wrap_kwargs is not None:
         attrs = op.wrap_kwargs(attrs)
     if op.eager_only and not _in_trace(arrays):
-        # differentiate wrt the data arg only, closing over the rest as
+        # differentiate wrt the data arg ONLY, closing over the rest as
         # CONCRETE values — a dynamic-shape op (boolean_mask) traces fine
         # once its shape-determining inputs are constants. Host pullback
         # (not run through the jitted run_vjp).
+        # CONTRACT: eager_only ops are differentiable in their FIRST input
+        # only (see register()); inputs 1.. receive None cotangents.
         from ..autograd import _PyPullback
 
         fn, rest = op.fn, arrays[1:]

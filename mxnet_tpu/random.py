@@ -89,16 +89,17 @@ def seed(seed_state, ctx="all"):
     """Seed the global RNG (parity: `python/mxnet/random.py:35`).
     ``ctx`` is accepted for API compatibility; TPU PRNG state is host-side.
 
-    Also seeds numpy's global RNG: host-side initializers
-    (`mxnet_tpu/initializer.py`) draw from it, and the reference contract is
-    that `mx.random.seed(n)` makes parameter initialization reproducible
-    (reference seeds the per-context mxnet RNGs the C++ initializers use)."""
-    import numpy as _np
+    Also reseeds the LIBRARY-OWNED initializer RNG
+    (`mxnet_tpu/initializer.py` _INIT_RNG) so `mx.random.seed(n)` makes
+    parameter initialization reproducible (the reference contract — it
+    seeds the per-context mxnet RNGs its C++ initializers use) without
+    clobbering the user's global numpy stream."""
+    from . import initializer as _init
 
     root = _stack()[0]
     if isinstance(root, EagerKeyProvider):
         root.reseed(int(seed_state))
-    _np.random.seed(int(seed_state) & 0x7FFFFFFF)
+    _init._INIT_RNG.seed(int(seed_state) % (2 ** 32))
 
 
 # nd.random / sym.random namespaces are populated by ndarray/symbol register.

@@ -1,0 +1,255 @@
+"""contrib: ONNX export/import, text vocab/embeddings, SVRG
+(reference corpora: `tests/python/unittest/onnx/`, `test_contrib_text.py`,
+`tests/python/unittest/test_contrib_svrg_module.py` / `_optimizer.py`)."""
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.contrib import onnx as onnx_mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule, SVRGOptimizer
+from mxnet_tpu.io import NDArrayIter
+
+
+# -------------------------------------------------------------------------
+# ONNX
+# -------------------------------------------------------------------------
+
+def _bind_with(net, shape, rng):
+    ex = net.simple_bind(grad_req="null", data=shape)
+    params = {}
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = mx.nd.array(rng.uniform(-0.5, 0.5, v.shape).astype(np.float32))
+            params[k] = v
+    for k, v in ex.aux_dict.items():
+        v[:] = mx.nd.array(np.abs(rng.uniform(0.1, 1.0, v.shape)).astype(np.float32))
+        params[k] = v
+    return ex, params
+
+
+def _reimport_forward(path, shape, x):
+    s2, arg2, aux2 = onnx_mx.import_model(path)
+    ex2 = s2.simple_bind(grad_req="null", data=shape)
+    for k, v in arg2.items():
+        if k in ex2.arg_dict:
+            ex2.arg_dict[k][:] = v
+    for k, v in aux2.items():
+        if k in ex2.aux_dict:
+            ex2.aux_dict[k][:] = v
+    return ex2.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+
+
+def test_onnx_roundtrip_conv_net(tmp_path):
+    rng = np.random.RandomState(0)
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                        stride=(2, 2), name="conv0")
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    r = sym.Activation(b, act_type="relu", name="relu0")
+    p = sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool0")
+    f = sym.Flatten(p, name="flat0")
+    net = sym.FullyConnected(f, num_hidden=3, name="fc0")
+
+    shape = (2, 3, 8, 8)
+    ex, params = _bind_with(net, shape, rng)
+    x = rng.uniform(-1, 1, shape).astype(np.float32)
+    ref = ex.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+
+    path = onnx_mx.export_model(net, params, shape,
+                                onnx_file_path=str(tmp_path / "m.onnx"))
+    got = _reimport_forward(path, shape, x)
+    assert np.allclose(got, ref, atol=1e-4), np.abs(got - ref).max()
+
+
+def test_onnx_roundtrip_mlp_ops(tmp_path):
+    rng = np.random.RandomState(1)
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = sym.Activation(h, act_type="tanh", name="t1")
+    h = sym.FullyConnected(h, num_hidden=4, name="fc2")
+    net = sym.softmax(h, name="sm1")
+
+    shape = (3, 5)
+    ex, params = _bind_with(net, shape, rng)
+    x = rng.uniform(-1, 1, shape).astype(np.float32)
+    ref = ex.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    path = onnx_mx.export_model(net, params, shape,
+                                onnx_file_path=str(tmp_path / "mlp.onnx"))
+    got = _reimport_forward(path, shape, x)
+    assert np.allclose(got, ref, atol=1e-5)
+
+
+def test_onnx_unsupported_op_errors(tmp_path):
+    data = sym.Variable("data")
+    net = sym.arctanh(data, name="weird")
+    with pytest.raises(mx.base.MXNetError, match="no ONNX translation"):
+        onnx_mx.export_model(net, {}, (2, 2),
+                             onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+# -------------------------------------------------------------------------
+# text
+# -------------------------------------------------------------------------
+
+def test_vocabulary_indexing():
+    counter = Counter({"b": 3, "a": 3, "c": 1, "d": 2})
+    v = text.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                        unknown_token="<unk>", reserved_tokens=["<pad>"])
+    # <unk>=0, <pad>=1, then by (-freq, token): a, b, d; c dropped (freq 1)
+    assert v.idx_to_token == ["<unk>", "<pad>", "a", "b", "d"]
+    assert v.to_indices("a") == 2
+    assert v.to_indices(["zzz", "d"]) == [0, 4]
+    assert v.to_tokens([2, 3]) == ["a", "b"]
+    assert len(v) == 5
+
+
+def test_vocabulary_most_freq_count():
+    counter = Counter({"a": 5, "b": 4, "c": 3, "d": 2})
+    v = text.Vocabulary(counter, most_freq_count=2)
+    assert v.idx_to_token == ["<unk>", "a", "b"]
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str("Life is great! \n life is good")
+    assert c["is"] == 2 and c["Life"] == 1
+    c2 = text.utils.count_tokens_from_str("Life is great! \n life is good",
+                                          to_lower=True)
+    assert c2["life"] == 2
+
+
+def test_custom_embedding_and_lookup(tmp_path):
+    path = tmp_path / "emb.txt"
+    path.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("world").asnumpy()
+    assert np.allclose(v, [4, 5, 6])
+    # OOV → unknown vector (zeros)
+    v2 = emb.get_vecs_by_tokens(["hello", "nope"]).asnumpy()
+    assert np.allclose(v2[0], [1, 2, 3]) and np.allclose(v2[1], 0)
+    emb.update_token_vectors("hello", mx.nd.array(np.array([9., 9., 9.])))
+    assert np.allclose(emb.get_vecs_by_tokens("hello").asnumpy(), 9)
+    with pytest.raises(mx.base.MXNetError):
+        emb.update_token_vectors("nope", mx.nd.array(np.zeros(3)))
+
+
+def test_embedding_registry(tmp_path):
+    path = tmp_path / "emb.txt"
+    path.write_text("x 1.0 2.0\n")
+    emb = text.embedding.create("customembedding",
+                                pretrained_file_path=str(path))
+    assert "customembedding" in text.embedding.list_embedding_names()
+    assert emb.vec_len == 2
+
+
+def test_composite_embedding(tmp_path):
+    p1 = tmp_path / "e1.txt"
+    p1.write_text("a 1.0 2.0\nb 3.0 4.0\n")
+    p2 = tmp_path / "e2.txt"
+    p2.write_text("a 5.0\nc 6.0\n")
+    vocab = text.Vocabulary(Counter({"a": 2, "b": 1, "c": 1}), min_freq=1)
+    comp = text.embedding.CompositeEmbedding(
+        vocab, [text.embedding.CustomEmbedding(str(p1)),
+                text.embedding.CustomEmbedding(str(p2))])
+    assert comp.vec_len == 3
+    va = comp.get_vecs_by_tokens("a").asnumpy()
+    assert np.allclose(va, [1, 2, 5])
+    vb = comp.get_vecs_by_tokens("b").asnumpy()
+    assert np.allclose(vb, [3, 4, 0])
+
+
+# -------------------------------------------------------------------------
+# SVRG
+# -------------------------------------------------------------------------
+
+def _linreg_data(n=64, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-1, 1, (n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, (d, 1)).astype(np.float32)
+    y = (X @ w).reshape(n)
+    return X, y
+
+
+def _linreg_mod(update_freq=2):
+    data = sym.Variable("data")
+    label = sym.Variable("lin_label")
+    fc = sym.FullyConnected(data, num_hidden=1, no_bias=True, name="fc")
+    net = sym.LinearRegressionOutput(fc, label, name="lro")
+    return SVRGModule(net, data_names=("data",), label_names=("lin_label",),
+                      update_freq=update_freq)
+
+
+def test_svrg_module_trains():
+    X, y = _linreg_data()
+    it = NDArrayIter(X, y, batch_size=16, shuffle=False,
+                     label_name="lin_label")
+    mod = _linreg_mod()
+    # LinearRegressionOutput emits the UNNORMALIZED (pred - label) grad
+    # like the reference; normalize via rescale_grad
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2,
+                              "rescale_grad": 1.0 / 16}, eval_metric="mse",
+            initializer=mx.init.Uniform(0.05))
+    # final mse must be tiny on a noiseless linear problem
+    it.reset()
+    score = mod.score(it, "mse")
+    assert dict(score)["mse"] < 1e-2
+
+
+def test_svrg_full_grads_are_dataset_mean():
+    X, y = _linreg_data(n=32, d=3, seed=1)
+    it = NDArrayIter(X, y, batch_size=8, shuffle=False,
+                     label_name="lin_label")
+    mod = _linreg_mod()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.05))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    mod.update_full_grads(it)
+    mu = mod._param_dict["fc_weight"].asnumpy()
+    # oracle: mean over batches of the UNNORMALIZED LinearRegressionOutput
+    # gradient (pred - label) — reference regression_output.cc emits the
+    # raw residual; rescale_grad handles normalization at update time
+    W = mod.get_params()[0]["fc_weight"].asnumpy()  # (1, d)
+    grads = []
+    for s in range(0, 32, 8):
+        xb, yb = X[s:s + 8], y[s:s + 8]
+        pred = xb @ W.T  # (8,1)
+        grads.append((pred - yb[:, None]).T @ xb)
+    oracle = np.mean(grads, axis=0)
+    assert np.allclose(mu, oracle, atol=1e-4), (mu, oracle)
+
+
+def test_svrg_optimizer_mu_keys():
+    o = SVRGOptimizer(default_optimizer="sgd", learning_rate=0.1)
+    w = mx.nd.array(np.zeros((2, 2), np.float32))
+    mu = mx.nd.array(np.ones((2, 2), np.float32))
+    o.update("_full_fc_weight", w, mu, None)
+    assert np.allclose(w.asnumpy(), 1.0)  # plain assignment for mu keys
+    w2 = mx.nd.array(np.ones((2,), np.float32))
+    g2 = mx.nd.array(np.ones((2,), np.float32))
+    st = o.create_state(0, w2)
+    o.update(0, w2, g2, st)
+    assert np.allclose(w2.asnumpy(), 0.9)  # sgd step through base optimizer
+
+
+def test_fasttext_header_skipped(tmp_path):
+    path = tmp_path / "ft.vec"
+    path.write_text("2 3\nhello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(path))
+    assert emb.vec_len == 3 and len(emb) == 3  # <unk> + 2 tokens
+    assert np.allclose(emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+
+
+def test_seed_does_not_clobber_user_numpy_stream():
+    np.random.seed(7)
+    expect = np.random.RandomState(7).uniform(size=5)
+    mx.random.seed(123)  # must NOT touch the user's global stream
+    got = np.random.uniform(size=5)
+    assert np.allclose(got, expect)

@@ -53,7 +53,6 @@ def _pos(*shape, seed=0):
 def _run_op(name, inputs, attrs):
     nd_in = [mx.nd.array(a) if isinstance(a, np.ndarray) else a
              for a in inputs]
-    fn = getattr(mx.nd, "_internal_dispatch", None)
     from mxnet_tpu.ndarray.register import invoke_nd
     out = invoke_nd(name, *nd_in, **attrs)
     return out, nd_in
@@ -591,26 +590,26 @@ COVERED_ELSEWHERE = {
     "contrib_adamw_update": "test_optimizer.py",
     "_contrib_mp_adamw_update": "test_optimizer.py",
     # random/samplers — test_random.py
-    "_random_exponential": "test_random.py", "_random_gamma": "test_random.py",
-    "_random_generalized_negative_binomial": "test_random.py",
-    "_random_negative_binomial": "test_random.py",
-    "_random_normal": "test_random.py", "_random_poisson": "test_random.py",
-    "_random_randint": "test_random.py", "_random_uniform": "test_random.py",
-    "random_exponential": "test_random.py", "random_gamma": "test_random.py",
-    "random_generalized_negative_binomial": "test_random.py",
-    "random_negative_binomial": "test_random.py",
-    "random_normal": "test_random.py", "random_poisson": "test_random.py",
-    "random_randint": "test_random.py", "random_uniform": "test_random.py",
-    "normal": "test_random.py", "uniform": "test_random.py",
-    "randint": "test_random.py",
-    "_sample_exponential": "test_random.py", "_sample_gamma": "test_random.py",
-    "_sample_multinomial": "test_random.py", "_sample_normal": "test_random.py",
-    "_sample_poisson": "test_random.py", "_sample_uniform": "test_random.py",
-    "_sample_unique_zipfian": "test_random.py",
-    "sample_exponential": "test_random.py", "sample_gamma": "test_random.py",
-    "sample_multinomial": "test_random.py", "sample_normal": "test_random.py",
-    "sample_poisson": "test_random.py", "sample_uniform": "test_random.py",
-    "_shuffle": "test_random.py", "shuffle": "test_random.py",
+    "_random_exponential": "test_op_coverage.py", "_random_gamma": "test_op_coverage.py",
+    "_random_generalized_negative_binomial": "test_op_coverage.py",
+    "_random_negative_binomial": "test_op_coverage.py",
+    "_random_normal": "test_op_coverage.py", "_random_poisson": "test_op_coverage.py",
+    "_random_randint": "test_op_coverage.py", "_random_uniform": "test_op_coverage.py",
+    "random_exponential": "test_op_coverage.py", "random_gamma": "test_op_coverage.py",
+    "random_generalized_negative_binomial": "test_op_coverage.py",
+    "random_negative_binomial": "test_op_coverage.py",
+    "random_normal": "test_op_coverage.py", "random_poisson": "test_op_coverage.py",
+    "random_randint": "test_op_coverage.py", "random_uniform": "test_op_coverage.py",
+    "normal": "test_op_coverage.py", "uniform": "test_op_coverage.py",
+    "randint": "test_op_coverage.py",
+    "_sample_exponential": "test_op_coverage.py", "_sample_gamma": "test_op_coverage.py",
+    "_sample_multinomial": "test_op_coverage.py", "_sample_normal": "test_op_coverage.py",
+    "_sample_poisson": "test_op_coverage.py", "_sample_uniform": "test_op_coverage.py",
+    "_sample_unique_zipfian": "test_op_coverage.py",
+    "sample_exponential": "test_op_coverage.py", "sample_gamma": "test_op_coverage.py",
+    "sample_multinomial": "test_op_coverage.py", "sample_normal": "test_op_coverage.py",
+    "sample_poisson": "test_op_coverage.py", "sample_uniform": "test_op_coverage.py",
+    "_shuffle": "test_op_coverage.py", "shuffle": "test_op_coverage.py",
     # control flow — test_control_flow.py
     "_foreach": "test_control_flow.py", "_while_loop": "test_control_flow.py",
     "_cond": "test_control_flow.py",
@@ -618,7 +617,7 @@ COVERED_ELSEWHERE = {
     "CTCLoss": "test_ctc.py", "_contrib_CTCLoss": "test_ctc.py",
     "_contrib_ctc_loss": "test_ctc.py", "ctc_loss": "test_ctc.py",
     # RNN — test_rnn_op.py / test_gluon_rnn.py
-    "RNN": "test_rnn_op.py", "_rnn_param_concat": "test_gluon_rnn.py",
+    "RNN": "test_gluon_rnn.py", "_rnn_param_concat": "test_gluon_rnn.py",
     # quantization — test_subgraph_quantization.py
     "_contrib_quantize_v2": "test_subgraph_quantization.py",
     "_contrib_dequantize": "test_subgraph_quantization.py",
@@ -682,6 +681,13 @@ def test_every_registered_op_is_accounted():
         f"{len(missing)} registered ops with no coverage accounting: "
         f"{missing} — add a Spec, point at the covering test file, or "
         f"EXEMPT with a reason")
+    # the cited covering files must actually exist
+    import os
+
+    here = os.path.dirname(__file__)
+    for fname in set(COVERED_ELSEWHERE.values()):
+        assert os.path.exists(os.path.join(here, fname)), \
+            f"COVERED_ELSEWHERE cites nonexistent test file {fname}"
 
 
 def test_coverage_report():
@@ -741,3 +747,88 @@ GRAD_CASES = [(n, s) for n, s in _spec_cases() if s.grad]
                          ids=[n for n, _ in GRAD_CASES])
 def test_op_gradient(name, spec):
     _fd_grad_check(name, spec.inputs, spec.attrs)
+
+
+# --------------------------------------------------------------------------
+# sampler ops: shape + moment checks (these cannot use a numpy oracle)
+# --------------------------------------------------------------------------
+
+_SAMPLER_CASES = [
+    # (op, attrs, mean, std) over a large draw
+    ("_random_uniform", {"low": 0.0, "high": 2.0, "shape": (4000,)}, 1.0, 2.0 / np.sqrt(12)),
+    ("_random_normal", {"loc": 1.0, "scale": 2.0, "shape": (4000,)}, 1.0, 2.0),
+    ("_random_exponential", {"lam": 2.0, "shape": (4000,)}, 0.5, 0.5),
+    ("_random_gamma", {"alpha": 4.0, "beta": 0.5, "shape": (4000,)}, 2.0, 1.0),
+    ("_random_poisson", {"lam": 3.0, "shape": (4000,)}, 3.0, np.sqrt(3.0)),
+    ("_random_negative_binomial", {"k": 5, "p": 0.5, "shape": (4000,)}, 5.0, np.sqrt(10.0)),
+    ("_random_generalized_negative_binomial",
+     {"mu": 2.0, "alpha": 0.5, "shape": (4000,)}, 2.0, np.sqrt(2.0 + 0.5 * 4.0)),
+]
+
+
+@pytest.mark.parametrize("op,attrs,mean,std", _SAMPLER_CASES,
+                         ids=[c[0] for c in _SAMPLER_CASES])
+def test_sampler_moments(op, attrs, mean, std):
+    mx.random.seed(7)
+    from mxnet_tpu.ndarray.register import invoke_nd
+    out = invoke_nd(op, **attrs)
+    arr = out.asnumpy().astype(np.float64)
+    assert arr.shape == attrs["shape"]
+    assert abs(arr.mean() - mean) < 5 * std / np.sqrt(arr.size) + 0.05
+    assert abs(arr.std() - std) < 0.15 * std + 0.05
+
+
+def test_random_randint_bounds():
+    from mxnet_tpu.ndarray.register import invoke_nd
+    out = invoke_nd("_random_randint", low=3, high=9, shape=(2000,)).asnumpy()
+    assert out.min() >= 3 and out.max() <= 8
+    assert set(np.unique(out)) == set(range(3, 9))
+
+
+def test_sample_parameterized():
+    from mxnet_tpu.ndarray.register import invoke_nd
+    # per-row parameters: row i ~ U(low[i], high[i])
+    low = mx.nd.array(np.array([0.0, 10.0], np.float32))
+    high = mx.nd.array(np.array([1.0, 20.0], np.float32))
+    out = invoke_nd("_sample_uniform", low, high, shape=(500,)).asnumpy()
+    assert out.shape == (2, 500)
+    assert 0 <= out[0].min() and out[0].max() <= 1
+    assert 10 <= out[1].min() and out[1].max() <= 20
+    mu = mx.nd.array(np.array([0.0, 5.0], np.float32))
+    sd = mx.nd.array(np.array([1.0, 0.1], np.float32))
+    nrm = invoke_nd("_sample_normal", mu, sd, shape=(2000,)).asnumpy()
+    assert abs(nrm[0].mean()) < 0.2 and abs(nrm[1].mean() - 5) < 0.2
+    gm = invoke_nd("_sample_gamma", mx.nd.array(np.array([4.0], np.float32)),
+                   mx.nd.array(np.array([0.5], np.float32)),
+                   shape=(2000,)).asnumpy()
+    assert abs(gm.mean() - 2.0) < 0.3
+    ps = invoke_nd("_sample_poisson", mx.nd.array(np.array([3.0], np.float32)),
+                   shape=(2000,)).asnumpy()
+    assert abs(ps.mean() - 3.0) < 0.3
+    ex = invoke_nd("_sample_exponential",
+                   mx.nd.array(np.array([2.0], np.float32)),
+                   shape=(2000,)).asnumpy()
+    assert abs(ex.mean() - 0.5) < 0.2
+
+
+def test_sample_multinomial_and_shuffle():
+    from mxnet_tpu.ndarray.register import invoke_nd
+    probs = mx.nd.array(np.array([[0.0, 1.0, 0.0], [0.5, 0.0, 0.5]],
+                                 np.float32))
+    draws = invoke_nd("_sample_multinomial", probs, shape=(400,)).asnumpy()
+    assert (draws[0] == 1).all()
+    assert set(np.unique(draws[1])) <= {0, 2}
+    x = mx.nd.array(np.arange(50, dtype=np.float32))
+    sh = invoke_nd("_shuffle", x).asnumpy()
+    assert sorted(sh.tolist()) == list(range(50))
+    assert not np.array_equal(sh, np.arange(50))
+
+
+def test_sample_unique_zipfian():
+    from mxnet_tpu.ndarray.register import invoke_nd
+    out, counts = invoke_nd("_sample_unique_zipfian", range_max=100,
+                            shape=(1, 40))
+    o = out.asnumpy()
+    assert o.shape[-1] == 40
+    assert len(np.unique(o)) == 40          # unique draws
+    assert o.min() >= 0 and o.max() < 100
