@@ -117,6 +117,20 @@ class _Importer:
         if not a.get("transB", 0):
             wv = wv.T.copy()
             self.arg_params[node.input[1]] = wv
+        # fold alpha into the weight and beta into the bias (Y = alpha*A@B
+        # + beta*C); both must be constants for the fold
+        alpha = float(a.get("alpha", 1.0))
+        beta = float(a.get("beta", 1.0))
+        if alpha != 1.0:
+            wv = wv * alpha
+            self.arg_params[node.input[1]] = wv
+        if beta != 1.0 and len(node.input) > 2:
+            bname = node.input[2]
+            if bname in self.consts:
+                self.arg_params[bname] = self.const_of(bname) * beta
+            else:
+                raise MXNetError("ONNX import: Gemm beta != 1 with a "
+                                 "non-constant C input is unsupported")
         num_hidden = wv.shape[0]
         ins = [data, w]
         no_bias = len(node.input) < 3

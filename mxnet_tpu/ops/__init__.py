@@ -18,3 +18,4 @@ from . import subgraph_ops   # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import optimizer_ops # noqa: F401
 from . import vision        # noqa: F401
+from . import image_ops     # noqa: F401

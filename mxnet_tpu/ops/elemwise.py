@@ -176,6 +176,12 @@ register("_logical_or")(_cmp(jnp.logical_or))
 register("_logical_xor")(_cmp(jnp.logical_xor))
 
 
+@register("hard_sigmoid")
+def _hard_sigmoid(x, alpha=0.2, beta=0.5, **kw):
+    """max(0, min(1, alpha*x + beta)) (`elemwise_unary_op_basic.cc:109`)."""
+    return jnp.clip(float(alpha) * x + float(beta), 0.0, 1.0)
+
+
 @register("add_n", aliases=["ElementWiseSum", "_sum"])
 def _add_n(*xs, num_args=None, **kw):
     out = xs[0]

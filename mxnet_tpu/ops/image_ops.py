@@ -1,0 +1,199 @@
+"""Image ops (parity: `src/operator/image/image_random.cc` + `resize.cc` +
+`crop.cc` — the `_image_*` kernels behind `gluon.data.vision.transforms`).
+
+All ops accept (H, W, C) or (N, H, W, C); random ops draw from the
+framework PRNG (needs_rng) so transforms are reproducible under
+`mx.random.seed`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import as_tuple, as_float_tuple, parse_bool
+
+
+def _hw_axes(data):
+    return (data.ndim - 3, data.ndim - 2)  # (H, W) for HWC / NHWC
+
+
+@register("_image_to_tensor", aliases=["image_to_tensor"])
+def _to_tensor(data, **kw):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (image_random.cc ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", aliases=["image_normalize"])
+def _normalize(data, mean=(0.0,), std=(1.0,), **kw):
+    """(data - mean) / std over the channel axis of CHW/NCHW input."""
+    mean = jnp.asarray(as_float_tuple(mean), jnp.float32)
+    std = jnp.asarray(as_float_tuple(std), jnp.float32)
+    shape = (-1, 1, 1)
+    return ((data.astype(jnp.float32) - mean.reshape(shape))
+            / std.reshape(shape)).astype(data.dtype)
+
+
+@register("_image_flip_left_right", aliases=["image_flip_left_right"])
+def _flip_lr(data, **kw):
+    return jnp.flip(data, axis=_hw_axes(data)[1])
+
+
+@register("_image_flip_top_bottom", aliases=["image_flip_top_bottom"])
+def _flip_tb(data, **kw):
+    return jnp.flip(data, axis=_hw_axes(data)[0])
+
+
+@register("_image_random_flip_left_right",
+          aliases=["image_random_flip_left_right"], needs_rng=True)
+def _random_flip_lr(key, data, **kw):
+    flip = jax.random.bernoulli(key)
+    return jnp.where(flip, jnp.flip(data, axis=_hw_axes(data)[1]), data)
+
+
+@register("_image_random_flip_top_bottom",
+          aliases=["image_random_flip_top_bottom"], needs_rng=True)
+def _random_flip_tb(key, data, **kw):
+    flip = jax.random.bernoulli(key)
+    return jnp.where(flip, jnp.flip(data, axis=_hw_axes(data)[0]), data)
+
+
+def _blend(img, other, alpha):
+    out = alpha * img.astype(jnp.float32) + (1.0 - alpha) * other
+    return out.astype(img.dtype)
+
+
+def _gray(img):
+    # ITU-R BT.601 luma weights (image_random.cc RGB2GrayConvert)
+    w = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    return (img.astype(jnp.float32) * w).sum(axis=-1, keepdims=True)
+
+
+@register("_image_random_brightness", aliases=["image_random_brightness"],
+          needs_rng=True)
+def _random_brightness(key, data, min_factor=0.0, max_factor=1.0, **kw):
+    alpha = jax.random.uniform(key, (), minval=float(min_factor),
+                               maxval=float(max_factor))
+    return _blend(data, 0.0, alpha)
+
+
+@register("_image_random_contrast", aliases=["image_random_contrast"],
+          needs_rng=True)
+def _random_contrast(key, data, min_factor=0.0, max_factor=1.0, **kw):
+    alpha = jax.random.uniform(key, (), minval=float(min_factor),
+                               maxval=float(max_factor))
+    mean = _gray(data).mean()
+    return _blend(data, mean, alpha)
+
+
+@register("_image_random_saturation", aliases=["image_random_saturation"],
+          needs_rng=True)
+def _random_saturation(key, data, min_factor=0.0, max_factor=1.0, **kw):
+    alpha = jax.random.uniform(key, (), minval=float(min_factor),
+                               maxval=float(max_factor))
+    return _blend(data, _gray(data), alpha)
+
+
+@register("_image_random_hue", aliases=["image_random_hue"], needs_rng=True)
+def _random_hue(key, data, min_factor=0.0, max_factor=1.0, **kw):
+    """Hue rotation in YIQ space (the standard linear approximation of the
+    reference's HSV cycle, image_random.cc RandomHue)."""
+    alpha = jax.random.uniform(key, (), minval=float(min_factor),
+                               maxval=float(max_factor))
+    theta = alpha * jnp.pi
+    cos, sin = jnp.cos(theta), jnp.sin(theta)
+    tyiq = jnp.asarray([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.321],
+                        [0.211, -0.523, 0.311]], jnp.float32)
+    # exact inverse (not the published 3-decimal ityiq) so that zero
+    # rotation is the identity transform
+    ityiq = jnp.linalg.inv(tyiq)
+    rot = jnp.asarray([[1.0, 0.0, 0.0],
+                       [0.0, cos, -sin],
+                       [0.0, sin, cos]], jnp.float32)
+    m = ityiq @ rot @ tyiq
+    out = data.astype(jnp.float32) @ m.T
+    return out.astype(data.dtype)
+
+
+@register("_image_random_color_jitter", aliases=["image_random_color_jitter"],
+          needs_rng=True)
+def _random_color_jitter(key, data, brightness=0.0, contrast=0.0,
+                         saturation=0.0, hue=0.0, **kw):
+    ks = jax.random.split(key, 4)
+    x = data
+    if float(brightness) > 0:
+        x = _random_brightness(ks[0], x, 1 - float(brightness),
+                               1 + float(brightness))
+    if float(contrast) > 0:
+        x = _random_contrast(ks[1], x, 1 - float(contrast),
+                             1 + float(contrast))
+    if float(saturation) > 0:
+        x = _random_saturation(ks[2], x, 1 - float(saturation),
+                               1 + float(saturation))
+    if float(hue) > 0:
+        x = _random_hue(ks[3], x, -float(hue), float(hue))
+    return x
+
+
+# ImageNet PCA lighting (the AlexNet recipe the reference hardcodes)
+_EIG_VAL = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+_EIG_VEC = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], jnp.float32)
+
+
+@register("_image_adjust_lighting", aliases=["image_adjust_lighting"])
+def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0), **kw):
+    alpha = jnp.asarray(as_float_tuple(alpha, 3), jnp.float32)
+    delta = _EIG_VEC @ (alpha * _EIG_VAL)
+    return (data.astype(jnp.float32) + delta).astype(data.dtype)
+
+
+@register("_image_random_lighting", aliases=["image_random_lighting"],
+          needs_rng=True)
+def _random_lighting(key, data, alpha_std=0.05, **kw):
+    alpha = jax.random.normal(key, (3,)) * float(alpha_std)
+    delta = _EIG_VEC @ (alpha * _EIG_VAL)
+    return (data.astype(jnp.float32) + delta).astype(data.dtype)
+
+
+@register("_image_resize", aliases=["image_resize"])
+def _resize(data, size=(), keep_ratio=False, interp=1, **kw):
+    """Bilinear (interp=1) / nearest (0) resize of HWC / NHWC images
+    (resize.cc)."""
+    size = as_tuple(size)
+    if len(size) == 1:
+        size = (size[0], size[0])
+    w, h = size  # reference size order is (w, h)
+    method = "nearest" if int(interp) == 0 else "linear"
+    if data.ndim == 3:
+        out_shape = (h, w, data.shape[2])
+    else:
+        out_shape = (data.shape[0], h, w, data.shape[3])
+    out = jax.image.resize(data.astype(jnp.float32), out_shape, method=method)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        out = jnp.round(out)  # OpenCV-style rounding, not truncation
+    return out.astype(data.dtype)
+
+
+@register("_image_crop", aliases=["image_crop"])
+def _crop(data, x=0, y=0, width=1, height=1, **kw):
+    """Fixed crop of HWC / NHWC images (crop.cc); out-of-range windows are
+    an error like the reference, not a silent clamp."""
+    from ..base import MXNetError
+
+    x, y, width, height = int(x), int(y), int(width), int(height)
+    h, w = (data.shape[0], data.shape[1]) if data.ndim == 3 \
+        else (data.shape[1], data.shape[2])
+    if x < 0 or y < 0 or width < 1 or height < 1 or \
+            x + width > w or y + height > h:
+        raise MXNetError(
+            f"_image_crop: window (x={x}, y={y}, w={width}, h={height}) "
+            f"out of bounds for image {h}x{w}")
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]

@@ -809,3 +809,217 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
     return _box_nms_diff(rows, float(nms_threshold), 0.0, int(nms_topk), 2, 1,
                          0, -1, bool(parse_bool(force_suppress)), "corner",
                          "corner")
+
+
+# ---------------------------------------------------------------------------
+# RPN Proposal / MultiProposal (Faster R-CNN), PSROIPooling (R-FCN)
+# ---------------------------------------------------------------------------
+
+
+def _gen_anchors(hf, wf, stride, scales, ratios):
+    """Base anchors per feature-map cell (proposal.cc GenerateAnchors):
+    centered boxes of area (stride*scale)^2 at each aspect ratio."""
+    base = float(stride)
+    ctr = (base - 1.0) / 2.0
+    anchors = []
+    for r in ratios:
+        size = base * base
+        size_r = size / r
+        ws = jnp.round(jnp.sqrt(size_r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            w2, h2 = ws * s / 2.0, hs * s / 2.0
+            anchors.append(jnp.stack([ctr - w2 + 0.5, ctr - h2 + 0.5,
+                                      ctr + w2 - 0.5, ctr + h2 - 0.5]))
+    base_a = jnp.stack(anchors)                         # (A, 4)
+    sy = jnp.arange(hf, dtype=jnp.float32) * stride
+    sx = jnp.arange(wf, dtype=jnp.float32) * stride
+    shift = jnp.stack(jnp.meshgrid(sx, sy)[::-1], axis=0)  # (2, hf, wf): y,x
+    shifts = jnp.stack([shift[1], shift[0], shift[1], shift[0]], axis=-1)
+    # (hf, wf, A, 4) → (hf*wf*A, 4); anchor-fastest like the reference
+    return (shifts[:, :, None, :] + base_a[None, None, :, :]).reshape(-1, 4)
+
+
+def _proposal_one(score, deltas, im_info, anchors, pre_n, post_n, thresh,
+                  min_size, stride):
+    """One image's RPN proposals: decode, clip, min-size filter, topk,
+    NMS, take post_n (proposal.cc ProposalOp::Forward)."""
+    a = anchors
+    na = a.shape[0]
+    # decode bbox deltas (center parameterization)
+    aw = a[:, 2] - a[:, 0] + 1.0
+    ah = a[:, 3] - a[:, 1] + 1.0
+    acx = a[:, 0] + 0.5 * (aw - 1.0)
+    acy = a[:, 1] + 0.5 * (ah - 1.0)
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+    h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+    x1 = cx - 0.5 * (w - 1.0)
+    y1 = cy - 0.5 * (h - 1.0)
+    x2 = cx + 0.5 * (w - 1.0)
+    y2 = cy + 0.5 * (h - 1.0)
+    # clip to image
+    imh, imw = im_info[0], im_info[1]
+    x1 = jnp.clip(x1, 0.0, imw - 1.0)
+    y1 = jnp.clip(y1, 0.0, imh - 1.0)
+    x2 = jnp.clip(x2, 0.0, imw - 1.0)
+    y2 = jnp.clip(y2, 0.0, imh - 1.0)
+    # min-size filter in input-image scale
+    ms = min_size * im_info[2]
+    keep = ((x2 - x1 + 1.0) >= ms) & ((y2 - y1 + 1.0) >= ms)
+    sc = jnp.where(keep, score, -jnp.inf)
+
+    pre_n = min(pre_n, na)
+    top_sc, order = lax.top_k(sc, pre_n)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)[order]   # (pre_n, 4)
+
+    # IoU in the reference's +1 pixel-extent convention (proposal.cc
+    # CalculateOverlap: width = x2 - x1 + 1) — _pair_iou's exclusive
+    # convention would keep small boxes the reference suppresses
+    bx1, by1, bx2, by2 = (boxes[:, i] for i in range(4))
+    iw = jnp.maximum(jnp.minimum(bx2[:, None], bx2[None, :]) -
+                     jnp.maximum(bx1[:, None], bx1[None, :]) + 1.0, 0.0)
+    ih = jnp.maximum(jnp.minimum(by2[:, None], by2[None, :]) -
+                     jnp.maximum(by1[:, None], by1[None, :]) + 1.0, 0.0)
+    inter = iw * ih
+    area = (bx2 - bx1 + 1.0) * (by2 - by1 + 1.0)
+    union = area[:, None] + area[None, :] - inter
+    iou = jnp.where(union > 0, inter / union, 0.0)
+    suppress = iou > thresh
+
+    def step(keep_mask, i):
+        earlier = (jnp.arange(pre_n) < i) & keep_mask
+        dead = jnp.any(suppress[:, i] & earlier)
+        ok = jnp.isfinite(top_sc[i]) & ~dead
+        return keep_mask.at[i].set(ok), None
+
+    keep_mask, _ = lax.scan(step, jnp.zeros((pre_n,), bool),
+                            jnp.arange(pre_n))
+    # order survivors first (stable by score); pad to post_n with the best
+    # box (reference pads short outputs by repeating proposals)
+    rank = jnp.where(keep_mask, jnp.arange(pre_n), pre_n + jnp.arange(pre_n))
+    idx = jnp.argsort(rank)
+    take = jnp.minimum(jnp.arange(post_n), pre_n - 1)
+    sel = idx[take]
+    valid = keep_mask[sel] & (jnp.arange(post_n) < pre_n)
+    picked = jnp.where(valid[:, None], boxes[sel],
+                       boxes[jnp.zeros_like(sel)])
+    picked_sc = jnp.where(valid, top_sc[sel], top_sc[0])
+    return picked, picked_sc
+
+
+def _proposal_impl(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n,
+                   rpn_post_nms_top_n, threshold, rpn_min_size, scales,
+                   ratios, feature_stride, output_score):
+    n, ca, hf, wf = cls_prob.shape
+    a_per_cell = ca // 2
+    if a_per_cell != len(scales) * len(ratios):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            f"Proposal: cls_prob has {a_per_cell} anchors per cell but "
+            f"scales x ratios = {len(scales)}x{len(ratios)} = "
+            f"{len(scales) * len(ratios)}")
+    anchors = _gen_anchors(hf, wf, float(feature_stride),
+                           [float(s) for s in scales],
+                           [float(r) for r in ratios])
+    # foreground scores: channels [A:2A); layout (N, A, hf, wf) → anchor-
+    # fastest flattening must match _gen_anchors: (hf, wf, A)
+    fg = jnp.transpose(cls_prob[:, a_per_cell:, :, :], (0, 2, 3, 1)
+                       ).reshape(n, -1)
+    deltas = bbox_pred.reshape(n, a_per_cell, 4, hf, wf)
+    deltas = jnp.transpose(deltas, (0, 3, 4, 1, 2)).reshape(n, -1, 4)
+
+    boxes, scores = jax.vmap(
+        lambda s, d, ii: _proposal_one(
+            s, d, ii, anchors, int(rpn_pre_nms_top_n),
+            int(rpn_post_nms_top_n), float(threshold),
+            float(rpn_min_size), float(feature_stride)))(fg, deltas, im_info)
+    bidx = jnp.repeat(jnp.arange(n, dtype=cls_prob.dtype),
+                      int(rpn_post_nms_top_n))
+    rois = jnp.concatenate([bidx[:, None],
+                            boxes.reshape(-1, 4).astype(cls_prob.dtype)],
+                           axis=1)
+    if parse_bool(output_score):
+        return rois, scores.reshape(-1, 1).astype(cls_prob.dtype)
+    return rois
+
+
+@register("_contrib_Proposal")
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+              output_score=False, iou_loss=False, **kw):
+    """RPN proposal generation (`proposal.cc:460`): anchors + bbox deltas →
+    clip → min-size filter → top-pre_nms by score → NMS → top-post_nms rois
+    (R, 5) rows [batch_idx, x1, y1, x2, y2]."""
+    return _proposal_impl(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n,
+                          rpn_post_nms_top_n, threshold, rpn_min_size,
+                          as_float_tuple(scales), as_float_tuple(ratios),
+                          feature_stride, output_score)
+
+
+@register("_contrib_MultiProposal")
+def _multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                    rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                    scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                    feature_stride=16, output_score=False, iou_loss=False, **kw):
+    """Batched Proposal (`multi_proposal.cc:498`) — identical math vmapped
+    over the batch (our Proposal already is)."""
+    return _proposal_impl(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n,
+                          rpn_post_nms_top_n, threshold, rpn_min_size,
+                          as_float_tuple(scales), as_float_tuple(ratios),
+                          feature_stride, output_score)
+
+
+@register("_contrib_PSROIPooling")
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
+                   pooled_size=1, group_size=0, **kw):
+    """Position-sensitive ROI AVERAGE pooling (`psroi_pooling.cc:255`,
+    R-FCN): input channel (d*G + gh)*G + gw feeds output channel d at bin
+    (gh, gw); each bin averages its quantized sub-window."""
+    ps = int(pooled_size)
+    gs = int(group_size) or ps
+    od = int(output_dim)
+    scale = float(spatial_scale)
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+
+    bidx = rois[:, 0].astype(jnp.int32)
+    roi32 = rois.astype(jnp.float32)
+    x1 = jnp.round(roi32[:, 1]) * scale
+    y1 = jnp.round(roi32[:, 2]) * scale
+    x2 = jnp.round(roi32[:, 3] + 1.0) * scale
+    y2 = jnp.round(roi32[:, 4] + 1.0) * scale
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+
+    iy = jnp.arange(ps)
+    hs = jnp.floor(y1[:, None] + iy[None, :] * rh[:, None] / ps).astype(jnp.int32)
+    he = jnp.ceil(y1[:, None] + (iy[None, :] + 1) * rh[:, None] / ps).astype(jnp.int32)
+    ix = jnp.arange(ps)
+    ws = jnp.floor(x1[:, None] + ix[None, :] * rw[:, None] / ps).astype(jnp.int32)
+    we = jnp.ceil(x1[:, None] + (ix[None, :] + 1) * rw[:, None] / ps).astype(jnp.int32)
+
+    hh = jnp.arange(h)
+    mask_h = (hh[None, None, :] >= jnp.clip(hs, 0, h)[:, :, None]) & \
+             (hh[None, None, :] < jnp.clip(he, 0, h)[:, :, None])    # (R,ps,H)
+    wwv = jnp.arange(w)
+    mask_w = (wwv[None, None, :] >= jnp.clip(ws, 0, w)[:, :, None]) & \
+             (wwv[None, None, :] < jnp.clip(we, 0, w)[:, :, None])   # (R,ps,W)
+
+    # per-bin channel selection: (od, ps, ps) → flattened input channel
+    dd = jnp.arange(od)[:, None, None]
+    gh = (iy * gs // ps)[None, :, None]
+    gw = (ix * gs // ps)[None, None, :]
+    chan = ((dd * gs + gh) * gs + gw)                    # (od, ps, ps)
+
+    imgs = data[bidx]                                    # (R, C, H, W)
+    sel = imgs[:, chan.reshape(-1), :, :].reshape(r, od, ps, ps, h, w)
+    mh = mask_h[:, None, :, None, :, None].astype(jnp.float32)
+    mw = mask_w[:, None, None, :, None, :].astype(jnp.float32)
+    msk = mh * mw                                        # (R,1,ps,ps,H,W)
+    tot = (sel * msk).sum(axis=(4, 5))
+    cnt = jnp.maximum(msk.sum(axis=(4, 5)), 1.0)
+    return (tot / cnt).astype(data.dtype)                # (R, od, ps, ps)

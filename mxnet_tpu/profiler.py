@@ -17,7 +17,8 @@ import threading
 import time
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
-           "Task", "Frame", "Event", "Counter", "Marker", "scope"]
+           "Task", "Frame", "Event", "Counter", "Marker", "scope",
+           "record_op", "aggregate_stats", "dumps_aggregate"]
 
 _config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
 _events = []
@@ -81,7 +82,74 @@ def _emit(name, ph, cat="host", ts=None, args=None, dur=None):
         _events.append(ev)
 
 
-def dumps(reset=False):
+def is_running():
+    return _running
+
+
+def record_op(name, dur_us, cat="operator"):
+    """Record one operator execution of `dur_us` microseconds — the role of
+    the engine's ProfileOperator wrap (`threaded_engine.h:353-362`): called
+    by the nd dispatch layer when profiling is on."""
+    _emit(name, "X", cat, ts=time.time() * 1e6 - dur_us, dur=dur_us)
+
+
+def aggregate_stats():
+    """Per-name aggregate over recorded duration events: {category:
+    {name: (count, total_ms, min_ms, max_ms)}} — the
+    `aggregate_stats.cc` AggregateStats role."""
+    stats = {}
+    with _lock:
+        evs = list(_events)
+    for ev in evs:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        cat = ev.get("cat", "host")
+        ms = ev["dur"] / 1e3
+        cnt, tot, mn, mx = stats.setdefault(cat, {}).get(
+            ev["name"], (0, 0.0, float("inf"), 0.0))
+        stats[cat][ev["name"]] = (cnt + 1, tot + ms, min(mn, ms), max(mx, ms))
+    return stats
+
+
+def dumps_aggregate(sort_by="total", ascending=False):
+    """Render the aggregate per-op summary table — the terminal-readable
+    output of the reference's `MXAggregateProfileStatsPrint`
+    (`aggregate_stats.cc`). sort_by: total|avg|min|max|count."""
+    key_idx = {"count": 0, "total": 1, "min": 2, "max": 3, "avg": 4}
+    if sort_by not in key_idx:
+        raise ValueError(f"sort_by must be one of {sorted(key_idx)}")
+    lines = ["", "Profile Statistics:"]
+    hdr = (f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
+           f"{'Min Time (ms)':>16}{'Max Time (ms)':>16}{'Avg Time (ms)':>16}")
+    for cat, names in sorted(aggregate_stats().items()):
+        lines.append("")
+        lines.append(cat)
+        lines.append("=" * len(cat))
+        lines.append(hdr)
+        lines.append(f"{'----':<40}{'-----------':>12}{'---------':>14}"
+                     f"{'-------------':>16}{'-------------':>16}"
+                     f"{'-------------':>16}")
+        rows = []
+        for name, (cnt, tot, mn, mx) in names.items():
+            rows.append((name, cnt, tot, mn, mx, tot / cnt))
+        idx = key_idx[sort_by]
+        rows.sort(key=lambda r: r[1 + idx] if sort_by != "count" else r[1],
+                  reverse=not ascending)
+        for name, cnt, tot, mn, mx, avg in rows:
+            lines.append(f"{name[:39]:<40}{cnt:>12}{tot:>14.4f}{mn:>16.4f}"
+                         f"{mx:>16.4f}{avg:>16.4f}")
+    return "\n".join(lines) + "\n"
+
+
+def dumps(reset=False, sort_by="total", ascending=False):
+    """Reference `profiler.py:151` dumps: the aggregate per-op table when
+    `aggregate_stats=True` was configured, else the chrome-trace JSON."""
+    if _config.get("aggregate_stats"):
+        out = dumps_aggregate(sort_by, ascending)
+        if reset:
+            with _lock:
+                _events.clear()
+        return out
     with _lock:
         out = json.dumps({"traceEvents": list(_events)}, indent=2)
         if reset:
@@ -90,9 +158,12 @@ def dumps(reset=False):
 
 
 def dump(finished=True, profile_process="worker"):
+    # always the chrome-trace JSON (the aggregate table is a dumps() view)
     fname = _config.get("filename", "profile.json")
+    with _lock:
+        out = json.dumps({"traceEvents": list(_events)}, indent=2)
     with open(fname, "w") as f:
-        f.write(dumps())
+        f.write(out)
 
 
 class _Scoped:

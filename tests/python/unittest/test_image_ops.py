@@ -1,0 +1,94 @@
+"""_image_* op family (reference `src/operator/image/image_random.cc`,
+`tests/python/unittest/test_gluon_data_vision.py` semantics)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.register import invoke_nd
+
+
+def _img(h=6, w=8, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 255, (h, w, c)).astype(np.float32)
+
+
+def test_to_tensor():
+    x = _img()
+    out = invoke_nd("_image_to_tensor", mx.nd.array(x)).asnumpy()
+    assert out.shape == (3, 6, 8)
+    assert np.allclose(out, x.transpose(2, 0, 1) / 255.0, atol=1e-6)
+    xb = np.stack([x, x])
+    outb = invoke_nd("_image_to_tensor", mx.nd.array(xb)).asnumpy()
+    assert outb.shape == (2, 3, 6, 8)
+
+
+def test_normalize():
+    x = _img().transpose(2, 0, 1)  # CHW
+    out = invoke_nd("_image_normalize", mx.nd.array(x),
+                    mean=(1.0, 2.0, 3.0), std=(2.0, 2.0, 2.0)).asnumpy()
+    ref = (x - np.array([1, 2, 3]).reshape(3, 1, 1)) / 2.0
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_flips():
+    x = _img()
+    lr = invoke_nd("_image_flip_left_right", mx.nd.array(x)).asnumpy()
+    assert np.allclose(lr, x[:, ::-1, :])
+    tb = invoke_nd("_image_flip_top_bottom", mx.nd.array(x)).asnumpy()
+    assert np.allclose(tb, x[::-1, :, :])
+    # random flips preserve the pixel multiset
+    rf = invoke_nd("_image_random_flip_left_right", mx.nd.array(x)).asnumpy()
+    assert np.allclose(np.sort(rf.ravel()), np.sort(x.ravel()))
+
+
+def test_brightness_contrast_saturation_hue():
+    mx.random.seed(3)
+    x = _img()
+    b = invoke_nd("_image_random_brightness", mx.nd.array(x),
+                  min_factor=0.5, max_factor=0.5).asnumpy()
+    assert np.allclose(b, 0.5 * x, atol=1e-4)   # fixed factor
+    c = invoke_nd("_image_random_contrast", mx.nd.array(x),
+                  min_factor=1.0, max_factor=1.0).asnumpy()
+    assert np.allclose(c, x, atol=1e-4)         # identity at factor 1
+    s = invoke_nd("_image_random_saturation", mx.nd.array(x),
+                  min_factor=0.0, max_factor=0.0).asnumpy()
+    # factor 0 = pure grayscale: all channels equal
+    assert np.allclose(s[..., 0], s[..., 1], atol=1e-3)
+    h = invoke_nd("_image_random_hue", mx.nd.array(x),
+                  min_factor=0.0, max_factor=0.0).asnumpy()
+    # zero rotation ≈ identity (YIQ round-trip matrices are the standard
+    # 3-decimal approximations, so ~0.3/255 error)
+    assert np.allclose(h, x, atol=0.5)
+    j = invoke_nd("_image_random_color_jitter", mx.nd.array(x),
+                  brightness=0.1, contrast=0.1, saturation=0.1,
+                  hue=0.1).asnumpy()
+    assert j.shape == x.shape and np.isfinite(j).all()
+
+
+def test_lighting():
+    x = _img()
+    out = invoke_nd("_image_adjust_lighting", mx.nd.array(x),
+                    alpha=(0.0, 0.0, 0.0)).asnumpy()
+    assert np.allclose(out, x)
+    out2 = invoke_nd("_image_adjust_lighting", mx.nd.array(x),
+                     alpha=(0.1, 0.0, 0.0)).asnumpy()
+    assert not np.allclose(out2, x)
+    # the shift is constant across pixels
+    d = out2 - x
+    assert np.allclose(d, d[0, 0], atol=1e-4)
+    r = invoke_nd("_image_random_lighting", mx.nd.array(x),
+                  alpha_std=0.0).asnumpy()
+    assert np.allclose(r, x, atol=1e-4)
+
+
+def test_resize_and_crop():
+    x = _img(4, 4)
+    up = invoke_nd("_image_resize", mx.nd.array(x), size=(8, 8)).asnumpy()
+    assert up.shape == (8, 8, 3)
+    near = invoke_nd("_image_resize", mx.nd.array(x), size=(8, 8),
+                     interp=0).asnumpy()
+    assert near.shape == (8, 8, 3)
+    assert set(np.unique(near)) <= set(np.unique(x))   # nearest reuses pixels
+    cr = invoke_nd("_image_crop", mx.nd.array(x), x=1, y=0, width=2,
+                   height=3).asnumpy()
+    assert cr.shape == (3, 2, 3)
+    assert np.allclose(cr, x[0:3, 1:3, :])

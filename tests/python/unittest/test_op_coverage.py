@@ -150,6 +150,7 @@ def _specs():
         "sigmoid": (sps.expit, (-2, 2), True),
         "relu": (lambda x: np.maximum(x, 0), (0.3, 2.0), True),
         "softsign": (lambda x: x / (1 + np.abs(x)), (-1, 1), True),
+        "hard_sigmoid": (lambda x: np.clip(0.2 * x + 0.5, 0, 1), (-1, 1), False),
         "logical_not": (lambda x: (x == 0).astype(np.float32), (0.3, 2.0), False),
         "identity": (lambda x: x, (-1, 1), True),
         "_copy": (lambda x: x, (-1, 1), False),
@@ -645,6 +646,37 @@ COVERED_ELSEWHERE = {
     "_contrib_MultiBoxPrior": "test_vision_ops.py",
     "_contrib_MultiBoxTarget": "test_vision_ops.py",
     "_contrib_MultiBoxDetection": "test_vision_ops.py",
+    # RPN / R-FCN family — test_vision_ops.py
+    "_contrib_Proposal": "test_vision_ops.py",
+    "_contrib_MultiProposal": "test_vision_ops.py",
+    "_contrib_PSROIPooling": "test_vision_ops.py",
+    # _image_* transforms — test_image_ops.py
+    "_image_to_tensor": "test_image_ops.py", "image_to_tensor": "test_image_ops.py",
+    "_image_normalize": "test_image_ops.py", "image_normalize": "test_image_ops.py",
+    "_image_flip_left_right": "test_image_ops.py",
+    "image_flip_left_right": "test_image_ops.py",
+    "_image_flip_top_bottom": "test_image_ops.py",
+    "image_flip_top_bottom": "test_image_ops.py",
+    "_image_random_flip_left_right": "test_image_ops.py",
+    "image_random_flip_left_right": "test_image_ops.py",
+    "_image_random_flip_top_bottom": "test_image_ops.py",
+    "image_random_flip_top_bottom": "test_image_ops.py",
+    "_image_random_brightness": "test_image_ops.py",
+    "image_random_brightness": "test_image_ops.py",
+    "_image_random_contrast": "test_image_ops.py",
+    "image_random_contrast": "test_image_ops.py",
+    "_image_random_saturation": "test_image_ops.py",
+    "image_random_saturation": "test_image_ops.py",
+    "_image_random_hue": "test_image_ops.py",
+    "image_random_hue": "test_image_ops.py",
+    "_image_random_color_jitter": "test_image_ops.py",
+    "image_random_color_jitter": "test_image_ops.py",
+    "_image_adjust_lighting": "test_image_ops.py",
+    "image_adjust_lighting": "test_image_ops.py",
+    "_image_random_lighting": "test_image_ops.py",
+    "image_random_lighting": "test_image_ops.py",
+    "_image_resize": "test_image_ops.py", "image_resize": "test_image_ops.py",
+    "_image_crop": "test_image_ops.py", "image_crop": "test_image_ops.py",
     # norm layers with aux state — test_gluon.py / test_operator.py
     "BatchNorm": "test_gluon.py", "BatchNorm_v1": "test_gluon.py",
     "_contrib_SyncBatchNorm": "test_gluon.py",

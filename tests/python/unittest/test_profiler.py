@@ -1,0 +1,63 @@
+"""Profiler: per-op events + aggregate stats table (reference
+`src/profiler/aggregate_stats.cc` / `MXAggregateProfileStatsPrint`,
+`tests/python/unittest/test_profiler.py`)."""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def _run_ops():
+    a = mx.nd.array(np.ones((8, 8), np.float32))
+    for _ in range(3):
+        b = mx.nd.dot(a, a)
+    c = mx.nd.relu(b)
+    return c
+
+
+def test_per_op_events_recorded(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        aggregate_stats=False)
+    profiler.start()
+    _run_ops()
+    profiler.stop()
+    trace = json.loads(profiler.dumps(reset=True))
+    names = [e["name"] for e in trace["traceEvents"]
+             if e.get("cat") == "operator"]
+    assert names.count("dot") == 3
+    assert "relu" in names
+
+
+def test_aggregate_table(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        aggregate_stats=True)
+    profiler.start()
+    _run_ops()
+    with profiler.Task(name="mytask"):
+        pass
+    profiler.stop()
+    stats = profiler.aggregate_stats()
+    assert stats["operator"]["dot"][0] == 3  # count
+    table = profiler.dumps(reset=False)
+    assert "Profile Statistics" in table
+    assert "dot" in table and "Total Count" in table
+    # sort-by validation
+    t2 = profiler.dumps_aggregate(sort_by="avg", ascending=True)
+    assert "dot" in t2
+    try:
+        profiler.dumps_aggregate(sort_by="bogus")
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
+    profiler.dumps(reset=True)
+    assert profiler.aggregate_stats() == {}
+    profiler.set_config(aggregate_stats=False)
+
+
+def test_profiler_off_records_nothing():
+    profiler.dumps(reset=True)
+    _run_ops()
+    trace = json.loads(profiler.dumps())
+    assert trace["traceEvents"] == []

@@ -308,3 +308,93 @@ def test_multibox_target_and_detection():
     # the matched anchor decodes exactly to the gt box
     err = np.abs(kept[:, 2:6] - np.array([0.3, 0.3, 0.7, 0.7])).min(axis=0 if kept.ndim == 1 else 0)
     assert (np.abs(kept[:, 2:6] - np.array([0.3, 0.3, 0.7, 0.7])).sum(axis=1).min()) < 1e-3
+
+
+def test_proposal_rpn():
+    """RPN Proposal: a strongly-scored anchor decodes into the output rois."""
+    rng = np.random.RandomState(0)
+    n, a, hf, wf = 1, 3, 4, 4
+    cls_prob = np.full((n, 2 * a, hf, wf), 0.1, np.float32)
+    cls_prob[0, a:, :, :] = rng.uniform(0.2, 0.8, (a, hf, wf))
+    cls_prob[0, a + 1, 2, 2] = 0.99          # hero anchor
+    bbox_pred = np.zeros((n, 4 * a, hf, wf), np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=24, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, scales=(8,), ratios=(0.5, 1, 2), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (8, 5)
+    assert (r[:, 0] == 0).all()                   # batch index
+    assert (r[:, 1:3] >= 0).all() and (r[:, 3:] <= 63).all()
+    # also the scored variant
+    rois2, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=24, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, scales=(8,), ratios=(0.5, 1, 2), feature_stride=16,
+        output_score=True)
+    assert scores.shape == (8, 1)
+    assert float(scores.asnumpy()[0]) >= float(scores.asnumpy()[-1]) - 1e-6
+
+
+def test_multi_proposal_batched():
+    rng = np.random.RandomState(1)
+    n, a, hf, wf = 2, 2, 3, 3
+    cls_prob = rng.uniform(0.1, 0.9, (n, 2 * a, hf, wf)).astype(np.float32)
+    bbox_pred = np.zeros((n, 4 * a, hf, wf), np.float32)
+    im_info = np.array([[48, 48, 1.0], [48, 48, 1.0]], np.float32)
+    rois = mx.nd.contrib.MultiProposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=18, rpn_post_nms_top_n=4, threshold=0.7,
+        rpn_min_size=2, scales=(4, 8), ratios=(1,), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (8, 5)
+    assert (r[:4, 0] == 0).all() and (r[4:, 0] == 1).all()
+
+
+def test_psroi_pooling():
+    # output_dim=2, pooled=2, group=2: channel (d*2+gh)*2+gw constant maps
+    ps, od = 2, 2
+    c = od * ps * ps
+    data = np.zeros((1, c, 4, 4), np.float32)
+    for ch in range(c):
+        data[0, ch] = ch + 1
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                                     spatial_scale=1.0, output_dim=od,
+                                     pooled_size=ps, group_size=ps)
+    got = out.asnumpy()
+    assert got.shape == (1, od, ps, ps)
+    # out[d, gh, gw] = constant of channel (d*2+gh)*2+gw
+    for d in range(od):
+        for gh in range(ps):
+            for gw in range(ps):
+                assert got[0, d, gh, gw] == (d * ps + gh) * ps + gw + 1
+
+
+def test_psroi_pooling_gradient():
+    rng = np.random.RandomState(2)
+    data = mx.nd.array(rng.randn(1, 8, 4, 4).astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.PSROIPooling(data, rois, spatial_scale=1.0,
+                                         output_dim=2, pooled_size=2)
+        (out * out).sum().backward()
+    assert np.abs(data.grad.asnumpy()).sum() > 0
+
+
+def test_proposal_post_exceeds_anchor_count():
+    """post_nms_top_n > available anchors must pad, not crash (reference
+    proposal.cc pads short outputs)."""
+    rng = np.random.RandomState(3)
+    cls_prob = rng.uniform(0.1, 0.9, (1, 2, 3, 3)).astype(np.float32)  # 9 anchors
+    bbox_pred = np.zeros((1, 4, 3, 3), np.float32)
+    im_info = np.array([[48, 48, 1.0]], np.float32)
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=20, threshold=0.7,
+        rpn_min_size=2, scales=(4,), ratios=(1,), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (20, 5)
+    assert np.isfinite(r).all()

@@ -1,0 +1,79 @@
+"""Inference throughput benchmark (parity:
+`example/image-classification/benchmark_score.py` — the img/s table behind
+the reference's published inference numbers, `docs/faq/perf.md:168-193`).
+
+Hybridized model-zoo nets, synthetic data, batch-size sweep; prints one
+line per (network, batch): `network=<n> batch=<b> images/sec=<v>`.
+
+Run on the TPU chip directly, or CPU-pinned:
+  JAX_PLATFORMS=cpu python tools/benchmark_score.py --network resnet50_v1 \
+      --batch-sizes 1,8 --image-shape 3,64,64 --iters 3
+"""
+import argparse
+import time
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="benchmark inference img/s")
+    p.add_argument("--network", type=str, default="all",
+                   help="model-zoo name or 'all' for the standard sweep")
+    p.add_argument("--batch-sizes", type=str, default="1,2,4,8,16,32")
+    p.add_argument("--image-shape", type=str, default="3,224,224")
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"])
+    return p.parse_args()
+
+
+SWEEP = ["alexnet", "vgg16", "resnet50_v1", "resnet152_v1", "inceptionv3",
+         "mobilenet1.0", "densenet121", "squeezenet1.1"]
+
+
+def score(network, batch, image_shape, classes, iters, dtype):
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    c, h, w = image_shape
+    if "inception" in network:
+        h = w = max(h, 299)
+    net = get_model(network, classes=classes)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+    if dtype != "float32":
+        net.cast(dtype)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.uniform(-1, 1, (batch, c, h, w)).astype(np.float32))
+    if dtype != "float32":
+        x = x.astype(dtype)
+    out = net(x)                       # compile
+    jax.block_until_ready(out._data)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    jax.block_until_ready(out._data)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def main():
+    args = parse_args()
+    shape = tuple(int(s) for s in args.image_shape.split(","))
+    batches = [int(b) for b in args.batch_sizes.split(",")]
+    networks = SWEEP if args.network == "all" else [args.network]
+    for network in networks:
+        for b in batches:
+            try:
+                v = score(network, b, shape, args.classes, args.iters,
+                          args.dtype)
+                print(f"network={network} batch={b} images/sec={v:.2f}",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001 — keep sweeping
+                print(f"network={network} batch={b} ERROR={e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
