@@ -1,0 +1,78 @@
+"""Distributed data-parallel training (parity:
+`example/distributed_training/cifar10_dist.py` — BASELINE config 4):
+gluon net + `kv.create('dist_tpu_sync')`, each worker trains on its shard
+(SplitSampler role), gradients allreduced across workers.
+
+Launch N workers on one host (jax.distributed CPU backend):
+
+  python tools/launch.py -n 2 python example/distributed_training/cifar10_dist.py
+
+Single-process it degenerates to local training.
+"""
+import argparse
+import os
+import sys
+
+# make the repo importable regardless of launch cwd (the reference examples
+# do the same sys.path bootstrap, e.g. tools/bandwidth/measure.py:19)
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer, loss as gloss
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+from mxnet_tpu.io import NDArrayIter
+
+logging.basicConfig(level=logging.INFO)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", type=str, default="resnet18_v1")
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--kv-store", type=str, default="dist_tpu_sync")
+    args = p.parse_args()
+
+    kv = mx.kv.create(args.kv_store)
+    rank, nworker = kv.rank, kv.num_workers
+    logging.info("worker %d/%d", rank, nworker)
+
+    # synthetic CIFAR-shaped data, deterministically sharded by rank
+    # (the reference's SplitSampler, cifar10_dist.py:90)
+    rng = np.random.RandomState(7)
+    n = 512
+    X = rng.uniform(-1, 1, (n, 3, 32, 32)).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    shard = slice(rank * n // nworker, (rank + 1) * n // nworker)
+    it = NDArrayIter(X[shard], y[shard], args.batch_size, shuffle=True)
+
+    net = get_model(args.model, classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": args.lr, "momentum": 0.9},
+                      kvstore=kv)
+    sce = gloss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        it.reset()
+        tot = cnt = 0
+        for batch in it:
+            with autograd.record():
+                out = net(batch.data[0])
+                loss = sce(out, batch.label[0])
+            loss.backward()
+            trainer.step(args.batch_size)
+            tot += float(loss.asnumpy().mean()); cnt += 1
+        logging.info("rank %d epoch %d: loss=%.4f", rank, epoch, tot / cnt)
+    print(f"rank {rank}: done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Gluon image classification (parity:
+`example/gluon/image_classification.py` — BASELINE config 2): model-zoo
+net + hybridize + Trainer, synthetic or RecordIO data.
+
+  JAX_PLATFORMS=cpu python example/gluon/image_classification.py \
+      --model resnet18_v1 --batch-size 8 --image-shape 3,32,32 --epochs 1
+"""
+import argparse
+import os
+import sys
+
+# make the repo importable regardless of launch cwd (the reference examples
+# do the same sys.path bootstrap, e.g. tools/bandwidth/measure.py:19)
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer, loss as gloss
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+from mxnet_tpu.io import NDArrayIter
+
+logging.basicConfig(level=logging.INFO)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", type=str, default="resnet18_v1")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--image-shape", type=str, default="3,32,32")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="float32")
+    p.add_argument("--num-batches", type=int, default=16,
+                   help="synthetic batches per epoch")
+    args = p.parse_args()
+
+    c, h, w = (int(s) for s in args.image_shape.split(","))
+    n = args.batch_size * args.num_batches
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (n, c, h, w)).astype(np.float32)
+    y = rng.randint(0, args.classes, n).astype(np.float32)
+    train = NDArrayIter(X, y, args.batch_size, shuffle=True)
+
+    net = get_model(args.model, classes=args.classes)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": args.lr, "momentum": 0.9,
+                       "wd": 1e-4,
+                       "multi_precision": args.dtype != "float32"})
+    sce = gloss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        train.reset()
+        metric.reset()
+        tic = time.time()
+        seen = 0
+        for batch in train:
+            x = batch.data[0]
+            if args.dtype != "float32":
+                x = x.astype(args.dtype)
+            label = batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = sce(out, label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([label], [out])
+            seen += args.batch_size
+        name, acc = metric.get()
+        logging.info("epoch %d: %s=%.4f  %.1f img/s", epoch, name, acc,
+                     seen / (time.time() - tic))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""MNIST training via the Module API (parity:
+`example/image-classification/train_mnist.py` — BASELINE config 1).
+
+Uses `io.MNISTIter` when --data-dir has the idx files, else a synthetic
+MNIST-shaped dataset (zero-egress images can't download).
+
+  JAX_PLATFORMS=cpu python example/image-classification/train_mnist.py \
+      --network mlp --num-epochs 3 --synthetic
+"""
+import argparse
+import os
+import sys
+
+# make the repo importable regardless of launch cwd (the reference examples
+# do the same sys.path bootstrap, e.g. tools/bandwidth/measure.py:19)
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+
+logging.basicConfig(level=logging.INFO)
+
+
+def get_mlp():
+    data = sym.Variable("data")
+    net = sym.Flatten(data, name="flatten")
+    net = sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = sym.Activation(net, act_type="relu", name="relu2")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def get_lenet():
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    a1 = sym.Activation(c1, act_type="tanh", name="tanh1")
+    p1 = sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                     name="pool1")
+    c2 = sym.Convolution(p1, kernel=(5, 5), num_filter=50, name="conv2")
+    a2 = sym.Activation(c2, act_type="tanh", name="tanh2")
+    p2 = sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                     name="pool2")
+    f = sym.Flatten(p2, name="flatten")
+    f1 = sym.FullyConnected(f, num_hidden=500, name="fc1")
+    a3 = sym.Activation(f1, act_type="tanh", name="tanh3")
+    f2 = sym.FullyConnected(a3, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(f2, name="softmax")
+
+
+def synthetic_iters(batch_size, n=2048):
+    """MNIST-shaped separable synthetic digits (each class lights a
+    distinct 7x7 block pattern)."""
+    rng = np.random.RandomState(42)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    X = 0.1 * rng.rand(n, 1, 28, 28).astype(np.float32)
+    for i in range(n):
+        c = int(y[i])
+        X[i, 0, (c // 5) * 14:(c // 5) * 14 + 14,
+          (c % 5) * 5:(c % 5) * 5 + 5] += 0.8
+    split = int(0.9 * n)
+    train = NDArrayIter(X[:split], y[:split], batch_size, shuffle=True)
+    val = NDArrayIter(X[split:], y[split:], batch_size)
+    return train, val
+
+
+def mnist_iters(data_dir, batch_size):
+    from mxnet_tpu.io import MNISTIter
+
+    train = MNISTIter(image=f"{data_dir}/train-images-idx3-ubyte",
+                      label=f"{data_dir}/train-labels-idx1-ubyte",
+                      batch_size=batch_size, shuffle=True, flat=False)
+    val = MNISTIter(image=f"{data_dir}/t10k-images-idx3-ubyte",
+                    label=f"{data_dir}/t10k-labels-idx1-ubyte",
+                    batch_size=batch_size, flat=False)
+    return train, val
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", choices=["mlp", "lenet"], default="mlp")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--kv-store", type=str, default="local")
+    p.add_argument("--data-dir", type=str, default="data/mnist")
+    p.add_argument("--synthetic", action="store_true",
+                   help="use synthetic MNIST-shaped data (no files needed)")
+    args = p.parse_args()
+
+    if args.synthetic:
+        train, val = synthetic_iters(args.batch_size)
+    else:
+        train, val = mnist_iters(args.data_dir, args.batch_size)
+
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    mod = Module(net, context=mx.cpu() if False else None)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            kvstore=args.kv_store,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    print(f"final validation accuracy: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,5 @@
-"""gluon.contrib (parity `python/mxnet/gluon/contrib/__init__.py`).
-
-Populated as contrib pieces land (sync BN wrapper, Conv*RNN cells,
-VariationalDropoutCell — SURVEY.md §2.3).
-"""
-try:
-    from . import nn  # noqa: F401
-    from . import rnn  # noqa: F401
-    from . import data  # noqa: F401
-except ImportError:  # pragma: no cover - during staged build only
-    pass
+"""gluon.contrib (parity `python/mxnet/gluon/contrib/__init__.py`):
+layer containers + SyncBatchNorm (nn), Conv*RNN / VariationalDropout /
+LSTMP cells (rnn) — SURVEY.md §2.3."""
+from . import nn   # noqa: F401
+from . import rnn  # noqa: F401

@@ -1,0 +1,5 @@
+"""gluon.contrib.rnn (parity: `python/mxnet/gluon/contrib/rnn/`)."""
+from .conv_rnn_cell import *  # noqa: F401,F403
+from .rnn_cell import *       # noqa: F401,F403
+from . import conv_rnn_cell   # noqa: F401
+from . import rnn_cell        # noqa: F401

@@ -85,7 +85,10 @@ def _random_brightness(key, data, min_factor=0.0, max_factor=1.0, **kw):
 def _random_contrast(key, data, min_factor=0.0, max_factor=1.0, **kw):
     alpha = jax.random.uniform(key, (), minval=float(min_factor),
                                maxval=float(max_factor))
-    mean = _gray(data).mean()
+    # PER-IMAGE gray mean: HWC reduces to a scalar, NHWC to (N,1,1,1) —
+    # batched images must not blend toward the batch-combined luma
+    axes = tuple(range(data.ndim - 3, data.ndim))
+    mean = _gray(data).mean(axis=axes, keepdims=True)
     return _blend(data, mean, alpha)
 
 
@@ -139,17 +142,21 @@ def _random_color_jitter(key, data, brightness=0.0, contrast=0.0,
     return x
 
 
-# ImageNet PCA lighting (the AlexNet recipe the reference hardcodes)
-_EIG_VAL = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
-_EIG_VEC = jnp.asarray([[-0.5675, 0.7192, 0.4009],
-                        [-0.5808, -0.0045, -0.8140],
-                        [-0.5836, -0.6948, 0.4203]], jnp.float32)
+# ImageNet PCA lighting (the AlexNet recipe the reference hardcodes).
+# Plain python lists — a module-level jnp.asarray would initialise the XLA
+# backend at import time, which breaks jax.distributed workers (they must
+# call distributed.initialize before ANY backend touch).
+_EIG_VAL = [55.46, 4.794, 1.148]
+_EIG_VEC = [[-0.5675, 0.7192, 0.4009],
+            [-0.5808, -0.0045, -0.8140],
+            [-0.5836, -0.6948, 0.4203]]
 
 
 @register("_image_adjust_lighting", aliases=["image_adjust_lighting"])
 def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0), **kw):
     alpha = jnp.asarray(as_float_tuple(alpha, 3), jnp.float32)
-    delta = _EIG_VEC @ (alpha * _EIG_VAL)
+    delta = jnp.asarray(_EIG_VEC, jnp.float32) @ \
+        (alpha * jnp.asarray(_EIG_VAL, jnp.float32))
     return (data.astype(jnp.float32) + delta).astype(data.dtype)
 
 
@@ -157,17 +164,27 @@ def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0), **kw):
           needs_rng=True)
 def _random_lighting(key, data, alpha_std=0.05, **kw):
     alpha = jax.random.normal(key, (3,)) * float(alpha_std)
-    delta = _EIG_VEC @ (alpha * _EIG_VAL)
+    delta = jnp.asarray(_EIG_VEC, jnp.float32) @ \
+        (alpha * jnp.asarray(_EIG_VAL, jnp.float32))
     return (data.astype(jnp.float32) + delta).astype(data.dtype)
 
 
 @register("_image_resize", aliases=["image_resize"])
 def _resize(data, size=(), keep_ratio=False, interp=1, **kw):
     """Bilinear (interp=1) / nearest (0) resize of HWC / NHWC images
-    (resize.cc)."""
+    (resize.cc). Scalar `size` with keep_ratio scales the SHORT edge to
+    `size` preserving aspect ratio (reference resize.cc SetSize)."""
     size = as_tuple(size)
+    ih, iw = (data.shape[0], data.shape[1]) if data.ndim == 3 \
+        else (data.shape[1], data.shape[2])
     if len(size) == 1:
-        size = (size[0], size[0])
+        if parse_bool(keep_ratio):
+            if ih < iw:
+                size = (int(round(iw * size[0] / ih)), size[0])
+            else:
+                size = (size[0], int(round(ih * size[0] / iw)))
+        else:
+            size = (size[0], size[0])
     w, h = size  # reference size order is (w, h)
     method = "nearest" if int(interp) == 0 else "linear"
     if data.ndim == 3:

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import default_mesh
+from ..kvstore import KVStoreBase
 from . import collectives as coll
 
 _initialized = False
@@ -188,13 +189,15 @@ def _allgather(buf, fill=0):
 _BUCKET_CAP = int(os.environ.get("MXNET_KVSTORE_DIST_BUCKET_SIZE", str(4 << 20)))
 
 
-class KVStoreDistTPUSync:
+class KVStoreDistTPUSync(KVStoreBase):
     """`kv.create('dist_tpu_sync')` / `'dist_sync'` / `'dist'`.
 
-    Keeps the KVStore front API (init/push/pull/pushpull, `kvstore.py`) so
-    Trainer/Module code is unchanged, but push+pull together are ONE
-    AllReduce over every device in the mesh — per-bucket programs are
-    compile-cached by shape. Keys live replicated on the mesh.
+    Keeps the KVStore front API (init/push/pull/pushpull, `kvstore.py`;
+    subclasses KVStoreBase so `isinstance` dispatch in
+    `model._create_kvstore` accepts store instances) so Trainer/Module code
+    is unchanged, but push+pull together are ONE AllReduce over every
+    device in the mesh — per-bucket programs are compile-cached by shape.
+    Keys live replicated on the mesh.
 
     Semantics vs reference (`kvstore_dist_server.h` sync mode): the server
     aggregated exactly num_workers pushes then answered pulls; here the
@@ -203,16 +206,13 @@ class KVStoreDistTPUSync:
     """
 
     def __init__(self, mesh=None):
-        from ..gradient_compression import GradientCompression
-
         init_process_group()
+        super().__init__()         # _updater/_updater_func/_gc
         self.mesh = mesh or default_mesh()
         self._store = {}           # key -> replicated jax Array
         self._pending = {}         # key -> aggregated dense grad
         self._pending_rsp = {}     # key -> list of (idx int32 (m,), data (m, ...))
-        self._updater = None
         self._optimizer = None
-        self._gc = GradientCompression()
 
     # -- identity -----------------------------------------------------------
 

@@ -1,0 +1,107 @@
+"""gluon.contrib recurrent cells (reference
+`tests/python/unittest/test_gluon_contrib.py` conv-RNN / vardrop / lstmp)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+def _unroll(cell, x_tnc, length):
+    outputs, states = cell.unroll(length, x_tnc, layout="TNC",
+                                  merge_outputs=False)
+    return outputs, states
+
+
+def test_conv_rnn_cells_all_dims():
+    rng = np.random.RandomState(0)
+    for dims, cls in [(1, crnn.Conv1DRNNCell), (2, crnn.Conv2DRNNCell),
+                      (3, crnn.Conv3DRNNCell)]:
+        spatial = (8,) * dims
+        cell = cls(input_shape=(3,) + spatial, hidden_channels=4,
+                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.nd.array(rng.randn(2, 3, *spatial).astype(np.float32))
+        states = cell.begin_state(batch_size=2)
+        out, new_states = cell(x, states)
+        assert out.shape == (2, 4) + spatial
+        assert np.isfinite(out.asnumpy()).all()
+
+
+def test_conv_lstm_gru_state_shapes():
+    rng = np.random.RandomState(1)
+    lstm = crnn.Conv2DLSTMCell(input_shape=(2, 6, 6), hidden_channels=3,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    lstm.initialize()
+    x = mx.nd.array(rng.randn(2, 2, 6, 6).astype(np.float32))
+    st = lstm.begin_state(batch_size=2)
+    assert len(st) == 2
+    out, ns = lstm(x, st)
+    assert out.shape == (2, 3, 6, 6)
+    assert ns[1].shape == (2, 3, 6, 6)
+
+    gru = crnn.Conv2DGRUCell(input_shape=(2, 6, 6), hidden_channels=3,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    gru.initialize()
+    st = gru.begin_state(batch_size=2)
+    out, ns = gru(x, st)
+    assert out.shape == (2, 3, 6, 6) and len(ns) == 1
+
+
+def test_conv_lstm_trains():
+    rng = np.random.RandomState(2)
+    cell = crnn.Conv2DLSTMCell(input_shape=(1, 4, 4), hidden_channels=2,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.nd.array(rng.randn(5, 2, 1, 4, 4).astype(np.float32))  # TNC...
+    for p in cell.collect_params().values():
+        p.grad_req = "write"
+    with autograd.record():
+        outputs, _ = cell.unroll(5, x, layout="TNC", merge_outputs=False)
+        loss = sum((o * o).sum() for o in outputs)
+    loss.backward()
+    g = cell.collect_params()[f"{cell.prefix}i2h_weight"].grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_even_h2h_kernel_rejected():
+    try:
+        crnn.Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=2,
+                           i2h_kernel=3, h2h_kernel=2)
+        assert False, "expected MXNetError"
+    except mx.base.MXNetError:
+        pass
+
+
+def test_variational_dropout_locked_mask():
+    from mxnet_tpu.gluon import rnn as grnn
+
+    rng = np.random.RandomState(3)
+    base = grnn.RNNCell(8, input_size=4)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                       drop_outputs=0.5)
+    cell.initialize()
+    x = mx.nd.array(rng.randn(6, 2, 4).astype(np.float32))
+    with autograd.record():  # train mode so dropout is live
+        outputs, _ = cell.unroll(6, x, layout="TNC", merge_outputs=False)
+    # the output mask is sampled once: zeroed units are zero at EVERY step
+    outs = np.stack([o.asnumpy() for o in outputs])   # (T, N, H)
+    zero_units = outs[0] == 0
+    if zero_units.any():
+        assert (outs[:, zero_units] == 0).all()
+
+
+def test_lstmp_projection():
+    rng = np.random.RandomState(4)
+    cell = crnn.LSTMPCell(hidden_size=8, projection_size=3, input_size=5)
+    cell.initialize()
+    x = mx.nd.array(rng.randn(2, 5).astype(np.float32))
+    st = cell.begin_state(batch_size=2)
+    assert st[0].shape == (2, 3) and st[1].shape == (2, 8)
+    out, ns = cell(x, st)
+    assert out.shape == (2, 3)          # projected
+    assert ns[1].shape == (2, 8)        # cell state full-size
+    # unroll works and stays finite
+    xs = mx.nd.array(rng.randn(4, 2, 5).astype(np.float32))
+    outputs, _ = cell.unroll(4, xs, layout="TNC", merge_outputs=False)
+    assert all(np.isfinite(o.asnumpy()).all() for o in outputs)

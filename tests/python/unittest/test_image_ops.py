@@ -92,3 +92,22 @@ def test_resize_and_crop():
                    height=3).asnumpy()
     assert cr.shape == (3, 2, 3)
     assert np.allclose(cr, x[0:3, 1:3, :])
+
+
+def test_resize_keep_ratio():
+    x = _img(4, 8)  # H=4 < W=8
+    out = invoke_nd("_image_resize", mx.nd.array(x), size=8,
+                    keep_ratio=True).asnumpy()
+    assert out.shape == (8, 16, 3)  # short edge → 8, ratio preserved
+
+
+def test_contrast_per_image_mean():
+    rng = np.random.RandomState(9)
+    dark = np.full((4, 4, 3), 10.0, np.float32)
+    bright = np.full((4, 4, 3), 200.0, np.float32)
+    batch = np.stack([dark, bright])
+    out = invoke_nd("_image_random_contrast", mx.nd.array(batch),
+                    min_factor=0.0, max_factor=0.0).asnumpy()
+    # factor 0 → each image collapses to ITS OWN gray mean, not the batch's
+    assert abs(out[0].mean() - 10.0) < 1e-3
+    assert abs(out[1].mean() - 200.0) < 1e-2
