@@ -19,3 +19,8 @@ from . import quantization_ops  # noqa: F401
 from . import optimizer_ops # noqa: F401
 from . import vision        # noqa: F401
 from . import image_ops     # noqa: F401
+
+# legacy v1 op names (reference `convolution_v1.cc` / `pooling_v1.cc`
+# register the pre-NNVM kernels under *_v1; numerically identical here)
+registry.alias("Convolution_v1", "Convolution")
+registry.alias("Pooling_v1", "Pooling")

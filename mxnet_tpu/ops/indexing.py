@@ -208,3 +208,36 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axi
     idx = jnp.where(steps[:, None] < lens[None, :], lens[None, :] - 1 - steps[:, None], steps[:, None])
     gather = jnp.take_along_axis(data, idx.reshape(seq, -1, *([1] * (data.ndim - 2))), axis=0)
     return gather
+
+
+@register("cast_storage")
+def _cast_storage_op(data, stype="default", **kw):
+    """Registered `cast_storage` (`tensor/cast_storage.cc`): at the dense
+    op layer every storage cast is identity on values — the FRONTEND
+    (`ndarray.sparse.cast_storage`) builds the actual
+    RowSparse/CSRNDArray wrappers; this op exists so symbolic graphs
+    carrying cast_storage nodes execute (dense fallback, the reference's
+    storage-fallback executor rule, `attach_op_execs_pass.cc:46`)."""
+    return data
+
+
+@register("sparse_retain")
+def _sparse_retain_op(data, indices, **kw):
+    """Registered `sparse_retain` (`tensor/sparse_retain.cc`): dense
+    rendering — zero every row NOT in `indices` (for a RowSparseNDArray
+    the frontend keeps only those rows; values agree)."""
+    rows = indices.reshape(-1).astype(jnp.int32)
+    keep = jnp.zeros((data.shape[0],), bool).at[rows].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("_square_sum", aliases=["square_sum"])
+def _square_sum_op(data, axis=None, keepdims=False, **kw):
+    """Registered `_square_sum` (`tensor/square_sum.cc`): sum(x^2) along
+    axis — the sparse-aware fused square+sum (dense rendering here; the
+    row_sparse path only touches occupied rows via the frontend)."""
+    from ._utils import reduce_axes, parse_bool
+
+    axes = reduce_axes(axis, data.ndim)
+    return jnp.sum(jnp.square(data), axis=axes,
+                   keepdims=parse_bool(keepdims))

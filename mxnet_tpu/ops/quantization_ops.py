@@ -143,3 +143,69 @@ def _quantized_pooling(data, min_data, max_data, kernel=(2, 2), stride=(),
     if str(pool_type) == "max":
         out = jnp.rint(out)
     return out.astype(data.dtype), min_data, max_data
+
+
+@register("_contrib_quantized_act", num_outputs=3)
+def _quantized_act(data, min_data, max_data, act_type="relu", **kw):
+    """int8 activation (`quantization/quantized_activation.cc`): relu on
+    int8 zeroes the negative codes. min/max pass through UNCHANGED — the
+    decode contract is maxabs-symmetric, so narrowing the declared range
+    without recoding would rescale every surviving value."""
+    if str(act_type) != "relu":
+        from ..base import MXNetError
+
+        raise MXNetError(f"quantized_act: only relu is supported, got "
+                         f"{act_type} (reference quantized_activation.cc)")
+    out = jnp.maximum(data, 0).astype(data.dtype)
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def _quantized_flatten(data, min_data, max_data, **kw):
+    """int8 flatten — pure reshape, range passthrough
+    (`quantized_flatten.cc`)."""
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_concat", num_outputs=3)
+def _quantized_concat(*args, dim=1, num_args=None, **kw):
+    """int8 concat (`quantization/quantized_concat.cc`): inputs are
+    (d0..dn-1, min0, max0, ..., minn-1, maxn-1); all inputs are REQUANTIZED
+    to the widest input range before concatenation."""
+    n = int(num_args) if num_args else len(args) // 3
+    datas = args[:n]
+    mins = args[n::2]
+    maxs = args[n + 1::2]
+    lo = jnp.minimum(jnp.stack([jnp.min(m) for m in mins]).min(),
+                     0.0)
+    hi = jnp.stack([jnp.max(m) for m in maxs]).max()
+    out_min = jnp.minimum(lo, -hi)   # symmetric int8 range
+    out_max = -out_min
+    scaled = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        in_range = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        scale = in_range / jnp.maximum(out_max, 1e-12)
+        scaled.append(jnp.clip(jnp.rint(d.astype(jnp.float32) * scale),
+                               -127, 127).astype(d.dtype))
+    return jnp.concatenate(scaled, axis=int(dim)), out_min, out_max
+
+
+@register("_contrib_quantized_elemwise_add", num_outputs=3)
+def _quantized_elemwise_add(a, b, min_a, max_a, min_b, max_b, **kw):
+    """int8 + int8 → int32 with combined range
+    (`quantized_elemwise_add.cc`): each operand is rescaled to a shared
+    fine scale before integer addition. The declared float range follows
+    the repo's int32 decode contract (value = code · maxabs / (2^31-1),
+    `_dequantize`), so dequantize/requantize on the output are exact."""
+    ra = jnp.maximum(jnp.abs(min_a), jnp.abs(max_a))
+    rb = jnp.maximum(jnp.abs(min_b), jnp.abs(max_b))
+    out_span = ra + rb                       # real-value magnitude bound
+    scale_out = out_span / (_INT8_MAX * _INT8_MAX)  # int32 code step
+    sa = ra / _INT8_MAX
+    sb = rb / _INT8_MAX
+    real = a.astype(jnp.float32) * sa + b.astype(jnp.float32) * sb
+    out_i32 = jnp.clip(jnp.rint(real / jnp.maximum(scale_out, 1e-12)),
+                       -_INT32_MAX, _INT32_MAX).astype(jnp.int32)
+    # range such that code·maxabs/INT32_MAX reproduces the real value
+    hi = scale_out * _INT32_MAX
+    return out_i32, -hi, hi

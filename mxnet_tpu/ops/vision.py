@@ -1028,3 +1028,50 @@ def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
            - ii[b, ch, y_hi, x_lo] + ii[b, ch, y_lo, x_lo])
     cnt = jnp.maximum((y_hi - y_lo) * (x_hi - x_lo), 1).astype(jnp.float32)
     return (tot / cnt).astype(data.dtype)                # (R, od, ps, ps)
+
+
+# ---------------------------------------------------------------------------
+# BilinearResize2D / div_sqrt_dim
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize2d(data, height=1, width=1, scale_height=None,
+                       scale_width=None, mode="size", **kw):
+    """Bilinear resize of NCHW feature maps (`bilinear_resize.cc`):
+    target from explicit (height, width) or per-axis scales. The
+    reference uses ALIGN-CORNERS sampling (`bilinear_resize-inl.h`:
+    rheight = (H_in-1)/(H_out-1), output corners land exactly on input
+    corners), which jax.image.resize's half-pixel 'linear' does not —
+    implemented as an explicit bilinear gather."""
+    n, c, h, w = data.shape
+    if scale_height not in (None, "None"):
+        oh = int(round(h * float(scale_height)))
+        ow = int(round(w * float(scale_width if scale_width not in
+                                 (None, "None") else scale_height)))
+    else:
+        oh, ow = int(height), int(width)
+
+    ys = jnp.arange(oh, dtype=jnp.float32) * ((h - 1) / max(oh - 1, 1))
+    xs = jnp.arange(ow, dtype=jnp.float32) * ((w - 1) / max(ow - 1, 1))
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    ly = (ys - y0)[:, None]
+    lx = (xs - x0)[None, :]
+    d = data.astype(jnp.float32)
+    v00 = d[:, :, y0[:, None], x0[None, :]]
+    v01 = d[:, :, y0[:, None], x1[None, :]]
+    v10 = d[:, :, y1[:, None], x0[None, :]]
+    v11 = d[:, :, y1[:, None], x1[None, :]]
+    out = ((1 - ly) * (1 - lx) * v00 + (1 - ly) * lx * v01 +
+           ly * (1 - lx) * v10 + ly * lx * v11)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data, **kw):
+    """data / sqrt(last_dim) (`contrib/transformer.cc` DivSqrtDim — the
+    attention-score scaling helper)."""
+    return data / jnp.sqrt(jnp.asarray(float(data.shape[-1]), data.dtype))

@@ -508,14 +508,25 @@ def _specs():
         [_r(1, 2, 5, 5, seed=53), _r(3, 2, 3, 3, seed=54), np.zeros(3, np.float32)],
         attrs={"kernel": (3, 3), "num_filter": 3}, grad=True,
         checker=lambda o, i: o.shape == (1, 3, 3, 3))
+    S["Convolution_v1"] = S["Convolution"]
     S["Deconvolution"] = Spec(
         [_r(1, 2, 3, 3, seed=55), _r(2, 3, 3, 3, seed=56)],
         attrs={"kernel": (3, 3), "num_filter": 3, "no_bias": True},
         checker=lambda o, i: o.shape == (1, 3, 5, 5))
+    S["cast_storage"] = Spec([a], attrs={"stype": "row_sparse"},
+                             oracle=lambda x: x)
+    S["sparse_retain"] = Spec(
+        [_r(4, 3, seed=70), np.array([0, 2], np.float32)],
+        oracle=lambda x, i: np.where(
+            np.isin(np.arange(4), i.astype(int))[:, None], x, 0))
+    S["_square_sum"] = Spec([xr], attrs={"axis": 1},
+                            oracle=lambda x: (x * x).sum(axis=1), grad=True)
+    S["square_sum"] = S["_square_sum"]
     S["Pooling"] = Spec([_r(1, 2, 4, 4, seed=57)],
                         attrs={"kernel": (2, 2), "pool_type": "max",
                                "stride": (2, 2)},
                         checker=lambda o, i: o.shape == (1, 2, 2, 2), grad=True)
+    S["Pooling_v1"] = S["Pooling"]
     S["UpSampling"] = Spec([_r(1, 2, 2, 2, seed=58)],
                            attrs={"scale": 2, "sample_type": "nearest"},
                            checker=lambda o, i: o.shape == (1, 2, 4, 4))
@@ -620,6 +631,10 @@ COVERED_ELSEWHERE = {
     # RNN — test_rnn_op.py / test_gluon_rnn.py
     "RNN": "test_gluon_rnn.py", "_rnn_param_concat": "test_gluon_rnn.py",
     # quantization — test_subgraph_quantization.py
+    "_contrib_quantized_act": "test_subgraph_quantization.py",
+    "_contrib_quantized_flatten": "test_subgraph_quantization.py",
+    "_contrib_quantized_concat": "test_subgraph_quantization.py",
+    "_contrib_quantized_elemwise_add": "test_subgraph_quantization.py",
     "_contrib_quantize_v2": "test_subgraph_quantization.py",
     "_contrib_dequantize": "test_subgraph_quantization.py",
     "_contrib_requantize": "test_subgraph_quantization.py",
@@ -647,6 +662,8 @@ COVERED_ELSEWHERE = {
     "_contrib_MultiBoxTarget": "test_vision_ops.py",
     "_contrib_MultiBoxDetection": "test_vision_ops.py",
     # RPN / R-FCN family — test_vision_ops.py
+    "_contrib_BilinearResize2D": "test_vision_ops.py",
+    "_contrib_div_sqrt_dim": "test_vision_ops.py",
     "_contrib_Proposal": "test_vision_ops.py",
     "_contrib_MultiProposal": "test_vision_ops.py",
     "_contrib_PSROIPooling": "test_vision_ops.py",

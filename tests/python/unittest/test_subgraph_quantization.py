@@ -320,3 +320,79 @@ def test_quantize_model_custom_data_name():
     y_q = qex.forward(is_train=False, images=nd.array(x))[0].asnumpy()
     rel = np.abs(y_q - y_fp).mean() / (np.abs(y_fp).mean() + 1e-8)
     assert rel < 0.05, rel
+
+
+def test_quantized_act_flatten():
+    from mxnet_tpu.ndarray.register import invoke_nd
+
+    d = mx.nd.array(np.array([[-5, 3], [7, -2]], np.int8).astype(np.float32)).astype("int8")
+    mn, mx_ = mx.nd.array(np.array([-1.0], np.float32)), mx.nd.array(np.array([1.0], np.float32))
+    out, omn, omx = invoke_nd("_contrib_quantized_act", d, mn, mx_, act_type="relu")
+    assert (out.asnumpy() >= 0).all()
+    # range passes through unchanged (maxabs decode contract)
+    assert float(omn.asnumpy()) == float(mn.asnumpy())
+    f, fmn, fmx = invoke_nd("_contrib_quantized_flatten",
+                            d.reshape((2, 2, 1)), mn, mx_)
+    assert f.shape == (2, 2)
+    assert np.allclose(fmn.asnumpy(), mn.asnumpy())
+
+
+def test_quantized_elemwise_add_range():
+    from mxnet_tpu.ndarray.register import invoke_nd
+
+    a = mx.nd.array(np.array([[127]], np.float32)).astype("int8")
+    b = mx.nd.array(np.array([[127]], np.float32)).astype("int8")
+    one = mx.nd.array(np.array([1.0], np.float32))
+    out, omn, omx = invoke_nd("_contrib_quantized_elemwise_add", a, b,
+                              -one, one, -one, one)
+    # 1.0 + 1.0 decodes to 2.0 through the standard dequantize contract
+    decoded = float(mx.nd.contrib.dequantize(out, omn, omx).asnumpy()[0, 0])
+    assert abs(decoded - 2.0) < 1e-2
+
+
+def test_quantized_act_preserves_decode():
+    """Asymmetric calib range [-4, 1]: quantized relu must leave the range
+    untouched (maxabs decode would rescale survivors otherwise)."""
+    from mxnet_tpu.ndarray.register import invoke_nd
+
+    x = nd.array(np.array([[1.0, -3.0]], np.float32))
+    q, mn, mx_ = invoke_nd("_contrib_quantize_v2", x,
+                           min_calib_range=-4.0, max_calib_range=1.0)
+    a, amn, amx = invoke_nd("_contrib_quantized_act", q, mn, mx_,
+                            act_type="relu")
+    back = nd.contrib.dequantize(a, amn, amx).asnumpy()
+    assert abs(back[0, 0] - 1.0) < 0.05          # 1.0 survives undistorted
+    assert back[0, 1] == 0.0
+
+
+def test_quantized_elemwise_add_dequantizes():
+    """The declared output range must satisfy the int32 decode contract:
+    dequantize(out, mn, mx) == a + b."""
+    from mxnet_tpu.ndarray.register import invoke_nd
+
+    rng = np.random.RandomState(4)
+    a = rng.uniform(-1, 1, (4, 4)).astype(np.float32)
+    b = rng.uniform(-2, 2, (4, 4)).astype(np.float32)
+    qa, mna, mxa = invoke_nd("_contrib_quantize_v2", nd.array(a))
+    qb, mnb, mxb = invoke_nd("_contrib_quantize_v2", nd.array(b))
+    out, mno, mxo = invoke_nd("_contrib_quantized_elemwise_add",
+                              qa, qb, mna, mxa, mnb, mxb)
+    back = nd.contrib.dequantize(out, mno, mxo).asnumpy()
+    np.testing.assert_allclose(back, a + b, atol=0.05)
+
+
+def test_quantized_concat():
+    """Inputs with different ranges requantize onto a common symmetric
+    range; dequantizing the concat reproduces the originals."""
+    from mxnet_tpu.ndarray.register import invoke_nd
+
+    a = np.array([[0.5, -1.0]], np.float32)
+    b = np.array([[3.0, -2.0]], np.float32)
+    qa, mna, mxa = invoke_nd("_contrib_quantize_v2", nd.array(a))
+    qb, mnb, mxb = invoke_nd("_contrib_quantize_v2", nd.array(b))
+    out, mno, mxo = invoke_nd("_contrib_quantized_concat",
+                              qa, qb, mna, mxa, mnb, mxb, dim=1, num_args=2)
+    assert out.shape == (1, 4)
+    back = nd.contrib.dequantize(out, mno, mxo).asnumpy()
+    np.testing.assert_allclose(back, np.concatenate([a, b], axis=1),
+                               atol=0.05)

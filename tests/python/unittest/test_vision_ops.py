@@ -398,3 +398,29 @@ def test_proposal_post_exceeds_anchor_count():
     r = rois.asnumpy()
     assert r.shape == (20, 5)
     assert np.isfinite(r).all()
+
+
+def test_bilinear_resize2d():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    out = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), height=8, width=8)
+    assert out.shape == (1, 2, 8, 8)
+    got = out.asnumpy()
+    # ALIGN-CORNERS contract (bilinear_resize-inl.h): output corners equal
+    # input corners exactly
+    assert np.allclose(got[..., 0, 0], x[..., 0, 0], atol=1e-6)
+    assert np.allclose(got[..., -1, -1], x[..., -1, -1], atol=1e-6)
+    # midpoints interpolate linearly along an axis
+    row = mx.nd.contrib.BilinearResize2D(
+        mx.nd.array(np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)),
+        height=1, width=7).asnumpy().ravel()
+    assert np.allclose(row, np.linspace(0, 3, 7), atol=1e-6)
+    out2 = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), scale_height=2.0,
+                                          scale_width=2.0)
+    assert out2.shape == (1, 2, 8, 8)
+
+
+def test_div_sqrt_dim():
+    x = np.ones((2, 3, 16), np.float32)
+    out = mx.nd.contrib.div_sqrt_dim(mx.nd.array(x)).asnumpy()
+    assert np.allclose(out, 1.0 / 4.0)
