@@ -1044,6 +1044,13 @@ def _bilinear_resize2d(data, height=1, width=1, scale_height=None,
     rheight = (H_in-1)/(H_out-1), output corners land exactly on input
     corners), which jax.image.resize's half-pixel 'linear' does not —
     implemented as an explicit bilinear gather."""
+    if str(mode) != "size":
+        from ..base import MXNetError
+
+        raise MXNetError(
+            f"BilinearResize2D: mode={mode!r} is not supported (only "
+            f"'size'; the reference's like/odd_scale modes need a second "
+            f"input / odd rounding this build does not implement)")
     n, c, h, w = data.shape
     if scale_height not in (None, "None"):
         oh = int(round(h * float(scale_height)))

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Kill stray training worker processes (parity: `tools/kill-mxnet.py`,
+which pdsh'd pkill over a host file). Single-host rendering for the
+jax.distributed launcher: kills lingering processes whose command line
+matches the given program (default: any tools/launch.py worker)."""
+import argparse
+import os
+import signal
+import sys
+
+
+def find_procs(pattern):
+    """Match `pattern` against each process's cmdline OR environment —
+    launcher workers are identified by their MXNET_PROCESS_ID env var,
+    which never appears on the command line."""
+    pids = []
+    me = os.getpid()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except OSError:
+            continue
+        if (pattern in cmd or pattern in env) and "kill-mxnet" not in cmd:
+            pids.append((int(pid), cmd.strip()))
+    return pids
+
+
+def main():
+    p = argparse.ArgumentParser(description="kill stray worker processes")
+    p.add_argument("pattern", nargs="?", default="MXNET_PROCESS_ID",
+                   help="substring of the worker command line or environ "
+                        "(default: launcher-spawned workers)")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args()
+
+    procs = find_procs(args.pattern)
+    if not procs:
+        print("no matching processes")
+        return 0
+    for pid, cmd in procs:
+        print(f"{'would kill' if args.dry_run else 'killing'} {pid}: "
+              f"{cmd[:120]}")
+        if not args.dry_run:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError as e:
+                print(f"  failed: {e}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown/csv table (parity:
+`tools/parse_log.py` — epoch, train/validation metric, speed columns from
+the Module/fit logging format this framework emits)."""
+import argparse
+import re
+import sys
+
+
+def parse(path):
+    """Returns rows of {epoch, train, val, speed} parsed from fit logs."""
+    rows = {}
+    with open(path) as f:
+        for line in f:
+            m = re.search(r"Epoch\[(\d+)\] Train-([\w-]+)=([0-9.eE+-]+)", line)
+            if m:
+                rows.setdefault(int(m.group(1)), {})["train"] = float(m.group(3))
+            m = re.search(r"Epoch\[(\d+)\] Validation-([\w-]+)=([0-9.eE+-]+)",
+                          line)
+            if m:
+                rows.setdefault(int(m.group(1)), {})["val"] = float(m.group(3))
+            m = re.search(r"Epoch\[(\d+)\].*Speed: ([0-9.]+) samples/sec",
+                          line)
+            if m:
+                e = rows.setdefault(int(m.group(1)), {})
+                e.setdefault("speeds", []).append(float(m.group(2)))
+            m = re.search(r"Epoch\[(\d+)\] Time cost=([0-9.]+)", line)
+            if m:
+                rows.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser(description="parse training log into a table")
+    p.add_argument("logfile", type=str)
+    p.add_argument("--format", choices=["markdown", "csv"],
+                   default="markdown")
+    args = p.parse_args()
+
+    rows = parse(args.logfile)
+    hdr = ["epoch", "train", "val", "speed (samples/s)", "time (s)"]
+    sep = {"markdown": " | ", "csv": ","}[args.format]
+    print(sep.join(hdr))
+    if args.format == "markdown":
+        print(sep.join("---" for _ in hdr))
+    for epoch in sorted(rows):
+        r = rows[epoch]
+        speed = sum(r.get("speeds", [])) / len(r["speeds"]) \
+            if r.get("speeds") else ""
+        vals = [str(epoch), r.get("train", ""), r.get("val", ""),
+                f"{speed:.1f}" if speed != "" else "", r.get("time", "")]
+        print(sep.join(str(v) for v in vals))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
