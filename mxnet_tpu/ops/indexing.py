@@ -125,19 +125,24 @@ def _boolean_mask(data, index, axis=0, **kw):
 
 @register("ravel_multi_index", aliases=["_ravel_multi_index"])
 def _ravel_multi_index(data, shape=None, **kw):
+    """Row-major flat indices (`tensor/ravel.cc`). Arithmetic in int32 —
+    float32 would silently lose exactness above 2^24; flat spaces beyond
+    2^31 need jax x64 (documented divergence from the reference's int64
+    build, `tests/nightly/test_large_array.py`)."""
     from ._utils import as_tuple
 
     shape = as_tuple(shape)
-    out = jnp.zeros(data.shape[1:], dtype=data.dtype)
+    out = jnp.zeros(data.shape[1:], dtype=jnp.int32)
     stride = 1
     for i in range(len(shape) - 1, -1, -1):
-        out = out + data[i] * stride
+        out = out + data[i].astype(jnp.int32) * jnp.int32(stride)
         stride *= shape[i]
     return out
 
 
 @register("unravel_index", aliases=["_unravel_index"])
 def _unravel_index(data, shape=None, **kw):
+    """Flat → multi indices, int32 arithmetic (see ravel_multi_index)."""
     from ._utils import as_tuple
 
     shape = as_tuple(shape)
@@ -151,8 +156,8 @@ def _unravel_index(data, shape=None, **kw):
         stride *= s
     strides = list(reversed(strides))
     for i, s in enumerate(shape):
-        outs.append((rem // strides[i]) % s)
-    return jnp.stack(outs, axis=0).astype(data.dtype)
+        outs.append((rem // jnp.int32(strides[i])) % jnp.int32(s))
+    return jnp.stack(outs, axis=0).astype(jnp.int32)
 
 
 @register("_contrib_index_copy")

@@ -1,0 +1,84 @@
+"""Nightly-suite parity checks (reference `tests/nightly/`):
+- large-array int64 indexing (`test_large_array.py` role, scaled to CI)
+- backwards-compat: a reference-era symbol JSON (the exact nnvm format,
+  `legacy_json_util.cc` territory) loads and executes.
+"""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+
+
+def test_large_flat_index_roundtrip():
+    """Flat index spaces beyond float32's 2^24 exact-integer limit must
+    stay exact: ravel/unravel compute in int32, covering logical spaces up
+    to 2^31 elements (reference test_large_array.py contract; beyond 2^31
+    requires jax x64 — documented divergence)."""
+    shape = (2, 30_000, 30_000)            # 1.8e9 elements, > 2^24, < 2^31
+    idx = np.array([[1, 1, 0], [29_999, 123, 7], [29_999, 17, 31]],
+                   np.int64)               # (k=3, n=3) multi-indices
+    flat = np.ravel_multi_index(idx, shape)
+    assert flat.max() > 2 ** 24            # float32 would corrupt these
+    got = nd.ravel_multi_index(nd.array(idx.astype(np.float64)),
+                               shape=shape)
+    np.testing.assert_allclose(got.asnumpy().astype(np.int64), flat)
+    back = nd.unravel_index(nd.array(flat.astype(np.float64),
+                                     dtype="int32"), shape=shape)
+    np.testing.assert_allclose(back.asnumpy(), idx)
+
+
+def test_large_take_int64_rows():
+    """Gather from a table whose row space exceeds int32 BYTES (the common
+    int64 failure: offsets computed as rows * row_bytes in 32-bit)."""
+    rows = 1_200_000
+    w = nd.arange(0, rows).reshape((rows, 1))
+    picks = np.array([0, 999_999, 1_199_999], np.float32)
+    out = nd.take(w, nd.array(picks)).asnumpy().ravel()
+    np.testing.assert_allclose(out, picks)
+
+
+REFERENCE_ERA_JSON = json.dumps({
+    # the nnvm graph format MXNet 1.5 emits (Symbol.tojson): nodes with
+    # string-typed attrs, 3-tuple node_row_ptr-free heads
+    "nodes": [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "fc1_weight", "inputs": []},
+        {"op": "null", "name": "fc1_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc1",
+         "attrs": {"num_hidden": "8"},
+         "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        {"op": "Activation", "name": "act1",
+         "attrs": {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+        {"op": "null", "name": "fc2_weight", "inputs": []},
+        {"op": "null", "name": "fc2_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc2",
+         "attrs": {"num_hidden": "3"},
+         "inputs": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]},
+    ],
+    "arg_nodes": [0, 1, 2, 5, 6],
+    "node_row_ptr": list(range(9)),
+    "heads": [[7, 0, 0]],
+    "attrs": {"mxnet_version": ["int", 10500]},
+})
+
+
+def test_reference_era_json_loads_and_runs():
+    net = sym.load_json(REFERENCE_ERA_JSON)
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias"]
+    ex = net.simple_bind(grad_req="null", data=(2, 5))
+    rng = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = nd.array(rng.uniform(-1, 1, v.shape).astype(np.float32))
+    out = ex.forward(is_train=False,
+                     data=nd.array(rng.randn(2, 5).astype(np.float32)))[0]
+    assert out.shape == (2, 3)
+    assert np.isfinite(out.asnumpy()).all()
+    # and our own serialization round-trips it
+    js2 = net.tojson()
+    net2 = sym.load_json(js2)
+    assert net2.list_arguments() == net.list_arguments()

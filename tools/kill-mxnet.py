@@ -21,10 +21,13 @@ def find_procs(pattern):
         try:
             with open(f"/proc/{pid}/cmdline", "rb") as f:
                 cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except OSError:
+            continue
+        try:
             with open(f"/proc/{pid}/environ", "rb") as f:
                 env = f.read().replace(b"\0", b" ").decode(errors="replace")
         except OSError:
-            continue
+            env = ""  # non-dumpable process: fall back to cmdline matching
         if (pattern in cmd or pattern in env) and "kill-mxnet" not in cmd:
             pids.append((int(pid), cmd.strip()))
     return pids

@@ -8,17 +8,26 @@ import sys
 
 
 def parse(path):
-    """Returns rows of {epoch, train, val, speed} parsed from fit logs."""
+    """Returns ({epoch: {column: value}}, ordered column names) parsed from
+    fit logs — one column per distinct train/validation METRIC (multiple
+    metrics per epoch must not overwrite each other)."""
     rows = {}
+    columns = []
+
+    def put(epoch, col, value):
+        if col not in columns:
+            columns.append(col)
+        rows.setdefault(epoch, {})[col] = value
+
     with open(path) as f:
         for line in f:
             m = re.search(r"Epoch\[(\d+)\] Train-([\w-]+)=([0-9.eE+-]+)", line)
             if m:
-                rows.setdefault(int(m.group(1)), {})["train"] = float(m.group(3))
+                put(int(m.group(1)), f"train-{m.group(2)}", float(m.group(3)))
             m = re.search(r"Epoch\[(\d+)\] Validation-([\w-]+)=([0-9.eE+-]+)",
                           line)
             if m:
-                rows.setdefault(int(m.group(1)), {})["val"] = float(m.group(3))
+                put(int(m.group(1)), f"val-{m.group(2)}", float(m.group(3)))
             m = re.search(r"Epoch\[(\d+)\].*Speed: ([0-9.]+) samples/sec",
                           line)
             if m:
@@ -26,8 +35,8 @@ def parse(path):
                 e.setdefault("speeds", []).append(float(m.group(2)))
             m = re.search(r"Epoch\[(\d+)\] Time cost=([0-9.]+)", line)
             if m:
-                rows.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
-    return rows
+                put(int(m.group(1)), "time (s)", float(m.group(2)))
+    return rows, columns
 
 
 def main():
@@ -37,8 +46,9 @@ def main():
                    default="markdown")
     args = p.parse_args()
 
-    rows = parse(args.logfile)
-    hdr = ["epoch", "train", "val", "speed (samples/s)", "time (s)"]
+    rows, columns = parse(args.logfile)
+    hdr = ["epoch"] + [c for c in columns if c != "time (s)"] + \
+        ["speed (samples/s)"] + (["time (s)"] if "time (s)" in columns else [])
     sep = {"markdown": " | ", "csv": ","}[args.format]
     print(sep.join(hdr))
     if args.format == "markdown":
@@ -47,9 +57,13 @@ def main():
         r = rows[epoch]
         speed = sum(r.get("speeds", [])) / len(r["speeds"]) \
             if r.get("speeds") else ""
-        vals = [str(epoch), r.get("train", ""), r.get("val", ""),
-                f"{speed:.1f}" if speed != "" else "", r.get("time", "")]
-        print(sep.join(str(v) for v in vals))
+        vals = [str(epoch)]
+        for c in hdr[1:]:
+            if c == "speed (samples/s)":
+                vals.append(f"{speed:.1f}" if speed != "" else "")
+            else:
+                vals.append(str(r.get(c, "")))
+        print(sep.join(vals))
     return 0
 
 
