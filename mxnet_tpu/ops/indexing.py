@@ -125,10 +125,12 @@ def _boolean_mask(data, index, axis=0, **kw):
 
 @register("ravel_multi_index", aliases=["_ravel_multi_index"])
 def _ravel_multi_index(data, shape=None, **kw):
-    """Row-major flat indices (`tensor/ravel.cc`). Arithmetic in int32 —
-    float32 would silently lose exactness above 2^24; flat spaces beyond
-    2^31 need jax x64 (documented divergence from the reference's int64
-    build, `tests/nightly/test_large_array.py`)."""
+    """Row-major flat indices (`tensor/ravel.cc`). Arithmetic hardcoded to
+    int32 — float32 would silently lose exactness above 2^24. Flat spaces
+    beyond 2^31 elements are UNSUPPORTED in this build (the reference's
+    int64 large-tensor build covers them, `tests/nightly/
+    test_large_array.py`; int64 here would additionally require jax x64
+    and an int64 code path)."""
     from ._utils import as_tuple
 
     shape = as_tuple(shape)
@@ -142,7 +144,8 @@ def _ravel_multi_index(data, shape=None, **kw):
 
 @register("unravel_index", aliases=["_unravel_index"])
 def _unravel_index(data, shape=None, **kw):
-    """Flat → multi indices, int32 arithmetic (see ravel_multi_index)."""
+    """Flat → multi indices; int32 arithmetic, same <2^31 contract as
+    ravel_multi_index."""
     from ._utils import as_tuple
 
     shape = as_tuple(shape)

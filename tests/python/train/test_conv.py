@@ -1,0 +1,50 @@
+"""Trainer-level integration: small convnet via the gluon front door
+(reference `tests/python/train/test_conv.py` role)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+from mxnet_tpu.io import NDArrayIter
+
+
+def _blocks_data(n=512, seed=3):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 4, n).astype(np.float32)
+    X = 0.1 * rng.rand(n, 1, 16, 16).astype(np.float32)
+    for i in range(n):
+        c = int(y[i])
+        X[i, 0, (c // 2) * 8:(c // 2) * 8 + 8,
+          (c % 2) * 8:(c % 2) * 8 + 8] += 0.9
+    return X, y
+
+
+def test_convnet_learns_spatial_classes():
+    X, y = _blocks_data()
+    it = NDArrayIter(X, y, 32, shuffle=True)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.GlobalAvgPool2D(),
+            nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.2, "momentum": 0.9})
+    sce = gloss.SoftmaxCrossEntropyLoss()
+    for _ in range(6):
+        it.reset()
+        for b in it:
+            with autograd.record():
+                out = net(b.data[0])
+                loss = sce(out, b.label[0])
+            loss.backward()
+            trainer.step(32)
+    it.reset()
+    correct = total = 0
+    for b in it:
+        pred = net(b.data[0]).asnumpy().argmax(1)
+        correct += (pred == b.label[0].asnumpy()).sum()
+        total += pred.size
+    assert correct / total > 0.95, f"convnet accuracy {correct / total}"

@@ -1,0 +1,51 @@
+"""Trainer-level integration across dtypes (reference
+`tests/python/train/test_dtype.py`): the same net must reach the accuracy
+threshold in fp32 AND bf16 multi-precision."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+from mxnet_tpu.io import NDArrayIter
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_mlp_dtype_threshold(dtype):
+    rng = np.random.RandomState(0)
+    n = 512
+    X = rng.uniform(-1, 1, (n, 16)).astype(np.float32)
+    w = rng.uniform(-1, 1, (16, 3)).astype(np.float32)
+    y = (X @ w).argmax(1).astype(np.float32)
+    it = NDArrayIter(X, y, 32, shuffle=True)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    if dtype != "float32":
+        net.cast(dtype)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9,
+                       "multi_precision": dtype != "float32"})
+    sce = gloss.SoftmaxCrossEntropyLoss()
+    for _ in range(10):
+        it.reset()
+        for b in it:
+            x = b.data[0]
+            if dtype != "float32":
+                x = x.astype(dtype)
+            with autograd.record():
+                loss = sce(net(x), b.label[0])
+            loss.backward()
+            trainer.step(32)
+    it.reset()
+    correct = total = 0
+    for b in it:
+        x = b.data[0]
+        if dtype != "float32":
+            x = x.astype(dtype)
+        pred = net(x).astype("float32").asnumpy().argmax(1)
+        correct += (pred == b.label[0].asnumpy()).sum()
+        total += pred.size
+    assert correct / total > 0.9, f"{dtype} accuracy {correct / total}"

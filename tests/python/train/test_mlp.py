@@ -1,0 +1,49 @@
+"""Trainer-level integration: MLP on separable synthetic digits via
+Module.fit with an accuracy threshold (reference `tests/python/train/
+test_mlp.py` — small real training, not a smoke test)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+
+
+def _data(n=1024, seed=7):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    X = 0.1 * rng.rand(n, 1, 28, 28).astype(np.float32)
+    for i in range(n):
+        c = int(y[i])
+        X[i, 0, (c // 5) * 14:(c // 5) * 14 + 14,
+          (c % 5) * 5:(c % 5) * 5 + 5] += 0.8
+    split = int(0.9 * n)
+    return (NDArrayIter(X[:split], y[:split], 64, shuffle=True),
+            NDArrayIter(X[split:], y[split:], 64))
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.Flatten(data)
+    net = sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_mlp_accuracy_threshold():
+    train, val = _data()
+    mod = Module(_mlp())
+    mod.fit(train, eval_data=val, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    assert acc > 0.95, f"MLP failed to train: accuracy {acc}"
+
+
+def test_mlp_adam_accuracy_threshold():
+    train, val = _data(seed=11)
+    mod = Module(_mlp())
+    mod.fit(train, eval_data=val, num_epoch=4, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3})
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    assert acc > 0.95, f"Adam MLP failed to train: accuracy {acc}"
