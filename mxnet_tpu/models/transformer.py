@@ -42,6 +42,15 @@ class TransformerLMConfig:
     dtype: str = "bfloat16"
     causal: bool = True
     tie_embeddings: bool = True
+    # Mixture-of-Experts (beyond-parity; the GShard/Switch recipe):
+    # moe_experts > 0 turns every `moe_every`-th FFN into a top-1-routed
+    # expert layer whose expert dim shards over the 'ep' mesh axis (or the
+    # 'dp' axis when no dedicated ep axis exists — the standard deployment:
+    # all-to-all rides the data-parallel group).
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.5
+    moe_aux_loss: float = 0.01
 
 
 def _spec(mesh, *axes):
@@ -60,6 +69,17 @@ class TransformerLM:
         self.cfg = config
         self.mesh = mesh or default_mesh()
 
+    def _is_moe(self, i):
+        c = self.cfg
+        return c.moe_experts > 0 and (i % max(c.moe_every, 1)) == \
+            max(c.moe_every, 1) - 1
+
+    @property
+    def _ep_axis(self):
+        # dedicated 'ep' axis when the mesh has one, else experts shard
+        # over the data-parallel group (GShard deployment)
+        return "ep" if "ep" in self.mesh.shape else "dp"
+
     # -- parameters ---------------------------------------------------------
 
     def param_specs(self):
@@ -70,6 +90,7 @@ class TransformerLM:
             "ln_f_scale": _spec(mesh, None),
             "ln_f_bias": _spec(mesh, None),
         }
+        ep = self._ep_axis
         for i in range(c.n_layers):
             specs.update({
                 f"l{i}.ln1_scale": _spec(mesh, None),
@@ -78,11 +99,22 @@ class TransformerLM:
                 f"l{i}.wo": _spec(mesh, "tp", None),     # [D, D] row-parallel
                 f"l{i}.ln2_scale": _spec(mesh, None),
                 f"l{i}.ln2_bias": _spec(mesh, None),
-                f"l{i}.w1": _spec(mesh, None, "tp"),     # [D, F] col-parallel
-                f"l{i}.b1": _spec(mesh, "tp"),
-                f"l{i}.w2": _spec(mesh, "tp", None),     # [F, D] row-parallel
-                f"l{i}.b2": _spec(mesh, None),
             })
+            if self._is_moe(i):
+                specs.update({
+                    f"l{i}.router": _spec(mesh, None, None),       # [D, E]
+                    f"l{i}.we1": _spec(mesh, ep, None, "tp"),      # [E, D, F]
+                    f"l{i}.be1": _spec(mesh, ep, "tp"),            # [E, F]
+                    f"l{i}.we2": _spec(mesh, ep, "tp", None),      # [E, F, D]
+                    f"l{i}.be2": _spec(mesh, ep, None),            # [E, D]
+                })
+            else:
+                specs.update({
+                    f"l{i}.w1": _spec(mesh, None, "tp"),  # [D, F] col-parallel
+                    f"l{i}.b1": _spec(mesh, "tp"),
+                    f"l{i}.w2": _spec(mesh, "tp", None),  # [F, D] row-parallel
+                    f"l{i}.b2": _spec(mesh, None),
+                })
         if not c.tie_embeddings:
             specs["lm_head"] = _spec(mesh, None, "tp")
         return specs
@@ -102,9 +134,21 @@ class TransformerLM:
                 f"l{i}.wqkv": (c.d_model, 3 * c.d_model),
                 f"l{i}.wo": (c.d_model, c.d_model),
                 f"l{i}.ln2_scale": (c.d_model,), f"l{i}.ln2_bias": (c.d_model,),
-                f"l{i}.w1": (c.d_model, c.d_ff), f"l{i}.b1": (c.d_ff,),
-                f"l{i}.w2": (c.d_ff, c.d_model), f"l{i}.b2": (c.d_model,),
             })
+            if self._is_moe(i):
+                e = c.moe_experts
+                shapes.update({
+                    f"l{i}.router": (c.d_model, e),
+                    f"l{i}.we1": (e, c.d_model, c.d_ff),
+                    f"l{i}.be1": (e, c.d_ff),
+                    f"l{i}.we2": (e, c.d_ff, c.d_model),
+                    f"l{i}.be2": (e, c.d_model),
+                })
+            else:
+                shapes.update({
+                    f"l{i}.w1": (c.d_model, c.d_ff), f"l{i}.b1": (c.d_ff,),
+                    f"l{i}.w2": (c.d_ff, c.d_model), f"l{i}.b2": (c.d_model,),
+                })
         if not c.tie_embeddings:
             shapes["lm_head"] = (c.d_model, c.vocab_size)
 
@@ -114,10 +158,12 @@ class TransformerLM:
         for (name, shape), k in zip(sorted(shapes.items()), keys):
             if name.endswith(("_scale",)):
                 val = jnp.ones(shape, dt)
-            elif name.endswith(("_bias", ".b1", ".b2")):
+            elif name.endswith(("_bias", ".b1", ".b2", ".be1", ".be2")):
                 val = jnp.zeros(shape, dt)
             else:
-                fan_in = shape[0]
+                # 3-D expert weights are per-expert matrices: fan over the
+                # contracted dim, not the expert dim
+                fan_in = shape[-2] if len(shape) == 3 else shape[0]
                 val = (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dt)
             params[name] = jax.device_put(val, specs[name])
         return params
@@ -153,8 +199,55 @@ class TransformerLM:
         o, m, l = _block_attn(q, k, v, bias)
         return o / _bhql_to_bqhl(l)
 
-    def forward(self, params, tokens):
-        """tokens [B, L] int32 → logits [B, L, V] (compute dtype, fp32 at loss)."""
+    def _moe_ffn(self, i, params, x):
+        """Top-1 ("Switch") expert FFN — the GShard GROUPED dispatch/
+        combine einsum recipe with STATIC per-group capacity: tokens are
+        grouped by batch row (G=B), each group routes at most C =
+        ceil(cf·L/E) tokens to an expert, dispatch (G, L, E, C) one-hots
+        move kept tokens into expert buffers (the all-to-all when experts
+        shard over ep/dp), experts batch-apply their FFN, combine scales
+        by the router gate. Grouping keeps dispatch memory O(S·E·C) with
+        C ∝ L/E instead of the ungrouped O(S²). Returns (out, aux)."""
+        c = self.cfg
+        dt = x.dtype
+        B, L, D = x.shape
+        E = c.moe_experts
+        C = max(1, int(np.ceil(c.moe_capacity_factor * L / E)))
+
+        logits = (x.astype(jnp.float32) @
+                  params[f"l{i}.router"].astype(jnp.float32))     # (B, L, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                       # (B, L)
+        gate = jnp.max(probs, axis=-1)                            # (B, L)
+
+        mask = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # (B, L, E)
+        # position of each token within its expert's PER-GROUP buffer
+        pos = (jnp.cumsum(mask, axis=1) - 1.0) * mask             # (B, L, E)
+        keep = mask * (pos < C)
+        # load-balancing aux loss (Switch eq. 4) from the PRE-capacity
+        # assignment — post-capacity f saturates at cf/E exactly when
+        # routing collapses, killing the balance gradient
+        f = mask.mean(axis=(0, 1))
+        pmean = probs.mean(axis=(0, 1))
+        aux = E * jnp.sum(f * pmean)
+
+        slot = jax.nn.one_hot(jnp.sum(pos * keep, axis=2).astype(jnp.int32),
+                              C, dtype=jnp.float32)               # (B, L, C)
+        dispatch = keep[:, :, :, None] * slot[:, :, None, :]      # (B, L, E, C)
+        combine = dispatch * gate[:, :, None, None]
+
+        xe = jnp.einsum("glec,gld->gecd", dispatch.astype(dt), x)  # (B,E,C,D)
+        h1 = jax.nn.gelu(
+            jnp.einsum("gecd,edf->gecf", xe, params[f"l{i}.we1"]) +
+            params[f"l{i}.be1"].astype(dt)[None, :, None, :])
+        h2 = jnp.einsum("gecf,efd->gecd", h1, params[f"l{i}.we2"]) + \
+            params[f"l{i}.be2"].astype(dt)[None, :, None, :]
+        out = jnp.einsum("glec,gecd->gld", combine.astype(dt), h2)
+        return out, aux
+
+    def forward(self, params, tokens, return_aux=False):
+        """tokens [B, L] int32 → logits [B, L, V] (compute dtype, fp32 at
+        loss); with return_aux also the summed MoE load-balance loss."""
         c, mesh = self.cfg, self.mesh
         dt = jnp.dtype(c.dtype)
         B, L = tokens.shape
@@ -164,6 +257,7 @@ class TransformerLM:
         h = jnp.take(params["embed"], tokens, axis=0).astype(dt)
         h = h + params["pos_embed"][None, :L].astype(dt)
         h = lax.with_sharding_constraint(h, NamedSharding(mesh, act))
+        aux_total = jnp.asarray(0.0, jnp.float32)
 
         for i in range(c.n_layers):
             ln1 = self._ln(h, params[f"l{i}.ln1_scale"], params[f"l{i}.ln1_bias"])
@@ -177,21 +271,30 @@ class TransformerLM:
             h = h + attn @ params[f"l{i}.wo"]              # row-parallel: XLA psums over tp
             h = lax.with_sharding_constraint(h, NamedSharding(mesh, act))
             ln2 = self._ln(h, params[f"l{i}.ln2_scale"], params[f"l{i}.ln2_bias"])
-            ff = jax.nn.gelu(ln2 @ params[f"l{i}.w1"] + params[f"l{i}.b1"].astype(dt))
-            h = h + ff @ params[f"l{i}.w2"] + params[f"l{i}.b2"].astype(dt)
+            if self._is_moe(i):
+                ff, aux = self._moe_ffn(i, params, ln2)
+                aux_total = aux_total + aux
+                h = h + ff
+            else:
+                ff = jax.nn.gelu(ln2 @ params[f"l{i}.w1"] + params[f"l{i}.b1"].astype(dt))
+                h = h + ff @ params[f"l{i}.w2"] + params[f"l{i}.b2"].astype(dt)
             h = lax.with_sharding_constraint(h, NamedSharding(mesh, act))
 
         h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
         head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-        return h @ head.astype(dt)
+        logits = h @ head.astype(dt)
+        if return_aux:
+            return logits, aux_total
+        return logits
 
     # -- training -----------------------------------------------------------
 
     def loss(self, params, tokens, targets):
-        logits = self.forward(params, tokens).astype(jnp.float32)
+        logits, aux = self.forward(params, tokens, return_aux=True)
+        logits = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return nll.mean()
+        return nll.mean() + self.cfg.moe_aux_loss * aux
 
     def make_train_step(self, optimizer=None, lr=1e-3):
         """Return jitted (params, opt_state, tokens, targets) -> (params,
