@@ -36,7 +36,7 @@ def pipeline_step(stage_fn, params_stack, x_microbatches, axis_name, axis_size):
     state = _pvary(zero, (axis_name,))
     outputs = _pvary(jnp.broadcast_to(zero, (m,) + h_shape), (axis_name,))
 
-    def tick(t, carry):
+    def tick(carry, t):
         state, outputs = carry
         # stage 0 ingests microbatch t (when available)
         feed = jnp.where(t < m, 1, 0)
@@ -50,9 +50,11 @@ def pipeline_step(stage_fn, params_stack, x_microbatches, axis_name, axis_size):
         outputs = jnp.where(valid, updated, outputs)
         # hand off to next stage
         state = lax.ppermute(state, axis_name, perm)
-        return state, outputs
+        return (state, outputs), None
 
-    _, outputs = lax.fori_loop(0, n_ticks, tick, (state, outputs))
+    # lax.scan (not fori_loop): the tick loop must be REVERSE-differentiable
+    # so pipeline training steps can backprop through the schedule
+    (_, outputs), _ = lax.scan(tick, (state, outputs), jnp.arange(n_ticks))
     # results live on the last stage only; broadcast to every stage so the
     # output is replicated over 'pp' (a masked psum = one-to-all over ICI)
     outputs = lax.psum(jnp.where(idx == axis_size - 1, outputs, 0 * outputs),
