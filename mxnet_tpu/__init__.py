@@ -66,6 +66,8 @@ from . import util
 from . import visualization
 from . import contrib
 from . import attribute
+from . import registry
+from . import rtc
 from .attribute import AttrScope
 from . import name
 from .name import NameManager

@@ -11,7 +11,7 @@ import sys
 import time
 
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric", "Speedometer",
-           "ProgressBar"]
+           "ProgressBar", "LogValidationMetricsCallback"]
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
@@ -98,3 +98,15 @@ class ProgressBar:
         percents = math.ceil(100.0 * count / float(self.total))
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
         sys.stdout.write(f"[{prog_bar}] {percents}%\r")
+
+
+class LogValidationMetricsCallback:
+    """Log validation metrics at each epoch end (parity `callback.py`
+    LogValidationMetricsCallback)."""
+
+    def __call__(self, param):
+        if not param.eval_metric:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

@@ -27,6 +27,12 @@ _INIT_REGISTRY = {}
 
 def register(klass):
     _INIT_REGISTRY[klass.__name__.lower()] = klass
+    # also into the generic factory (reference initializer.py builds its
+    # register/create on registry.py) so mx.registry views agree and the
+    # JSON '[name, kwargs]' spec form works
+    from .registry import get_register_func
+
+    get_register_func(Initializer, "initializer")(klass)
     return klass
 
 
@@ -312,3 +318,15 @@ class Mixed:
 
 
 _register_aliases()
+
+
+# factory face: preserves get()'s contract (instance | name | None →
+# Uniform default, including the 'zeros'/'ones' aliases) and adds the
+# generic registry.py JSON '[name, kwargs]' spec form
+def create(*args, **kwargs):
+    if args and (args[0] is None or isinstance(args[0], Initializer) or
+                 (isinstance(args[0], str) and not args[0].startswith("["))):
+        return get(args[0], **kwargs)
+    from .registry import get_create_func
+
+    return get_create_func(Initializer, "initializer")(*args, **kwargs)

@@ -1,0 +1,64 @@
+"""Runtime kernel compilation (parity: `python/mxnet/rtc.py` CudaModule
+over `include/mxnet/rtc.h:39` NVRTC).
+
+TPU-native replacement: there is no NVRTC; runtime kernel compilation on
+TPU is jax.jit (XLA) and Pallas (`jax.experimental.pallas`) — see
+`mxnet_tpu/gradient_compression.py` `quantize_2bit_pallas` for the
+in-tree example. `XlaModule` offers the CudaModule-shaped API over a
+python kernel function; `CudaModule` itself raises with that pointer
+(documented divergence)."""
+from __future__ import annotations
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "XlaModule"]
+
+
+class CudaModule:
+    """Unsupported on TPU (reference rtc.py compiled CUDA source at
+    runtime). Use :class:`XlaModule` / Pallas instead."""
+
+    def __init__(self, *a, **kw):
+        raise MXNetError(
+            "CudaModule (NVRTC) does not exist on TPU. Write the kernel as "
+            "a jax/Pallas function and wrap it with mxnet_tpu.rtc.XlaModule "
+            "(runtime compilation is XLA's job here).")
+
+
+class _Kernel:
+    def __init__(self, jitted, name):
+        self._fn = jitted
+        self.name = name
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        """CudaModule-shaped launch: ctx/grid/block/shared_mem are accepted
+        and IGNORED (XLA owns device placement and scheduling); returns the
+        kernel outputs as NDArrays."""
+        from .ndarray import NDArray
+
+        arrays = [a._data if isinstance(a, NDArray) else a for a in args]
+        out = self._fn(*arrays)
+        if isinstance(out, (list, tuple)):
+            return [NDArray(o) for o in out]
+        return NDArray(out)
+
+
+class XlaModule:
+    """Runtime-compiled kernel collection: pass python functions over jax
+    arrays; each gets a jitted, launchable handle (the CudaModule
+    get_kernel shape without signature strings — types come from tracing).
+    Kernels jit ONCE at module construction; repeated get_kernel of the
+    same name returns the same compiled handle."""
+
+    def __init__(self, **kernels):
+        self._kernels = {name: _Kernel(jax.jit(fn), name)
+                         for name, fn in kernels.items()}
+
+    def get_kernel(self, name, signature=None):
+        if name not in self._kernels:
+            raise MXNetError(f"kernel {name!r} not in module; have "
+                             f"{sorted(self._kernels)}")
+        return self._kernels[name]

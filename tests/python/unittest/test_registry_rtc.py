@@ -1,0 +1,55 @@
+"""mx.registry factory machinery + mx.rtc runtime-kernel surface
+(reference `python/mxnet/registry.py`, `python/mxnet/rtc.py`)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+class _Base:
+    pass
+
+
+def test_registry_register_create_alias():
+    reg = mx.registry.get_register_func(_Base, "widget")
+    create = mx.registry.get_create_func(_Base, "widget")
+    alias = mx.registry.get_alias_func(_Base, "widget")
+
+    @alias("w2", "W3")
+    class Widget(_Base):
+        def __init__(self, v=1):
+            self.v = v
+
+    reg(Widget)
+    assert isinstance(create("widget"), Widget)
+    assert create("W2", v=5).v == 5             # case-insensitive alias
+    assert create('["widget", {"v": 9}]').v == 9  # json spec form
+    w = Widget(7)
+    assert create(w) is w                        # instance passthrough
+    with pytest.raises(mx.base.MXNetError):
+        create("nope")
+
+
+def test_registry_rejects_non_subclass():
+    reg = mx.registry.get_register_func(_Base, "widget")
+    with pytest.raises(AssertionError):
+        reg(int)
+
+
+def test_rtc_xla_module():
+    mod = mx.rtc.XlaModule(saxpy=lambda a, x, y: a * x + y,
+                           square=lambda x: x * x)
+    k = mod.get_kernel("saxpy")
+    out = k.launch([mx.nd.array(np.array(2.0, np.float32)),
+                    mx.nd.ones((4,)), mx.nd.ones((4,))],
+                   grid_dims=(1, 1, 1), block_dims=(4, 1, 1))
+    assert np.allclose(out.asnumpy(), 3.0)
+    assert np.allclose(mod.get_kernel("square").launch(
+        [mx.nd.array(np.array([3.0], np.float32))]).asnumpy(), 9.0)
+    with pytest.raises(mx.base.MXNetError):
+        mod.get_kernel("missing")
+
+
+def test_rtc_cuda_module_raises():
+    with pytest.raises(mx.base.MXNetError, match="TPU"):
+        mx.rtc.CudaModule("__global__ void k(float* x) {}")
