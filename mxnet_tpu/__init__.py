@@ -68,6 +68,10 @@ from . import contrib
 from . import attribute
 from . import registry
 from . import rtc
+from . import log
+from . import kvstore_server
+from . import operator
+operator._install_nd_custom()
 from .attribute import AttrScope
 from . import name
 from .name import NameManager

@@ -39,12 +39,16 @@ def register(klass):
 # frontend alias names (reference uses @mx.init.register alias decorators:
 # `initializer.py` registers Zero as 'zeros', One as 'ones')
 def _register_aliases():
+    from .registry import get_alias_func
+
     for alias_, target in (("zeros", "zero"), ("ones", "one")):
         if target in _INIT_REGISTRY:
             _INIT_REGISTRY[alias_] = _INIT_REGISTRY[target]
+            get_alias_func(Initializer, "initializer")(alias_)(
+                _INIT_REGISTRY[target])
 
 
-def get(name, **kwargs):
+def get(name, *args, **kwargs):
     if isinstance(name, Initializer):
         return name
     if name is None:
@@ -52,18 +56,18 @@ def get(name, **kwargs):
     key = name.lower()
     if key not in _INIT_REGISTRY:
         raise MXNetError(f"Unknown initializer {name}")
-    return _INIT_REGISTRY[key](**kwargs)
-
-
-# `create` is the frontend spelling (accepts instance | name | None);
-# `register_named` lets dynamically-built initializers (gluon Constant
-# parameters) register under an explicit key.
-create = get
+    return _INIT_REGISTRY[key](*args, **kwargs)
 
 
 def register_named(name):
+    """Register dynamically-built initializers (gluon Constant parameters)
+    under an explicit key — mirrored into the generic registry so the JSON
+    spec form resolves them too."""
     def deco(klass):
         _INIT_REGISTRY[name.lower()] = klass
+        from .registry import get_alias_func
+
+        get_alias_func(Initializer, "initializer")(name)(klass)
         return klass
 
     return deco
@@ -321,12 +325,12 @@ _register_aliases()
 
 
 # factory face: preserves get()'s contract (instance | name | None →
-# Uniform default, including the 'zeros'/'ones' aliases) and adds the
-# generic registry.py JSON '[name, kwargs]' spec form
+# Uniform default, 'zeros'/'ones' aliases, positional ctor args) and adds
+# the generic registry.py JSON '[name, kwargs]' spec form
 def create(*args, **kwargs):
     if args and (args[0] is None or isinstance(args[0], Initializer) or
                  (isinstance(args[0], str) and not args[0].startswith("["))):
-        return get(args[0], **kwargs)
+        return get(args[0], *args[1:], **kwargs)
     from .registry import get_create_func
 
     return get_create_func(Initializer, "initializer")(*args, **kwargs)

@@ -1,0 +1,48 @@
+"""KVStore server bootstrap (parity: `python/mxnet/kvstore_server.py` —
+the reference starts a `KVStoreServer` applying pickled optimizers when
+launched with DMLC_ROLE=server).
+
+DOCUMENTED DIVERGENCE: the TPU build has no parameter servers — gradient
+synchronization is synchronous XLA AllReduce over ICI/DCN inside the SPMD
+program (`mxnet_tpu/parallel/dist.py`), the role the reference's server
+processes played (`kvstore_dist_server.h:155`, SURVEY.md §5). This module
+keeps the import surface and explains the mapping; launching with a
+server/scheduler role is an explicit error pointing at tools/launch.py.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """API-parity shim of the reference server controller. `run()` refuses
+    with the TPU mapping instead of blocking in a ZMQ loop."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+
+    def run(self):
+        raise MXNetError(
+            "Parameter-server processes do not exist on TPU: every worker "
+            "participates in synchronous AllReduce collectives instead "
+            "(kvstore 'dist_tpu_sync'; launch workers with tools/launch.py)."
+        )
+
+
+def _init_kvstore_server_module():
+    """Reference `kvstore_server.py:_init_kvstore_server_module`: when the
+    process is launched in a server/scheduler role, take over as a server.
+    Here those roles are an error (no servers to become)."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role in ("server", "scheduler"):
+        raise MXNetError(
+            f"DMLC_ROLE={role!r}: the TPU build has no {role} role — "
+            "dist_tpu_sync replaces ps-lite with XLA collectives; launch "
+            "N workers via tools/launch.py (jax.distributed rendezvous).")
+
+
+_init_kvstore_server_module()

@@ -1,0 +1,161 @@
+"""User-defined operators in Python (parity: `python/mxnet/operator.py` —
+CustomOp / CustomOpProp / register, the frontend of the reference's
+`src/operator/custom/custom.cc` bridge).
+
+The reference runs custom-op callbacks on a dedicated thread pool outside
+the engine (`custom.cc:70-119`). Here custom ops are HOST ops by
+construction: `mx.nd.Custom(...)` executes the python `forward` eagerly on
+concrete NDArrays, and when autograd is recording, a host pullback
+(`autograd._PyPullback`) calls the python `backward` — the same
+eager-only contract as dynamic-shape ops (they cannot be captured into a
+jitted graph; documented divergence for the Symbol path, which the
+reference supports via engine callbacks)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference operator.py:160)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write `src` into `dst` honoring the grad req (reference
+        operator.py assign)."""
+        if req in ("null", None):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Declares a custom op's interface (reference operator.py:466)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: outputs shaped like input 0, NO aux states — a prop
+        declaring aux states must override (reference operator.py:513)."""
+        if self.list_auxiliary_states():
+            raise MXNetError(
+                "CustomOpProp with auxiliary states must override "
+                "infer_shape to return their shapes")
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under `reg_name` (reference
+    operator.py:744); invoke with mx.nd.Custom(..., op_type=reg_name)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop(op_type):
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError(
+            f"custom op {op_type!r} is not registered; registered: "
+            f"{sorted(_CUSTOM_REGISTRY)}")
+    return _CUSTOM_REGISTRY[op_type]
+
+
+def _invoke_custom(*args, op_type=None, **kwargs):
+    """mx.nd.Custom: eager forward + taped python backward."""
+    from . import autograd
+    from .ndarray import NDArray
+    from .ndarray.ndarray import empty
+
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    str_kwargs = {k: str(v) for k, v in kwargs.items()}
+    prop = get_prop(op_type)(**str_kwargs)
+
+    in_data = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
+    in_shapes = [list(a.shape) for a in in_data]
+    _, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [a.dtype for a in in_data]
+    _, out_types, _ = prop.infer_type(in_types)
+
+    op = prop.create_operator(None, in_shapes, in_types)
+    out_data = [empty(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    aux = [empty(tuple(s)) for s in (aux_shapes or [])]
+
+    is_train = bool(autograd.is_training())
+    op.forward(is_train, ["write"] * len(out_data), in_data, out_data, aux)
+
+    if autograd.is_recording():
+        import jax
+
+        def pullback(cts):
+            cts_t = cts if isinstance(cts, tuple) else (cts,)
+            out_grad = [NDArray(c) for c in cts_t]
+            in_grad = [empty(a.shape, dtype=a.dtype) for a in in_data]
+            # pause: the NDArray ops inside user backward/assign must not
+            # append to the tape mid-backward (same guard as
+            # autograd.Function's pullback)
+            with autograd.pause():
+                op.backward(["write"] * len(in_grad), out_grad, in_data,
+                            out_data, in_grad, aux)
+            return tuple(g._data for g in in_grad)
+
+        autograd._record_node(
+            autograd._PyPullback(pullback), in_data, out_data,
+            [jax.ShapeDtypeStruct(o.shape, _np.dtype(o.dtype))
+             for o in out_data])
+
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+def _install_nd_custom():
+    """Expose mx.nd.Custom / mx.symbol-level registration marker."""
+    from . import ndarray as nd
+
+    nd.Custom = _invoke_custom
+    if hasattr(nd, "op"):
+        nd.op.Custom = _invoke_custom

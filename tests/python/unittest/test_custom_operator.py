@@ -1,0 +1,88 @@
+"""mx.operator CustomOp API (reference `python/mxnet/operator.py` +
+`tests/python/unittest/test_operator.py` test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.operator as mxop
+from mxnet_tpu import autograd, nd
+
+
+@mxop.register("mysigmoid")
+class SigmoidProp(mxop.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Sigmoid(self.scale)
+
+
+class Sigmoid(mxop.CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = self.scale / (1.0 + np.exp(-x))
+        self.assign(out_data[0], req[0], nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy() / self.scale
+        g = out_grad[0].asnumpy() * self.scale * y * (1 - y)
+        self.assign(in_grad[0], req[0], nd.array(g))
+
+
+def test_custom_forward():
+    x = nd.array(np.array([[0.0, 1.0], [-1.0, 2.0]], np.float32))
+    y = nd.Custom(x, op_type="mysigmoid")
+    ref = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-6)
+
+
+def test_custom_kwargs_reach_prop():
+    x = nd.array(np.zeros((2, 2), np.float32))
+    y = nd.Custom(x, op_type="mysigmoid", scale=3.0)
+    np.testing.assert_allclose(y.asnumpy(), 1.5, rtol=1e-6)  # 3*sigmoid(0)
+
+
+def test_custom_backward_matches_fd():
+    rng = np.random.RandomState(0)
+    xv = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="mysigmoid")
+        loss = (y * y).sum()
+    loss.backward()
+    got = x.grad.asnumpy()
+
+    eps = 1e-3
+    fd = np.zeros_like(xv)
+    for i in np.ndindex(*xv.shape):
+        vp, vm = xv.copy(), xv.copy()
+        vp[i] += eps
+        vm[i] -= eps
+        sp = 1 / (1 + np.exp(-vp))
+        sm = 1 / (1 + np.exp(-vm))
+        fd[i] = ((sp ** 2).sum() - (sm ** 2).sum()) / (2 * eps)
+    np.testing.assert_allclose(got, fd, rtol=1e-2, atol=1e-3)
+
+
+def test_custom_unregistered_errors():
+    with pytest.raises(mx.base.MXNetError, match="not registered"):
+        nd.Custom(nd.ones((2,)), op_type="nope")
+
+
+def test_register_rejects_non_prop():
+    with pytest.raises(mx.base.MXNetError):
+        mxop.register("bad")(int)
