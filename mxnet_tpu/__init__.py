@@ -71,7 +71,12 @@ from . import rtc
 from . import log
 from . import kvstore_server
 from . import operator
-operator._install_nd_custom()
+operator._register_custom_op()
+# expose the generated nd.Custom / sym.Custom (the Custom op registers
+# after the namespaces were first populated)
+ndarray.register.populate_op_namespaces("mxnet_tpu.ndarray")
+ndarray.register.populate_op_namespaces("mxnet_tpu.symbol",
+                                        make_func=symbol._make_sym_func)
 from .attribute import AttrScope
 from . import name
 from .name import NameManager

@@ -90,6 +90,14 @@ def register(reg_name):
     def deco(prop_cls):
         if not issubclass(prop_cls, CustomOpProp):
             raise MXNetError("register expects a CustomOpProp subclass")
+        if reg_name in _CUSTOM_REGISTRY:
+            # re-registration (notebooks, test reruns): compiled Custom
+            # programs captured the OLD prop's callbacks — drop the op
+            # compile caches so the next invocation re-traces
+            from .ops import registry as _reg
+
+            _reg._jitted.cache_clear()
+            _reg._vjp_fwd_jitted.cache_clear()
         _CUSTOM_REGISTRY[reg_name] = prop_cls
         return prop_cls
 
@@ -104,58 +112,111 @@ def get_prop(op_type):
     return _CUSTOM_REGISTRY[op_type]
 
 
-def _invoke_custom(*args, op_type=None, **kwargs):
-    """mx.nd.Custom: eager forward + taped python backward."""
-    from . import autograd
-    from .ndarray import NDArray
-    from .ndarray.ndarray import empty
+def _user_kwargs(attrs):
+    """User kwargs for the prop constructor: strip framework attrs and
+    node metadata (attr= entries like __lr_mult__, ctx_group) — the same
+    filter the executor applies to every other op."""
+    return {k: str(v) for k, v in attrs.items()
+            if k not in ("op_type", "_train", "ctx_group", "name")
+            and not k.startswith("__")}
 
-    if op_type is None:
-        raise MXNetError("Custom requires op_type=")
-    str_kwargs = {k: str(v) for k, v in kwargs.items()}
-    prop = get_prop(op_type)(**str_kwargs)
 
-    in_data = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
-    in_shapes = [list(a.shape) for a in in_data]
-    _, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
-    in_types = [a.dtype for a in in_data]
-    _, out_types, _ = prop.infer_type(in_types)
+def _n_custom_outputs(attrs):
+    prop_cls = get_prop(attrs.get("op_type"))
+    return len(prop_cls(**_user_kwargs(attrs)).list_outputs())
 
-    op = prop.create_operator(None, in_shapes, in_types)
-    out_data = [empty(tuple(s), dtype=t)
-                for s, t in zip(out_shapes, out_types)]
-    aux = [empty(tuple(s)) for s in (aux_shapes or [])]
 
-    is_train = bool(autograd.is_training())
-    op.forward(is_train, ["write"] * len(out_data), in_data, out_data, aux)
+def _register_custom_op():
+    """Register the `Custom` operator (reference op `Custom`,
+    `src/operator/custom/custom.cc`): the user's python forward/backward
+    run as HOST CALLBACKS via `jax.pure_callback`, so custom ops work both
+    eagerly AND captured inside compiled graphs (hybridize / Symbol
+    executor) — the host-callback mechanism SURVEY §7 calls for. Gradients
+    flow through a custom_vjp whose backward is a second callback into the
+    user's `backward`."""
+    import jax
+    import jax.numpy as jnp
 
-    if autograd.is_recording():
-        import jax
+    from .ops.registry import register
 
-        def pullback(cts):
-            cts_t = cts if isinstance(cts, tuple) else (cts,)
-            out_grad = [NDArray(c) for c in cts_t]
-            in_grad = [empty(a.shape, dtype=a.dtype) for a in in_data]
-            # pause: the NDArray ops inside user backward/assign must not
-            # append to the tape mid-backward (same guard as
-            # autograd.Function's pullback)
+    @register("Custom", open_attrs=True, needs_mode=True,
+              num_outputs=_n_custom_outputs)
+    def _custom(*data, op_type=None, _train=False, **kw):
+        if op_type is None:
+            raise MXNetError("Custom requires op_type=")
+        prop = get_prop(op_type)(**_user_kwargs(kw))
+        if prop.list_auxiliary_states():
+            raise MXNetError(
+                "Custom ops with auxiliary states are not supported on the "
+                "host-callback path (documented divergence)")
+
+        in_shapes = [list(d.shape) for d in data]
+        _, out_shapes, _ = prop.infer_shape(in_shapes)
+        in_types = [d.dtype for d in data]
+        _, out_types, _ = prop.infer_type(in_types)
+        out_sds = tuple(jax.ShapeDtypeStruct(tuple(s), _np.dtype(t))
+                        for s, t in zip(out_shapes, out_types))
+        in_sds = tuple(jax.ShapeDtypeStruct(tuple(s), _np.dtype(t))
+                       for s, t in zip(in_shapes, in_types))
+        n_in, n_out = len(data), len(out_sds)
+        is_train = bool(_train)
+
+        # ONE operator instance shared by forward and backward callbacks
+        # (reference: one op per executor) so state saved in forward
+        # (self.xxx, e.g. cached masks) is visible to backward; created
+        # lazily on the host at first callback
+        _op_holder = {}
+
+        def _mk_op():
+            if "op" not in _op_holder:
+                _op_holder["op"] = prop.create_operator(None, in_shapes,
+                                                        in_types)
+            return _op_holder["op"]
+
+        def host_forward(*arrays):
+            from . import autograd
+            from .ndarray import NDArray
+            from .ndarray.ndarray import empty
+
             with autograd.pause():
-                op.backward(["write"] * len(in_grad), out_grad, in_data,
-                            out_data, in_grad, aux)
-            return tuple(g._data for g in in_grad)
+                in_nd = [NDArray(jnp.asarray(a)) for a in arrays]
+                out_nd = [empty(s.shape, dtype=s.dtype) for s in out_sds]
+                _mk_op().forward(is_train, ["write"] * n_out, in_nd,
+                                 out_nd, [])
+                return tuple(_np.asarray(o.asnumpy(), s.dtype)
+                             for o, s in zip(out_nd, out_sds))
 
-        autograd._record_node(
-            autograd._PyPullback(pullback), in_data, out_data,
-            [jax.ShapeDtypeStruct(o.shape, _np.dtype(o.dtype))
-             for o in out_data])
+        def host_backward(*arrays):
+            from . import autograd
+            from .ndarray import NDArray
+            from .ndarray.ndarray import empty
 
-    return out_data[0] if len(out_data) == 1 else out_data
+            with autograd.pause():
+                in_nd = [NDArray(jnp.asarray(a)) for a in arrays[:n_in]]
+                out_nd = [NDArray(jnp.asarray(a))
+                          for a in arrays[n_in:n_in + n_out]]
+                og_nd = [NDArray(jnp.asarray(a))
+                         for a in arrays[n_in + n_out:]]
+                ig_nd = [empty(s.shape, dtype=s.dtype) for s in in_sds]
+                _mk_op().backward(["write"] * n_in, og_nd, in_nd, out_nd,
+                                  ig_nd, [])
+                return tuple(_np.asarray(g.asnumpy(), s.dtype)
+                             for g, s in zip(ig_nd, in_sds))
 
+        @jax.custom_vjp
+        def core(*arrays):
+            return jax.pure_callback(host_forward, out_sds, *arrays)
 
-def _install_nd_custom():
-    """Expose mx.nd.Custom / mx.symbol-level registration marker."""
-    from . import ndarray as nd
+        def core_fwd(*arrays):
+            outs = core(*arrays)
+            return outs, (arrays, outs)
 
-    nd.Custom = _invoke_custom
-    if hasattr(nd, "op"):
-        nd.op.Custom = _invoke_custom
+        def core_bwd(res, cts):
+            arrays, outs = res
+            cts_t = cts if isinstance(cts, tuple) else (cts,)
+            return jax.pure_callback(host_backward, in_sds,
+                                     *arrays, *outs, *cts_t)
+
+        core.defvjp(core_fwd, core_bwd)
+        outs = core(*data)
+        return outs[0] if n_out == 1 else outs

@@ -28,10 +28,12 @@ class Op:
     """A registered operator."""
 
     __slots__ = ("name", "fn", "num_outputs", "mutate_aux", "wrap_kwargs", "doc", "needs_rng",
-                 "needs_mode", "tensor_opts", "sparse_vjp", "eager_only", "_schema_cache")
+                 "needs_mode", "tensor_opts", "sparse_vjp", "eager_only", "open_attrs",
+                 "_schema_cache")
 
     def __init__(self, name, fn, num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
-                 needs_mode=False, tensor_opts=(), sparse_vjp=None, eager_only=False):
+                 needs_mode=False, tensor_opts=(), sparse_vjp=None, eager_only=False,
+                 open_attrs=False):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
@@ -67,6 +69,9 @@ class Op:
         # traced graphs (documented divergence from the reference's
         # dynamic-shape support on CPU)
         self.eager_only = eager_only
+        # ops forwarding arbitrary user kwargs (Custom → CustomOpProp
+        # constructors) opt out of strict-kwargs validation
+        self.open_attrs = open_attrs
         self._schema_cache = None
         self.doc = fn.__doc__
 
@@ -80,7 +85,8 @@ class Op:
 
 
 def register(name, aliases=(), num_outputs=1, mutate_aux=None, wrap_kwargs=None, needs_rng=False,
-             needs_mode=False, tensor_opts=(), sparse_vjp=None, eager_only=False):
+             needs_mode=False, tensor_opts=(), sparse_vjp=None, eager_only=False,
+             open_attrs=False):
     """Decorator: register a jax fn as operator ``name`` (+ aliases).
 
     ``eager_only`` (dynamic-shape ops, e.g. boolean_mask): the op bypasses
@@ -92,7 +98,7 @@ def register(name, aliases=(), num_outputs=1, mutate_aux=None, wrap_kwargs=None,
     def deco(fn):
         op = Op(name, fn, num_outputs=num_outputs, mutate_aux=mutate_aux, wrap_kwargs=wrap_kwargs,
                 needs_rng=needs_rng, needs_mode=needs_mode, tensor_opts=tensor_opts,
-                sparse_vjp=sparse_vjp, eager_only=eager_only)
+                sparse_vjp=sparse_vjp, eager_only=eager_only, open_attrs=open_attrs)
         _OPS[name] = op
         for a in aliases:
             _OPS[a] = op
@@ -161,6 +167,8 @@ def validate_attrs(op, attrs):
     """Reject unknown keyword arguments — the reference's dmlc::Parameter
     Init() throws on unknown/malformed kwargs; silently-ignored typos must
     not train wrong. Called by BOTH frontends (nd + symbol)."""
+    if op.open_attrs:
+        return  # op forwards arbitrary kwargs (Custom → user prop ctor)
     schema = attr_schema(op)
     if schema is None:
         return

@@ -86,3 +86,121 @@ def test_custom_unregistered_errors():
 def test_register_rejects_non_prop():
     with pytest.raises(mx.base.MXNetError):
         mxop.register("bad")(int)
+
+
+def test_custom_inside_hybridized_block():
+    """The host-callback path lets Custom ops live INSIDE compiled graphs
+    (jax.pure_callback; reference custom.cc runs callbacks outside the
+    engine) — forward AND backward through a hybridized block."""
+    from mxnet_tpu.gluon import HybridBlock, nn
+
+    class WithCustom(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = nn.Dense(4, use_bias=False)
+
+        def hybrid_forward(self, F, x):
+            return F.Custom(self.fc(x), op_type="mysigmoid")
+
+    net = WithCustom()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(np.random.RandomState(1).randn(2, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x)
+        loss = y.sum()
+    loss.backward()
+    assert y.shape == (2, 4)
+    assert (y.asnumpy() > 0).all() and (y.asnumpy() < 1).all()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_custom_in_symbol_executor():
+    """sym.Custom binds and executes through the whole-graph executor."""
+    from mxnet_tpu import symbol as sym
+
+    data = sym.Variable("data")
+    net = sym.Custom(data, op_type="mysigmoid", name="cust0")
+    ex = net.simple_bind(grad_req="write", data=(2, 3))
+    xv = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+    out = ex.forward(is_train=True, data=nd.array(xv))[0].asnumpy()
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-xv)), rtol=1e-5)
+    ex.backward(out_grads=nd.ones((2, 3)))
+    g = ex.grad_dict["data"].asnumpy()
+    s = 1 / (1 + np.exp(-xv))
+    np.testing.assert_allclose(g, s * (1 - s), rtol=1e-4, atol=1e-5)
+
+
+def test_custom_aux_states_rejected():
+    @mxop.register("withaux")
+    class AuxProp(mxop.CustomOpProp):
+        def list_auxiliary_states(self):
+            return ["state"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], [in_shape[0]]
+
+    with pytest.raises(mx.base.MXNetError, match="auxiliary"):
+        nd.Custom(nd.ones((2,)), op_type="withaux")
+
+
+def test_custom_stateful_forward_to_backward():
+    """State saved in forward (self.xxx) must be visible to backward —
+    one operator instance per invocation (reference: one per executor)."""
+    @mxop.register("stateful3x")
+    class StatefulProp(mxop.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return Stateful()
+
+    class Stateful(mxop.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.saved = in_data[0].asnumpy() * 3.0
+            self.assign(out_data[0], req[0], nd.array(self.saved))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            # uses forward-saved state: grad = og * sign(saved)
+            g = out_grad[0].asnumpy() * np.sign(self.saved)
+            self.assign(in_grad[0], req[0], nd.array(g))
+
+    x = nd.array(np.array([[1.0, -2.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="stateful3x")
+        y.sum().backward()
+    np.testing.assert_allclose(y.asnumpy(), [[3.0, -6.0]])
+    np.testing.assert_allclose(x.grad.asnumpy(), [[1.0, -1.0]])
+
+
+def test_custom_reregistration_takes_effect():
+    @mxop.register("reuse_op")
+    class A(mxop.CustomOpProp):
+        def create_operator(self, ctx, s, t):
+            op = mxop.CustomOp()
+            op.forward = lambda is_train, req, i, o, aux: \
+                op.assign(o[0], req[0], nd.array(i[0].asnumpy() * 2))
+            return op
+
+    assert float(nd.Custom(nd.ones((1,)), op_type="reuse_op").asnumpy()) == 2
+
+    @mxop.register("reuse_op")
+    class B(mxop.CustomOpProp):
+        def create_operator(self, ctx, s, t):
+            op = mxop.CustomOp()
+            op.forward = lambda is_train, req, i, o, aux: \
+                op.assign(o[0], req[0], nd.array(i[0].asnumpy() * 10))
+            return op
+
+    assert float(nd.Custom(nd.ones((1,)), op_type="reuse_op").asnumpy()) == 10
+
+
+def test_custom_node_metadata_attrs_filtered():
+    from mxnet_tpu import symbol as sym
+
+    data = sym.Variable("data")
+    net = sym.Custom(data, op_type="mysigmoid", name="c0",
+                     attr={"__lr_mult__": "2.0"})
+    ex = net.simple_bind(grad_req="null", data=(2, 2))
+    out = ex.forward(is_train=False, data=nd.zeros((2, 2)))[0].asnumpy()
+    np.testing.assert_allclose(out, 0.5)

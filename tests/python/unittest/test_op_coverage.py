@@ -625,6 +625,8 @@ COVERED_ELSEWHERE = {
     # control flow — test_control_flow.py
     "_foreach": "test_control_flow.py", "_while_loop": "test_control_flow.py",
     "_cond": "test_control_flow.py",
+    # python custom operators — test_custom_operator.py
+    "Custom": "test_custom_operator.py",
     # CTC — test_ctc.py
     "CTCLoss": "test_ctc.py", "_contrib_CTCLoss": "test_ctc.py",
     "_contrib_ctc_loss": "test_ctc.py", "ctc_loss": "test_ctc.py",
