@@ -64,19 +64,15 @@ from . import monitor
 from . import profiler
 from . import util
 from . import visualization
+from . import visualization as viz
+from . import image as img
 from . import contrib
 from . import attribute
 from . import registry
 from . import rtc
 from . import log
 from . import kvstore_server
-from . import operator
-operator._register_custom_op()
-# expose the generated nd.Custom / sym.Custom (the Custom op registers
-# after the namespaces were first populated)
-ndarray.register.populate_op_namespaces("mxnet_tpu.ndarray")
-ndarray.register.populate_op_namespaces("mxnet_tpu.symbol",
-                                        make_func=symbol._make_sym_func)
+from . import operator  # Custom op itself registers in ops/__init__
 from .attribute import AttrScope
 from . import name
 from .name import NameManager

@@ -90,14 +90,10 @@ def register(reg_name):
     def deco(prop_cls):
         if not issubclass(prop_cls, CustomOpProp):
             raise MXNetError("register expects a CustomOpProp subclass")
-        if reg_name in _CUSTOM_REGISTRY:
-            # re-registration (notebooks, test reruns): compiled Custom
-            # programs captured the OLD prop's callbacks — drop the op
-            # compile caches so the next invocation re-traces
-            from .ops import registry as _reg
-
-            _reg._jitted.cache_clear()
-            _reg._vjp_fwd_jitted.cache_clear()
+        # re-registration needs no cache invalidation: the host callbacks
+        # resolve the prop from this registry AT CALL TIME, so even
+        # already-compiled programs (CachedOps, bound executors) pick up
+        # the new implementation on their next execution
         _CUSTOM_REGISTRY[reg_name] = prop_cls
         return prop_cls
 
@@ -113,12 +109,20 @@ def get_prop(op_type):
 
 
 def _user_kwargs(attrs):
-    """User kwargs for the prop constructor: strip framework attrs and
-    node metadata (attr= entries like __lr_mult__, ctx_group) — the same
-    filter the executor applies to every other op."""
-    return {k: str(v) for k, v in attrs.items()
-            if k not in ("op_type", "_train", "ctx_group", "name")
-            and not k.startswith("__")}
+    """User kwargs for the prop constructor (all strings, like the
+    reference's C-string kwargs): strip the canonical framework attrs plus
+    Custom's own keys and node metadata (__lr_mult__ etc.). Sequences
+    render as list-repr ('[3, 3]') — the jit-cache freeze turns lists into
+    tuples, and props commonly json-parse their kwargs."""
+    from .ops.registry import _FRAMEWORK_ATTRS
+
+    skip = _FRAMEWORK_ATTRS | {"op_type", "ctx_group"}
+
+    def s(v):
+        return str(list(v)) if isinstance(v, tuple) else str(v)
+
+    return {k: s(v) for k, v in attrs.items()
+            if k not in skip and not k.startswith("__")}
 
 
 def _n_custom_outputs(attrs):
@@ -161,17 +165,22 @@ def _register_custom_op():
         n_in, n_out = len(data), len(out_sds)
         is_train = bool(_train)
 
-        # ONE operator instance shared by forward and backward callbacks
-        # (reference: one op per executor) so state saved in forward
-        # (self.xxx, e.g. cached masks) is visible to backward; created
-        # lazily on the host at first callback
-        _op_holder = {}
+        # Host callbacks resolve the prop from the registry AT CALL TIME
+        # (like the reference's custom.cc dispatch), so re-registration
+        # reaches even already-compiled programs. Stateful forward→backward
+        # pairing: each TRAIN forward pushes a fresh operator instance onto
+        # a per-trace stack and backward pops it — the autograd tape runs
+        # pullbacks in reverse order, so LIFO pairs each backward with its
+        # own forward even when same-shape invocations interleave. The
+        # stack is bounded (train forwards without a backward would
+        # otherwise leak instances).
+        user_kw = _user_kwargs(kw)
+        _op_stack = []
+        _MAX_PENDING = 64
 
-        def _mk_op():
-            if "op" not in _op_holder:
-                _op_holder["op"] = prop.create_operator(None, in_shapes,
-                                                        in_types)
-            return _op_holder["op"]
+        def _new_op():
+            return get_prop(op_type)(**user_kw).create_operator(
+                None, in_shapes, in_types)
 
         def host_forward(*arrays):
             from . import autograd
@@ -179,10 +188,14 @@ def _register_custom_op():
             from .ndarray.ndarray import empty
 
             with autograd.pause():
+                cop = _new_op()
+                if is_train:
+                    _op_stack.append(cop)
+                    if len(_op_stack) > _MAX_PENDING:
+                        _op_stack.pop(0)
                 in_nd = [NDArray(jnp.asarray(a)) for a in arrays]
                 out_nd = [empty(s.shape, dtype=s.dtype) for s in out_sds]
-                _mk_op().forward(is_train, ["write"] * n_out, in_nd,
-                                 out_nd, [])
+                cop.forward(is_train, ["write"] * n_out, in_nd, out_nd, [])
                 return tuple(_np.asarray(o.asnumpy(), s.dtype)
                              for o, s in zip(out_nd, out_sds))
 
@@ -192,14 +205,15 @@ def _register_custom_op():
             from .ndarray.ndarray import empty
 
             with autograd.pause():
+                cop = _op_stack.pop() if _op_stack else _new_op()
                 in_nd = [NDArray(jnp.asarray(a)) for a in arrays[:n_in]]
                 out_nd = [NDArray(jnp.asarray(a))
                           for a in arrays[n_in:n_in + n_out]]
                 og_nd = [NDArray(jnp.asarray(a))
                          for a in arrays[n_in + n_out:]]
                 ig_nd = [empty(s.shape, dtype=s.dtype) for s in in_sds]
-                _mk_op().backward(["write"] * n_in, og_nd, in_nd, out_nd,
-                                  ig_nd, [])
+                cop.backward(["write"] * n_in, og_nd, in_nd, out_nd,
+                             ig_nd, [])
                 return tuple(_np.asarray(g.asnumpy(), s.dtype)
                              for g, s in zip(ig_nd, in_sds))
 

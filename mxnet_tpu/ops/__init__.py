@@ -24,3 +24,9 @@ from . import image_ops     # noqa: F401
 # register the pre-NNVM kernels under *_v1; numerically identical here)
 registry.alias("Convolution_v1", "Convolution")
 registry.alias("Pooling_v1", "Pooling")
+
+# the python Custom operator registers here, BEFORE the nd/symbol
+# namespaces are populated, so no second registry sweep is needed
+from ..operator import _register_custom_op as _rco  # noqa: E402
+
+_rco()

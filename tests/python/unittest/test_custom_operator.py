@@ -204,3 +204,72 @@ def test_custom_node_metadata_attrs_filtered():
     ex = net.simple_bind(grad_req="null", data=(2, 2))
     out = ex.forward(is_train=False, data=nd.zeros((2, 2)))[0].asnumpy()
     np.testing.assert_allclose(out, 0.5)
+
+
+def test_custom_stateful_interleaved_same_shape():
+    """Two same-shape invocations interleaved under one record(): each
+    backward must see ITS OWN forward's saved state (LIFO instance pool;
+    the tape replays pullbacks in reverse order)."""
+    x1 = nd.array(np.array([[1.0]], np.float32))
+    x2 = nd.array(np.array([[-1.0]], np.float32))
+    x1.attach_grad()
+    x2.attach_grad()
+    with autograd.record():
+        y1 = nd.Custom(x1, op_type="stateful3x")
+        y2 = nd.Custom(x2, op_type="stateful3x")
+        (y1 + y2).sum().backward()
+    # grad = sign(saved) where saved = 3*x of the SAME invocation
+    np.testing.assert_allclose(x1.grad.asnumpy(), [[1.0]])
+    np.testing.assert_allclose(x2.grad.asnumpy(), [[-1.0]])
+
+
+def test_custom_reregistration_reaches_compiled_graphs():
+    """Callback-time registry dispatch: a bound symbol executor compiled
+    against op A must execute B after re-registration."""
+    from mxnet_tpu import symbol as sym
+
+    @mxop.register("swap_op")
+    class A2(mxop.CustomOpProp):
+        def create_operator(self, ctx, s, t):
+            op = mxop.CustomOp()
+            op.forward = lambda is_train, req, i, o, aux: \
+                op.assign(o[0], req[0], nd.array(i[0].asnumpy() * 2))
+            return op
+
+    data = sym.Variable("data")
+    net = sym.Custom(data, op_type="swap_op", name="sw0")
+    ex = net.simple_bind(grad_req="null", data=(1, 1))
+    assert float(ex.forward(is_train=False,
+                            data=nd.ones((1, 1)))[0].asnumpy()) == 2
+
+    @mxop.register("swap_op")
+    class B2(mxop.CustomOpProp):
+        def create_operator(self, ctx, s, t):
+            op = mxop.CustomOp()
+            op.forward = lambda is_train, req, i, o, aux: \
+                op.assign(o[0], req[0], nd.array(i[0].asnumpy() * 10))
+            return op
+
+    assert float(ex.forward(is_train=False,
+                            data=nd.ones((1, 1)))[0].asnumpy()) == 10
+
+
+def test_custom_sequence_kwargs_list_repr():
+    """List kwargs survive the jit-cache freeze as list-repr strings."""
+    @mxop.register("kernel_echo")
+    class EchoProp(mxop.CustomOpProp):
+        def __init__(self, kernel="[1, 1]"):
+            super().__init__()
+            import json
+
+            self.kernel = json.loads(kernel)   # the common parsing pattern
+
+        def create_operator(self, ctx, s, t):
+            op = mxop.CustomOp()
+            op.forward = lambda is_train, req, i, o, aux: \
+                op.assign(o[0], req[0],
+                          nd.array(i[0].asnumpy() * float(sum(self.kernel))))
+            return op
+
+    out = nd.Custom(nd.ones((1,)), op_type="kernel_echo", kernel=[3, 4])
+    assert float(out.asnumpy()) == 7.0

@@ -1,0 +1,66 @@
+"""Long-context training demonstration (the first-class sequence
+parallelism the reference lacks — SURVEY.md §5 'Long-context': bucketing
+was its only tool). A 16k-token sequence trains through the SPMD
+TransformerLM with ring attention over the 'sp' axis: the K/V blocks ride
+lax.ppermute around the ring so no device ever materializes the full
+L x L score matrix."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import parallel as par
+from mxnet_tpu.models import TransformerLM, TransformerLMConfig
+
+
+@pytest.mark.slow
+def test_16k_context_train_step():
+    L = 16384
+    mesh = par.create_mesh(devices=jax.devices()[:8], dp=1, sp=8)
+    cfg = TransformerLMConfig(vocab_size=64, d_model=32, n_heads=2,
+                              d_ff=64, n_layers=1, max_len=L,
+                              dtype="float32")
+    lm = TransformerLM(cfg, mesh)
+    params = lm.init_params(jax.random.PRNGKey(0))
+    step, init_opt = lm.make_train_step(lr=1e-3)
+    opt = init_opt(params)
+    rng = np.random.RandomState(0)
+    toks = lm.shard_tokens(rng.randint(0, 64, (1, L)))
+    tgts = lm.shard_tokens(rng.randint(0, 64, (1, L)))
+    with mesh:
+        params, opt, loss = step(params, opt, toks, tgts, jnp.asarray(0))
+        jax.block_until_ready(loss)
+    l0 = float(np.asarray(loss))
+    assert np.isfinite(l0)
+    # a couple more steps must reduce loss on the fixed batch
+    with mesh:
+        for i in range(1, 4):
+            params, opt, loss = step(params, opt, toks, tgts,
+                                     jnp.asarray(i))
+    assert float(np.asarray(loss)) < l0
+
+
+def test_ring_vs_dense_at_moderate_length():
+    """Sanity at a length where the dense oracle is still cheap: the
+    sharded 2k-token forward equals the unsharded computation."""
+    L = 2048
+    mesh = par.create_mesh(devices=jax.devices()[:4], dp=1, sp=4)
+    cfg = TransformerLMConfig(vocab_size=32, d_model=16, n_heads=2,
+                              d_ff=32, n_layers=1, max_len=L,
+                              dtype="float32")
+    lm_sp = TransformerLM(cfg, mesh)
+    params = lm_sp.init_params(jax.random.PRNGKey(1))
+    rng = np.random.RandomState(1)
+    toks_np = rng.randint(0, 32, (1, L))
+    with mesh:
+        logits_sp = np.asarray(jax.jit(lm_sp.forward)(
+            params, lm_sp.shard_tokens(toks_np)))
+
+    mesh1 = par.create_mesh(devices=jax.devices()[:1], dp=1)
+    lm_1 = TransformerLM(cfg, mesh1)
+    params_host = {k: jnp.asarray(np.asarray(v)) for k, v in params.items()}
+    with mesh1:
+        logits_1 = np.asarray(jax.jit(lm_1.forward)(
+            params_host, jnp.asarray(toks_np, jnp.int32)))
+    np.testing.assert_allclose(logits_sp, logits_1, rtol=2e-4, atol=2e-4)
