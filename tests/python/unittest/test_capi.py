@@ -1,0 +1,128 @@
+"""The flat C ABI (`src/capi.cc`, reference `src/c_api/c_api.cc` +
+`include/mxnet/c_api.h` role) driven by a PURE-ctypes client.
+
+The client script below never imports `mxnet_tpu`: it binds
+`libcapi_tpu.so` with ctypes alone and exercises NDArray create/copy/
+shape/dtype, op invoke-by-name (`MXImperativeInvoke`, the
+`c_api_ndarray.cc:132` role), op listing, and Symbol JSON round-trip.
+It runs in a FRESH subprocess so the proof is uncontaminated by the
+test session's own imports.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+_SO = os.path.join(_REPO, "mxnet_tpu", "_native", "libcapi_tpu.so")
+
+CLIENT = r'''
+import ctypes, json, struct, sys
+
+so_path, = sys.argv[1:]
+lib = ctypes.CDLL(so_path)
+
+lib.MXGetLastError.restype = ctypes.c_char_p
+def check(rc):
+    if rc != 0:
+        raise RuntimeError(lib.MXGetLastError().decode())
+
+# version
+v = ctypes.c_int()
+check(lib.MXGetVersion(ctypes.byref(v)))
+assert v.value == 10500, v.value
+
+# op listing contains the core op families
+n = ctypes.c_int()
+names = ctypes.POINTER(ctypes.c_char_p)()
+check(lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(names)))
+all_names = {names[i].decode() for i in range(n.value)}
+assert n.value > 400, n.value
+for required in ("Convolution", "FullyConnected", "BatchNorm", "_plus_scalar"):
+    assert required in all_names, required
+
+# NDArray create (2x3 fp32) + copy in
+shape = (ctypes.c_int64 * 2)(2, 3)
+h = ctypes.c_void_p()
+check(lib.MXNDArrayCreate(shape, 2, 0, ctypes.byref(h)))
+data = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+buf = struct.pack("<6f", *data)
+check(lib.MXNDArraySyncCopyFromCPU(h, buf, len(buf)))
+
+# shape + dtype readback
+ndim = ctypes.c_int()
+shp = ctypes.POINTER(ctypes.c_int64)()
+check(lib.MXNDArrayGetShape(h, ctypes.byref(ndim), ctypes.byref(shp)))
+assert ndim.value == 2 and shp[0] == 2 and shp[1] == 3
+dt = ctypes.c_int()
+check(lib.MXNDArrayGetDType(h, ctypes.byref(dt)))
+assert dt.value == 0, dt.value
+
+# invoke-by-name with a string attr (the DMLC param-parsing role)
+nout = ctypes.c_int()
+outs = ctypes.POINTER(ctypes.c_void_p)()
+keys = (ctypes.c_char_p * 1)(b"scalar")
+vals = (ctypes.c_char_p * 1)(b"10.0")
+ins = (ctypes.c_void_p * 1)(h)
+check(lib.MXImperativeInvoke(b"_plus_scalar", 1, ins, ctypes.byref(nout),
+                             ctypes.byref(outs), 1, keys, vals))
+assert nout.value == 1
+out_h = ctypes.c_void_p(outs[0])
+got = ctypes.create_string_buffer(24)
+check(lib.MXNDArraySyncCopyToCPU(out_h, got, 24))
+vals_out = struct.unpack("<6f", got.raw)
+assert vals_out == tuple(x + 10.0 for x in data), vals_out
+
+# a second op: elementwise add of the array with itself
+check(lib.MXImperativeInvoke(b"elemwise_add", 2,
+                             (ctypes.c_void_p * 2)(h, h),
+                             ctypes.byref(nout), ctypes.byref(outs),
+                             0, None, None))
+sum_h = ctypes.c_void_p(outs[0])
+check(lib.MXNDArraySyncCopyToCPU(sum_h, got, 24))
+assert struct.unpack("<6f", got.raw) == tuple(2 * x for x in data)
+
+# error path: bogus op name reports through MXGetLastError
+rc = lib.MXImperativeInvoke(b"definitely_not_an_op", 1, ins,
+                            ctypes.byref(nout), ctypes.byref(outs),
+                            0, None, None)
+assert rc != 0
+assert "definitely_not_an_op" in lib.MXGetLastError().decode()
+
+# Symbol JSON round-trip
+graph = {
+    "nodes": [
+        {"op": "null", "name": "x", "inputs": []},
+        {"op": "Activation", "name": "act0",
+         "attrs": {"act_type": "relu"}, "inputs": [[0, 0]]},
+    ],
+    "heads": [[1, 0]],
+}
+sh = ctypes.c_void_p()
+check(lib.MXSymbolCreateFromJSON(json.dumps(graph).encode(), ctypes.byref(sh)))
+out_json = ctypes.c_char_p()
+check(lib.MXSymbolSaveToJSON(sh, ctypes.byref(out_json)))
+round_tripped = json.loads(out_json.value.decode())
+ops = [nd["op"] for nd in round_tripped["nodes"]]
+assert "Activation" in ops and "null" in ops, ops
+
+check(lib.MXSymbolFree(sh))
+check(lib.MXNDArrayFree(out_h))
+check(lib.MXNDArrayFree(sum_h))
+check(lib.MXNDArrayFree(h))
+print("CAPI_CLIENT_OK")
+'''
+
+
+@pytest.mark.skipif(not os.path.exists(_SO),
+                    reason="libcapi_tpu.so not built (make -C src)")
+def test_pure_ctypes_client():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_TPU_ROOT"] = _REPO
+    out = subprocess.run([sys.executable, "-c", CLIENT, _SO],
+                         capture_output=True, text=True, timeout=600,
+                         cwd=_REPO, env=env)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "CAPI_CLIENT_OK" in out.stdout
