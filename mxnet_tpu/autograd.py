@@ -36,6 +36,11 @@ def _st():
         _state.recording = False
         _state.training = False
         _state.tape = []
+        # id(NDArray) -> [tape nodes referencing it]: lets a recorded
+        # in-place write retarget only the nodes that actually touch the
+        # array (O(uses), not O(tape)). Ids stay valid while indexed: the
+        # node input/output lists hold strong references.
+        _state.tape_index = {}
     return _state
 
 
@@ -166,11 +171,31 @@ class _TapeNode:
 
 
 def _record_node(vjp, inputs, outputs, out_avals):
-    _st().tape.append(_TapeNode(vjp, inputs, outputs, out_avals))
+    st = _st()
+    node = _TapeNode(vjp, inputs, outputs, out_avals)
+    st.tape.append(node)
+    idx = st.tape_index
+    for a in list(inputs) + list(outputs):
+        if a is not None:
+            idx.setdefault(id(a), []).append(node)
+
+
+def _retarget(frm, to):
+    """Swap every tape reference to `frm` for `to` — the identity rewrite
+    behind NDArray._recorded_setitem (the pre-write value becomes its own
+    tape identity). O(nodes using frm) via the tape index."""
+    st = _st()
+    nodes = st.tape_index.pop(id(frm), [])
+    for node in nodes:
+        node.inputs = [to if a is frm else a for a in node.inputs]
+        node.outputs = [to if a is frm else a for a in node.outputs]
+    if nodes:
+        st.tape_index.setdefault(id(to), []).extend(nodes)
 
 
 def _clear_tape():
     _st().tape = []
+    _st().tape_index = {}
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

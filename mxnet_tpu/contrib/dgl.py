@@ -1,0 +1,161 @@
+"""CSR-aware frontends for the DGL graph ops — the FComputeEx path of
+`src/operator/contrib/dgl_graph.cc` rendered in python over the repo's
+CSRNDArray (data/indices/indptr components), O(nnz) with exact edge-id
+semantics (no dense rendering ambiguity). Shadowed onto `nd.contrib` next
+to the registered dense-op names (same pattern as `nd.sparse_retain`,
+`mxnet_tpu/ndarray/__init__.py:41`).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..ndarray.sparse import CSRNDArray
+
+
+def _csr_parts(csr):
+    return (_np.asarray(csr.data.asnumpy()),
+            _np.asarray(csr.indices.asnumpy(), _np.int64),
+            _np.asarray(csr.indptr.asnumpy(), _np.int64))
+
+
+def _mk_csr(data, indices, indptr, shape):
+    return CSRNDArray(nd.array(_np.asarray(data)),
+                      nd.array(_np.asarray(indices, _np.int64), dtype="int64"),
+                      nd.array(_np.asarray(indptr, _np.int64), dtype="int64"),
+                      shape)
+
+
+def edge_id(csr, u, v):
+    """`_contrib_edge_id` (`dgl_graph.cc:1300`) over the CSR directly:
+    out[i] = stored value at (u[i], v[i]) else -1 — exact for ANY edge ids
+    (including 0, which the dense op rendering cannot represent)."""
+    data, indices, indptr = _csr_parts(csr)
+    uu = _np.asarray(u.asnumpy(), _np.int64).reshape(-1)
+    vv = _np.asarray(v.asnumpy(), _np.int64).reshape(-1)
+    # output dtype follows the edge-id dtype (reference EdgeIDType,
+    # `dgl_graph.cc:1197`): int64 ids survive exactly
+    out = _np.full(uu.shape, -1, data.dtype if data.size else _np.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        lo, hi = indptr[a], indptr[a + 1]
+        pos = _np.searchsorted(indices[lo:hi], b)
+        if pos < hi - lo and indices[lo + pos] == b:
+            out[i] = data[lo + pos]
+    return nd.array(out)
+
+
+def dgl_adjacency(csr):
+    """`_contrib_dgl_adjacency` (`dgl_graph.cc:1376`): same sparsity, all
+    values 1.0 float32."""
+    data, indices, indptr = _csr_parts(csr)
+    return _mk_csr(_np.ones_like(data, _np.float32), indices, indptr,
+                   csr.shape)
+
+
+def dgl_subgraph(csr, *vertex_arrays, return_mapping=False):
+    """`_contrib_dgl_subgraph` (`dgl_graph.cc:1115`): induced subgraph per
+    vertex set; new edge ids 1..E row-major, plus the parent-edge-id copy
+    when return_mapping."""
+    data, indices, indptr = _csr_parts(csr)
+    new_out, old_out = [], []
+    for vs in vertex_arrays:
+        vlist = [int(x) for x in _np.asarray(vs.asnumpy()).reshape(-1)]
+        pos = {v: i for i, v in enumerate(vlist)}
+        s_ind, s_old, s_ptr = [], [], [0]
+        for v in vlist:
+            lo, hi = indptr[v], indptr[v + 1]
+            for k in range(lo, hi):
+                c = int(indices[k])
+                if c in pos:
+                    s_ind.append(pos[c])
+                    s_old.append(data[k])
+            s_ptr.append(len(s_ind))
+        n = len(vlist)
+        s_new = _np.arange(1, len(s_ind) + 1, dtype=_np.int64)
+        new_out.append(_mk_csr(s_new, s_ind, s_ptr, (n, n)))
+        old_out.append(_mk_csr(_np.asarray(s_old), s_ind, s_ptr, (n, n)))
+    outs = new_out + old_out if return_mapping else new_out
+    return outs if len(outs) > 1 else outs[0]
+
+
+def _neighbor_sample(csr, seed_arrays, num_hops, num_neighbor,
+                     max_num_vertices, probability=None):
+    from .. import random as _random
+    from ..ops.graph_ops import csr_neighbor_sample
+
+    data, indices, indptr = _csr_parts(csr)
+    rng = _np.random.RandomState(_np.uint32(_random.derive_host_seed()))
+    verts, csrs, layers = [], [], []
+    for seeds in seed_arrays:
+        v, (sd, si, sp), lay = csr_neighbor_sample(
+            indptr, indices, data, seeds.asnumpy(), num_hops, num_neighbor,
+            max_num_vertices, probability=probability, rng=rng)
+        verts.append(nd.array(v, dtype="int64"))
+        csrs.append(_mk_csr(sd, si, sp,
+                            (int(max_num_vertices), csr.shape[1])))
+        layers.append(nd.array(lay, dtype="int64"))
+    return verts, csrs, layers
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seed_arrays, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):
+    """`_contrib_dgl_csr_neighbor_uniform_sample` (`dgl_graph.cc:744`)."""
+    verts, csrs, layers = _neighbor_sample(csr, seed_arrays, num_hops,
+                                           num_neighbor, max_num_vertices)
+    return verts + csrs + layers
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, *seed_arrays,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2, max_num_vertices=100):
+    """`_contrib_dgl_csr_neighbor_non_uniform_sample` (`dgl_graph.cc:838`).
+    Output order matches the reference ComputeEx: vertices, sub_csrs,
+    probabilities, layers."""
+    prob = _np.asarray(probability.asnumpy(), _np.float64)
+    verts, csrs, layers = _neighbor_sample(csr, seed_arrays, num_hops,
+                                           num_neighbor, max_num_vertices,
+                                           probability=prob)
+    probs = []
+    for v in verts:
+        vn = _np.asarray(v.asnumpy())[:-1]
+        p = _np.zeros((len(vn),), _np.float32)
+        valid = vn >= 0
+        p[valid] = prob[vn[valid]]
+        probs.append(nd.array(p))
+    return verts + csrs + probs + layers
+
+
+def dgl_graph_compact(*graphs, graph_sizes=(), return_mapping=False):
+    """`_contrib_dgl_graph_compact` (`dgl_graph.cc:1551`): drop the
+    sampler's max_num_vertices padding, keeping graph_sizes[i] vertices."""
+    outs = []
+    for g, sz in zip(graphs, graph_sizes):
+        sz = int(sz)
+        data, indices, indptr = _csr_parts(g)
+        keep_d, keep_i, ptr = [], [], [0]
+        for r in range(sz):
+            lo, hi = indptr[r], indptr[r + 1]
+            for k in range(lo, hi):
+                if indices[k] < sz:
+                    keep_i.append(int(indices[k]))
+                    keep_d.append(data[k])
+            ptr.append(len(keep_i))
+        outs.append(_mk_csr(_np.asarray(keep_d), keep_i, ptr, (sz, sz)))
+    return outs if len(outs) > 1 else outs[0]
+
+
+def getnnz(csr, axis=None):
+    """`_contrib_getnnz` (`contrib/nnz.cc`): stored-entry count, total or
+    per column."""
+    data, indices, indptr = _csr_parts(csr)
+    if axis is None:
+        return nd.array(_np.asarray([len(data)], _np.int64), dtype="int64")
+    if int(axis) != 0:
+        from ..base import MXNetError
+
+        raise MXNetError("getnnz: axis must be None or 0")
+    counts = _np.zeros((csr.shape[1],), _np.int64)
+    for c in indices:
+        counts[int(c)] += 1
+    return nd.array(counts, dtype="int64")

@@ -425,6 +425,10 @@ class NDArray:
         return NDArray(out, self._ctx)
 
     def __setitem__(self, key, value):
+        from .. import autograd
+
+        if autograd.is_recording() and self._recorded_setitem(key, value):
+            return
         if isinstance(value, NDArray):
             value = value._data
         elif isinstance(value, (_np.ndarray, list, tuple, float, int)):
@@ -434,6 +438,55 @@ class NDArray:
             return
         key = _convert_index(key)
         self._data = self._data.at[key].set(value.astype(self.dtype) if hasattr(value, "astype") else value)
+
+    def _recorded_setitem(self, key, value):
+        """Differentiable sliced write (`nd[a:b] = v` inside autograd.record).
+
+        The reference forbids in-place writes to arrays in the graph
+        (`imperative.cc` RecordOp's AGInfo check); here the write is
+        FUNCTIONAL — `_slice_assign` (`matrix_op.cc:477`) — so gradients
+        flow both around the window (to the pre-write value) and into the
+        window (to `value`). The pre-write value becomes a fresh tape
+        identity; if `self` was a marked leaf the mark (and grad buffer)
+        moves to it, so `self.grad` after backward is the gradient wrt the
+        value `self` held when recording reached this write.
+
+        Returns True when the write was handled (basic int/slice keys);
+        advanced (array) keys fall back to the raw in-place path."""
+        keys = key if isinstance(key, tuple) else (key,)
+        if not all(isinstance(k, (slice, int, _np.integer)) for k in keys) \
+                or len(keys) > self.ndim:
+            return False
+        begin, end, step = [], [], []
+        for k in keys:
+            if isinstance(k, (int, _np.integer)):
+                k = int(k)
+                if k < 0:
+                    k += self.shape[len(begin)]
+                begin.append(k); end.append(k + 1); step.append(1)
+            else:
+                if k.step is not None and int(k.step) < 0:
+                    return False  # negative-step writes stay on the raw path
+                begin.append(k.start); end.append(k.stop); step.append(k.step or 1)
+        old = NDArray(self._data, self._ctx)
+        old.grad, old.grad_req = self.grad, self.grad_req
+        old._ag_marked, self._ag_marked = self._ag_marked, False
+        from .. import autograd
+        from .register import invoke_nd
+
+        autograd._retarget(self, old)
+        if isinstance(value, numeric_types):
+            out = invoke_nd("_slice_assign_scalar", old, begin=tuple(begin),
+                            end=tuple(end), step=tuple(step),
+                            scalar=float(value))
+        else:
+            if not isinstance(value, NDArray):
+                value = NDArray(jnp.asarray(value, dtype=self.dtype), self._ctx)
+            out = invoke_nd("_slice_assign", old, value, begin=tuple(begin),
+                            end=tuple(end), step=tuple(step))
+        autograd._retarget(out, self)
+        self._data = out._data
+        return True
 
     def __iter__(self):
         for i in range(self.shape[0]):

@@ -19,6 +19,7 @@ from . import quantization_ops  # noqa: F401
 from . import optimizer_ops # noqa: F401
 from . import vision        # noqa: F401
 from . import image_ops     # noqa: F401
+from . import graph_ops     # noqa: F401
 
 # legacy v1 op names (reference `convolution_v1.cc` / `pooling_v1.cc`
 # register the pre-NNVM kernels under *_v1; numerically identical here)

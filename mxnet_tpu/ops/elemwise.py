@@ -218,6 +218,7 @@ _SCALAR = {
     "_logical_or_scalar": lambda x, s: jnp.logical_or(x, s).astype(x.dtype),
     "_logical_xor_scalar": lambda x, s: jnp.logical_xor(x, s).astype(x.dtype),
     "_scatter_plus_scalar": lambda x, s: x + s,
+    "_scatter_minus_scalar": lambda x, s: x - s,
 }
 
 for _name, _f in _SCALAR.items():
@@ -231,3 +232,91 @@ def _smooth_l1(x, scalar=1.0, **kw):
     s2 = float(scalar) ** 2
     absx = jnp.abs(x)
     return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# storage-aware aliases and gradient-routing identities
+# ---------------------------------------------------------------------------
+# The reference registers these as distinct nodes because its executor
+# dispatches on storage type / write mode (`elemwise_unary_op_basic.cc:352`,
+# `elemwise_binary_op_basic.cc` _grad_add); in XLA they are the same fused
+# elementwise HLO — the distinct names exist for graph parity (legacy
+# symbol-JSON must load) and for the sparse frontends that shadow them.
+
+
+@register("_grad_add")
+def _grad_add(lhs, rhs, **kw):
+    """Gradient accumulation add (`elemwise_binary_op_basic.cc` _grad_add):
+    identical math to elemwise_add but always a write (never in-place
+    aliasing) in the reference; XLA owns buffers here, so it is a plain
+    add that fuses into the producing kernel."""
+    return lhs + rhs
+
+
+@register("_copyto")
+def _copyto(x, **kw):
+    """Cross-context copy node (`ndarray.cc` CopyFromTo as an op). Device
+    placement is a frontend concern (Context → jax.device_put); inside a
+    program it is the identity."""
+    return x
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs, **kw):
+    """Identity on lhs, output storage/shape attrs taken from rhs
+    (`elemwise_unary_op_basic.cc:352`). Used by the reference to route
+    sparse storage attrs through graph rewrites; values are lhs."""
+    return lhs
+
+
+@register("_zeros_without_dtype")
+def _zeros_without_dtype(shape=None, ctx=None, dtype=-1, **kw):
+    """`_zeros_without_dtype` (`init_op.cc`): zeros whose dtype defaults at
+    graph-build time (dtype=-1 → float32) rather than being pinned."""
+    from ._utils import as_tuple
+    from ..base import np_dtype
+
+    dt = "float32" if dtype in (-1, "-1", None, "None") else dtype
+    return jnp.zeros(as_tuple(shape) or (), np_dtype(dt))
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(lhs, rhs, **kw):
+    """`_scatter_elemwise_div` (`elemwise_binary_op_basic.cc`): division
+    applied only to stored (nonzero) entries of a sparse lhs. Dense
+    rendering divides everywhere — 0/x keeps the zeros, so values agree;
+    the sparse frontend keeps the O(nnz) path."""
+    return lhs / rhs
+
+
+@register("_contrib_quadratic", aliases=["contrib_quadratic"])
+def _contrib_quadratic(data, a=0.0, b=0.0, c=0.0, **kw):
+    """`_contrib_quadratic` (`contrib/quadratic_op.cc:31`):
+    f(x) = a*x^2 + b*x + c."""
+    return float(a) * jnp.square(data) + float(b) * data + float(c)
+
+
+def _make_gradientmultiplier():
+    @jax.custom_vjp
+    def gm(data, scalar):
+        return data
+
+    def fwd(data, scalar):
+        return data, scalar
+
+    def bwd(scalar, ct):
+        return (ct * scalar, None)
+
+    gm.defvjp(fwd, bwd)
+    return gm
+
+
+_gm_core = _make_gradientmultiplier()
+
+
+@register("_contrib_gradientmultiplier", aliases=["contrib_gradientmultiplier"])
+def _contrib_gradientmultiplier(data, scalar=1.0, **kw):
+    """`_contrib_gradientmultiplier` (`contrib/gradient_multiplier_op.cc`):
+    identity forward, gradient scaled by `scalar` on the way back (the
+    gradient-reversal-layer building block when scalar < 0)."""
+    return _gm_core(data, jnp.asarray(float(scalar), data.dtype))

@@ -1,0 +1,272 @@
+"""DGL graph-sampling ops — parity with the reference's
+`src/operator/contrib/dgl_graph.cc` (_contrib_dgl_csr_neighbor_uniform_sample
+:744, _contrib_dgl_csr_neighbor_non_uniform_sample :838, _contrib_dgl_subgraph
+:1115, _contrib_edge_id :1300, _contrib_dgl_adjacency :1376,
+_contrib_dgl_graph_compact :1551) and `_contrib_getnnz`
+(`src/operator/contrib/nnz.cc`).
+
+Graph sampling is data-dependent host work on every backend (the reference
+runs these on CPU over CSR indptr/indices; there is no GPU kernel) — so
+these are eager_only host ops. At the op layer the graph argument is the
+DENSE edge-id rendering of the CSR (entry (u, v) holds the edge id stored in
+the CSR value, 0 = no edge — the reference's own examples use 1-based edge
+ids for exactly this reason); the CSR-aware frontends in
+`mxnet_tpu.contrib.dgl` shadow these names on `nd.contrib` and work directly
+on (data, indices, indptr) in O(nnz), returning CSRNDArray outputs like the
+reference's FComputeEx path.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from .registry import register
+from ._utils import parse_bool
+
+
+def _dense_to_csr(adj):
+    adj = _np.asarray(adj)
+    indptr = [0]
+    indices = []
+    data = []
+    for r in range(adj.shape[0]):
+        nz = _np.nonzero(adj[r])[0]
+        indices.extend(nz.tolist())
+        data.extend(adj[r, nz].tolist())
+        indptr.append(len(indices))
+    return (_np.asarray(data), _np.asarray(indices, _np.int64),
+            _np.asarray(indptr, _np.int64))
+
+
+def csr_neighbor_sample(indptr, indices, data, seeds, num_hops, num_neighbor,
+                        max_num_vertices, probability=None, rng=None):
+    """Core neighbor sampler shared by the op layer and the CSR frontend
+    (`dgl_graph.cc` SampleSubgraph): BFS from `seeds` for `num_hops` layers
+    keeping at most `num_neighbor` neighbors per vertex (uniformly, or by
+    `probability` when given). Returns (vertices[max+1] with count in the
+    last slot, sub-csr triple over ORIGINAL edge ids, layer[max])."""
+    rng = rng or _np.random
+    indptr = _np.asarray(indptr, _np.int64)
+    indices = _np.asarray(indices, _np.int64)
+    data = _np.asarray(data)
+    seeds = [int(s) for s in _np.asarray(seeds).reshape(-1) if s >= 0]
+    layer_of = {}
+    for s in seeds:
+        if len(layer_of) >= int(max_num_vertices):
+            break  # more seeds than the vertex budget: extras are dropped
+        layer_of.setdefault(s, 0)
+    frontier = list(layer_of)
+    # sampled edges per DESTINATION vertex (the reference samples the
+    # in-edges of each frontier vertex: row v of the CSR lists v's neighbors)
+    sampled_edges = {}
+    for hop in range(1, int(num_hops) + 1):
+        nxt = []
+        for v in frontier:
+            lo, hi = int(indptr[v]), int(indptr[v + 1])
+            nbr = indices[lo:hi]
+            eid = data[lo:hi]
+            if len(nbr) == 0:
+                continue
+            if probability is not None:
+                p = _np.asarray(probability)[nbr].astype(_np.float64)
+                tot = p.sum()
+                if tot <= 0:
+                    continue
+                nz = int((p > 0).sum())
+                # reference GetNonUniformSample (`dgl_graph.cc:490`): when
+                # there are no more candidates than requested, keep them all
+                k = min(int(num_neighbor), nz)
+                pick = rng.choice(len(nbr), size=k, replace=False, p=p / tot)
+            else:
+                k = min(int(num_neighbor), len(nbr))
+                pick = rng.choice(len(nbr), size=k, replace=False)
+            for j in pick:
+                u = int(nbr[j])
+                sampled_edges.setdefault(v, []).append((u, eid[j]))
+                if u not in layer_of and len(layer_of) < int(max_num_vertices):
+                    layer_of[u] = hop
+                    nxt.append(u)
+        frontier = nxt
+        if not frontier:
+            break
+    verts = sorted(layer_of)[: int(max_num_vertices)]
+    vset = set(verts)
+    n = int(max_num_vertices)
+    out_verts = _np.full((n + 1,), -1, _np.int64)
+    out_verts[: len(verts)] = verts
+    out_verts[-1] = len(verts)
+    out_layer = _np.full((n,), -1, _np.int64)
+    for i, v in enumerate(verts):
+        out_layer[i] = layer_of[v]
+    # sub-csr rows are the sampled vertices' positions (row v keeps only
+    # sampled in-edges whose source also survived the vertex cap)
+    sub_indptr = [0]
+    sub_indices = []
+    sub_data = []
+    for v in verts:
+        for (u, e) in sorted(sampled_edges.get(v, [])):
+            if u in vset:  # every kept edge endpoint is an output vertex
+                sub_indices.append(u)
+                sub_data.append(e)
+        sub_indptr.append(len(sub_indices))
+    while len(sub_indptr) < n + 1:
+        sub_indptr.append(len(sub_indices))
+    return (out_verts, (_np.asarray(sub_data), _np.asarray(sub_indices, _np.int64),
+                        _np.asarray(sub_indptr, _np.int64)), out_layer)
+
+
+def _sample_op(adj, seed_arrays, num_hops, num_neighbor, max_num_vertices,
+               probability=None):
+    from .. import random as _random
+
+    data, indices, indptr = _dense_to_csr(adj)
+    rng = _np.random.RandomState(_np.uint32(_random.derive_host_seed()))
+    n_graph = _np.asarray(adj).shape[1]
+    vert_outs, csr_outs, layer_outs = [], [], []
+    for seeds in seed_arrays:
+        verts, (sd, si, sp), layers = csr_neighbor_sample(
+            indptr, indices, data, _np.asarray(seeds), num_hops, num_neighbor,
+            max_num_vertices, probability=probability, rng=rng)
+        dense = _np.zeros((int(max_num_vertices), n_graph), data.dtype
+                          if data.size else _np.int64)
+        for r in range(int(max_num_vertices)):
+            for k in range(int(sp[r]), int(sp[r + 1])):
+                dense[r, int(si[k])] = sd[k]
+        vert_outs.append(jnp.asarray(verts))
+        csr_outs.append(jnp.asarray(dense))
+        layer_outs.append(jnp.asarray(layers))
+    return tuple(vert_outs + csr_outs + layer_outs)
+
+
+def _sample_nout(attrs):
+    return 3 * (int(attrs.get("num_args", 2)) - 1)
+
+
+@register("_contrib_dgl_csr_neighbor_uniform_sample", num_outputs=_sample_nout,
+          eager_only=True)
+def _dgl_uniform_sample(adj, *seed_arrays, num_args=2, num_hops=1,
+                        num_neighbor=2, max_num_vertices=100, **kw):
+    """`_contrib_dgl_csr_neighbor_uniform_sample` (`dgl_graph.cc:744`)."""
+    return _sample_op(adj, seed_arrays, num_hops, num_neighbor,
+                      max_num_vertices)
+
+
+@register("_contrib_dgl_csr_neighbor_non_uniform_sample",
+          num_outputs=lambda attrs: 4 * (int(attrs.get("num_args", 3)) - 2),
+          eager_only=True)
+def _dgl_non_uniform_sample(adj, probability, *seed_arrays, num_args=3,
+                            num_hops=1, num_neighbor=2, max_num_vertices=100,
+                            **kw):
+    """`_contrib_dgl_csr_neighbor_non_uniform_sample` (`dgl_graph.cc:838`):
+    like the uniform sampler plus a per-vertex probability input; also
+    emits the sampled vertices' probabilities. Output order follows the
+    reference's ComputeEx exactly: vertices[i], sub_csr[i+n], prob[i+2n],
+    layer[i+3n]."""
+    outs = _sample_op(adj, seed_arrays, num_hops, num_neighbor,
+                      max_num_vertices, probability=_np.asarray(probability))
+    n = len(seed_arrays)
+    verts, csrs, layers = outs[:n], outs[n:2 * n], outs[2 * n:]
+    prob_np = _np.asarray(probability)
+    probs = []
+    for v in verts:
+        vn = _np.asarray(v)[:-1]
+        p = _np.zeros((len(vn),), _np.float32)
+        valid = vn >= 0
+        p[valid] = prob_np[vn[valid]]
+        probs.append(jnp.asarray(p))
+    return tuple(list(verts) + list(csrs) + probs + list(layers))
+
+
+def _subgraph_nout(attrs):
+    n = int(attrs.get("num_args", 2)) - 1
+    return 2 * n if parse_bool(attrs.get("return_mapping", False)) else n
+
+
+@register("_contrib_dgl_subgraph", num_outputs=_subgraph_nout, eager_only=True)
+def _dgl_subgraph(adj, *vertex_arrays, num_args=2, return_mapping=False, **kw):
+    """`_contrib_dgl_subgraph` (`dgl_graph.cc:1115`): induced subgraph over
+    each vertex set; edges renumbered 1..E in row-major order, plus (when
+    return_mapping) the same subgraph carrying the parent's edge ids."""
+    adj = _np.asarray(adj)
+    new_out, old_out = [], []
+    for vs in vertex_arrays:
+        vs = [int(v) for v in _np.asarray(vs).reshape(-1)]
+        pos = {v: i for i, v in enumerate(vs)}
+        sub_old = adj[_np.ix_(vs, vs)]
+        sub_new = _np.zeros_like(sub_old)
+        # edge ids are assigned walking each row's PARENT columns in
+        # ascending order — the same order the CSR frontend's indptr walk
+        # produces (contrib.dgl.dgl_subgraph), so the two renderings agree
+        # even for unsorted vertex arrays
+        nxt = 1
+        for v in vs:
+            for col in sorted(c for c in pos if adj[v, c] != 0):
+                sub_new[pos[v], pos[col]] = nxt
+                nxt += 1
+        new_out.append(jnp.asarray(sub_new))
+        old_out.append(jnp.asarray(sub_old))
+    if parse_bool(return_mapping):
+        return tuple(new_out + old_out)
+    return tuple(new_out) if len(new_out) > 1 else new_out[0]
+
+
+@register("_contrib_edge_id", aliases=["contrib_edge_id"], eager_only=True)
+def _edge_id(data, u, v, **kw):
+    """`_contrib_edge_id` (`dgl_graph.cc:1300`): out[i] = data[u[i], v[i]]
+    when the edge exists else -1. Dense rendering: 0 entries mean
+    'no edge' (the reference stores 1-based edge ids in its own examples);
+    the CSR frontend (`contrib.dgl.edge_id`) is exact for any ids."""
+    uu = jnp.asarray(u).astype(jnp.int32).reshape(-1)
+    vv = jnp.asarray(v).astype(jnp.int32).reshape(-1)
+    vals = jnp.asarray(data)[uu, vv]
+    # output dtype follows the edge-id dtype (reference EdgeIDType,
+    # `dgl_graph.cc:1197`) — int64 ids must not round through float32
+    return jnp.where(vals != 0, vals, -1).astype(vals.dtype)
+
+
+@register("_contrib_dgl_adjacency", aliases=["contrib_dgl_adjacency"])
+def _dgl_adjacency(data, **kw):
+    """`_contrib_dgl_adjacency` (`dgl_graph.cc:1376`): edge-id matrix →
+    connectivity matrix (all stored values become 1.0)."""
+    return (data != 0).astype(jnp.float32)
+
+
+def _compact_nout(attrs):
+    n = int(attrs.get("num_args", 1))
+    if parse_bool(attrs.get("return_mapping", False)):
+        n //= 2
+    return n
+
+
+@register("_contrib_dgl_graph_compact", num_outputs=_compact_nout,
+          eager_only=True)
+def _dgl_graph_compact(*graphs, num_args=1, return_mapping=False,
+                       graph_sizes=(), **kw):
+    """`_contrib_dgl_graph_compact` (`dgl_graph.cc:1551`): strip the
+    max_num_vertices padding the samplers emit — each input graph i keeps
+    its first graph_sizes[i] rows/cols."""
+    from ._utils import as_tuple
+
+    sizes = [int(s) for s in (as_tuple(graph_sizes) or ())]
+    outs = []
+    for g, sz in zip(graphs, sizes):
+        g = _np.asarray(g)
+        outs.append(jnp.asarray(g[:sz, :sz]))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register("_contrib_getnnz", aliases=["contrib_getnnz"], eager_only=True)
+def _getnnz(data, axis=None, **kw):
+    """`_contrib_getnnz` (`contrib/nnz.cc`): number of stored (nonzero)
+    entries of a CSR matrix — total (axis=None) or per column (axis=0)."""
+    d = _np.asarray(data)
+    if axis in (None, "None"):
+        return jnp.asarray(_np.int64((d != 0).sum()))
+    axis = int(axis)
+    if axis != 0:
+        from ..base import MXNetError
+
+        raise MXNetError("getnnz: only axis=None or 0 supported (reference "
+                         "nnz.cc accepts the same)")
+    return jnp.asarray((d != 0).sum(axis=0).astype(_np.int64))

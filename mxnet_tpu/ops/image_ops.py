@@ -214,3 +214,81 @@ def _crop(data, x=0, y=0, width=1, height=1, **kw):
     if data.ndim == 3:
         return data[y:y + height, x:x + width, :]
     return data[:, y:y + height, x:x + width, :]
+
+
+# ---------------------------------------------------------------------------
+# cv* codec ops — the reference's OpenCV-backed host image ops
+# (`src/io/image_io.cc:242` _cvimdecode/_cvimread/_cvimresize/
+# _cvcopyMakeBorder). Codec work is HOST work on any backend (the reference
+# runs these on CPU too), so they are eager_only host functions: PIL decode
+# + numpy, returning device arrays. Not differentiable (uint8 codecs).
+# ---------------------------------------------------------------------------
+
+
+def _pil_decode(buf_np, flag, to_rgb):
+    import io as _io
+
+    import numpy as _np
+    from PIL import Image
+
+    img = Image.open(_io.BytesIO(bytes(bytearray(_np.asarray(buf_np, dtype=_np.uint8)))))
+    if int(flag) == 0:
+        arr = _np.asarray(img.convert("L"))[:, :, None]
+    else:
+        arr = _np.asarray(img.convert("RGB"))
+        if not parse_bool(to_rgb):
+            arr = arr[:, :, ::-1]
+    return jnp.asarray(arr.copy())
+
+
+@register("_cvimdecode", aliases=["cvimdecode"], eager_only=True)
+def _cvimdecode(buf, flag=1, to_rgb=True, **kw):
+    """`_cvimdecode` (`image_io.cc:242`): decode an encoded image byte
+    buffer (uint8 1-D) to an HWC uint8 array."""
+    return _pil_decode(buf, flag, to_rgb)
+
+
+@register("_cvimread", aliases=["cvimread"], eager_only=True)
+def _cvimread(filename=None, flag=1, to_rgb=True, **kw):
+    """`_cvimread` (`image_io.cc`): read + decode an image file."""
+    with open(str(filename), "rb") as f:
+        import numpy as _np
+
+        buf = _np.frombuffer(f.read(), dtype=_np.uint8)
+    return _pil_decode(buf, flag, to_rgb)
+
+
+@register("_cvimresize", aliases=["cvimresize"], eager_only=True)
+def _cvimresize(src, w=None, h=None, interp=1, **kw):
+    """`_cvimresize` (`image_io.cc`): host resize of an HWC uint8 image."""
+    import numpy as _np
+    from PIL import Image
+
+    arr = _np.asarray(src).astype(_np.uint8)
+    squeeze = arr.shape[-1] == 1
+    img = Image.fromarray(arr[:, :, 0] if squeeze else arr)
+    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                3: Image.LANCZOS, 4: Image.LANCZOS}.get(int(interp), Image.BILINEAR)
+    out = _np.asarray(img.resize((int(w), int(h)), resample))
+    if squeeze:
+        out = out[:, :, None]
+    return jnp.asarray(out.copy())
+
+
+@register("_cvcopyMakeBorder", aliases=["cvcopyMakeBorder"], eager_only=True)
+def _cvcopy_make_border(src, top=0, bot=0, left=0, right=0, type=0, value=0.0, **kw):
+    """`_cvcopyMakeBorder` (`image_io.cc`): pad an HWC image. type 0 =
+    constant fill (cv2.BORDER_CONSTANT); 1 = replicate edge; 2 = reflect."""
+    import numpy as _np
+
+    arr = _np.asarray(src)
+    pads = ((int(top), int(bot)), (int(left), int(right)), (0, 0))
+    t = int(type)
+    if t == 1:
+        out = _np.pad(arr, pads, mode="edge")
+    elif t == 2:
+        out = _np.pad(arr, pads, mode="reflect")
+    else:
+        out = _np.pad(arr, pads, mode="constant",
+                      constant_values=_np.asarray(value, arr.dtype))
+    return jnp.asarray(out)

@@ -229,7 +229,7 @@ def _cast_storage_op(data, stype="default", **kw):
     return data
 
 
-@register("sparse_retain")
+@register("_sparse_retain", aliases=["sparse_retain"])
 def _sparse_retain_op(data, indices, **kw):
     """Registered `sparse_retain` (`tensor/sparse_retain.cc`): dense
     rendering — zero every row NOT in `indices` (for a RowSparseNDArray
@@ -249,3 +249,15 @@ def _square_sum_op(data, axis=None, keepdims=False, **kw):
     axes = reduce_axes(axis, data.ndim)
     return jnp.sum(jnp.square(data), axis=axes,
                    keepdims=parse_bool(keepdims))
+
+
+@register("_contrib_SparseEmbedding", aliases=["contrib_SparseEmbedding"],
+          sparse_vjp=lambda arrays, attrs: _embedding_sparse_vjp(
+              arrays, {**attrs, "sparse_grad": True}))
+def _sparse_embedding(data, weight, input_dim=None, output_dim=None,
+                      dtype="float32", deterministic=False, **kw):
+    """`_contrib_SparseEmbedding` (`indexing_op.cc` SparseEmbedding):
+    Embedding whose weight gradient is ALWAYS row_sparse — the contrib
+    precursor of Embedding(sparse_grad=True); same forward gather."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)

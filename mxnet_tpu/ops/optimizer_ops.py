@@ -289,3 +289,93 @@ def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t=None, lr=
     new_var = b2 * var + (1 - b2) * jnp.square(g)
     new_w32 = weight32 - float(eta) * (float(lr) * new_mean / (jnp.sqrt(new_var) + float(epsilon)) + float(wd) * weight32)
     return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+# -- AdamW with tensor-valued rescale (dynamic loss scaling) -----------------
+# The reference registers `_adamw_update` / `_mp_adamw_update` separately
+# from the `_contrib_*` pair because rescale_grad is a TENSOR input there
+# (`contrib/adamw.cc:98,53`): under dynamic loss scaling the scale lives on
+# device, and the update is SKIPPED when it is NaN/Inf/0 (overflow step).
+# jnp.where renders the skip branchlessly — no host sync, the whole guarded
+# update stays one fused XLA kernel.
+
+
+def _finite_scale(rescale_grad):
+    rs = rescale_grad.reshape(()).astype(jnp.float32)
+    ok = jnp.isfinite(rs) & (rs != 0)
+    return rs, ok
+
+
+@register("_adamw_update", num_outputs=3, mutate_aux=(2, 3))
+def _adamw_update_t(weight, grad, mean, var, rescale_grad, lr=0.01, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                    clip_gradient=-1.0, **kw):
+    rs, ok = _finite_scale(rescale_grad)
+    g = grad.astype(jnp.float32) * rs
+    if clip_gradient not in (None, "None") and float(clip_gradient) > 0:
+        c = float(clip_gradient)
+        g = jnp.clip(g, -c, c)
+    b1, b2 = float(beta1), float(beta2)
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    w = weight.astype(jnp.float32)
+    new_w = w - float(eta) * (float(lr) * new_mean /
+                              (jnp.sqrt(new_var) + float(epsilon)) + float(wd) * w)
+    return (jnp.where(ok, new_w, w).astype(weight.dtype),
+            jnp.where(ok, new_mean, mean),
+            jnp.where(ok, new_var, var))
+
+
+@register("_mp_adamw_update", num_outputs=4, mutate_aux=(2, 3, 4))
+def _mp_adamw_update_t(weight, grad, mean, var, weight32, rescale_grad, lr=0.01,
+                       beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                       clip_gradient=-1.0, **kw):
+    rs, ok = _finite_scale(rescale_grad)
+    g = grad.astype(jnp.float32) * rs
+    if clip_gradient not in (None, "None") and float(clip_gradient) > 0:
+        c = float(clip_gradient)
+        g = jnp.clip(g, -c, c)
+    b1, b2 = float(beta1), float(beta2)
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    new_w32 = weight32 - float(eta) * (float(lr) * new_mean /
+                                       (jnp.sqrt(new_var) + float(epsilon)) +
+                                       float(wd) * weight32)
+    new_w32 = jnp.where(ok, new_w32, weight32)
+    return (new_w32.astype(weight.dtype),
+            jnp.where(ok, new_mean, mean),
+            jnp.where(ok, new_var, var),
+            new_w32)
+
+
+# -- AdaGrad family ----------------------------------------------------------
+
+
+@register("_sparse_adagrad_update", num_outputs=2, mutate_aux=(2,))
+def _sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    """`_sparse_adagrad_update` (`optimizer_op.cc:840`):
+    history += square(rescaled_grad); w -= lr * g / sqrt(history + eps).
+    Dense rendering — a zero gradient row contributes nothing to history
+    and moves nothing, so values agree with the reference's rows-only
+    kernel; the row_sparse frontend keeps the O(rows) path."""
+    g = _rescale(grad, rescale_grad, clip_gradient, wd, weight)
+    new_hist = history.astype(jnp.float32) + jnp.square(g)
+    new_w = weight.astype(jnp.float32) - float(lr) * g / jnp.sqrt(new_hist + float(epsilon))
+    return new_w.astype(weight.dtype), new_hist.astype(history.dtype)
+
+
+@register("_contrib_group_adagrad_update", aliases=["contrib_group_adagrad_update"],
+          num_outputs=2, mutate_aux=(2,))
+def _group_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-5,
+                          rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    """`_contrib_group_adagrad_update` (`contrib/optimizer_op.cc:53`):
+    per-ROW (group) accumulator — history += mean(square(grad), axis=1..);
+    the embedding-table optimizer whose state is one scalar per row."""
+    g = _rescale(grad, rescale_grad, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    g2 = jnp.mean(jnp.square(g), axis=axes, keepdims=True) if axes else jnp.square(g)
+    new_hist = history.astype(jnp.float32) + g2.reshape(history.shape)
+    div = g / jnp.sqrt(new_hist.reshape(g2.shape) + float(epsilon))
+    new_w = weight.astype(jnp.float32) - float(lr) * div
+    return new_w.astype(weight.dtype), new_hist.astype(history.dtype)

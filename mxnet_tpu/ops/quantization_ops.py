@@ -209,3 +209,24 @@ def _quantized_elemwise_add(a, b, min_a, max_a, min_b, max_b, **kw):
     # range such that code·maxabs/INT32_MAX reproduces the real value
     hi = scale_out * _INT32_MAX
     return out_i32, -hi, hi
+
+
+@register("_contrib_quantize", num_outputs=3)
+def _quantize_v1(data, min_range, max_range, out_type="uint8", **kw):
+    """`_contrib_quantize` (`quantization/quantize.cc`, v1 API): quantize
+    fp32 into int8 (zero-centered, `quantize-inl.h:73`) or uint8 (affine,
+    `quantize_unsigned`) given a CALLER-supplied float range — the ranges
+    ride as tensors so requantize chains stay on device."""
+    mn = min_range.reshape(()).astype(jnp.float32)
+    mx_ = max_range.reshape(()).astype(jnp.float32)
+    if str(out_type) in ("int8", "5"):
+        real_range = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        scale = _INT8_MAX / jnp.maximum(real_range, 1e-12)
+        q = jnp.clip(jnp.rint(data.astype(jnp.float32) * scale),
+                     -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+        return q, -real_range, real_range
+    # uint8: affine over [min_range, max_range]
+    scale = 255.0 / jnp.maximum(mx_ - mn, 1e-12)
+    q = jnp.clip(jnp.rint((data.astype(jnp.float32) - mn) * scale),
+                 0, 255).astype(jnp.uint8)
+    return q, mn, mx_

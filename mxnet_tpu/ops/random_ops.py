@@ -185,3 +185,27 @@ def _sample_unique_zipfian(key, range_max=1, shape=(), **kw):
     t_est, _ = jax.lax.scan(newton, t0, None, length=25)
     trials = jnp.full((batch,), jnp.ceil(t_est), jnp.float32).astype(jnp.int32)
     return samples, trials
+
+
+register("_sample_negative_binomial", aliases=["sample_negative_binomial"], needs_rng=True)(
+    _msample(lambda key, p, s: _nb_draw(key, _b(p[0], s), _b(p[1], s), s))
+)
+register("_sample_generalized_negative_binomial",
+         aliases=["sample_generalized_negative_binomial"], needs_rng=True)(
+    _msample(lambda key, p, s: _gnb_draw(key, _b(p[0], s), _b(p[1], s), s))
+)
+
+
+def _nb_draw(key, k, p, shape):
+    """NB(k, p) via the gamma-Poisson mixture (`multisample_op.cc` per-row
+    params): lambda ~ Gamma(k) * (1-p)/p, draw ~ Poisson(lambda)."""
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * (1.0 - p) / p
+    return jax.random.poisson(k2, lam, shape).astype(jnp.float32)
+
+
+def _gnb_draw(key, mu, alpha, shape):
+    """Generalized NB(mu, alpha): lambda ~ Gamma(1/alpha) * mu*alpha."""
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, 1.0 / alpha, shape) * (mu * alpha)
+    return jax.random.poisson(k2, lam, shape).astype(jnp.float32)

@@ -232,3 +232,100 @@ def _arange_like(x, start=0.0, step=1.0, repeat=1, axis=None, **kw):
         return (jnp.arange(n, dtype=x.dtype) * float(step) + float(start)).reshape(x.shape)
     n = x.shape[int(axis)]
     return jnp.arange(n, dtype=x.dtype) * float(step) + float(start)
+
+
+def _opt_int_tuple(v):
+    """Like as_tuple but entries may be None (open slice bounds); accepts
+    the string form the Symbol/JSON path serializes ("(1, None)")."""
+    if v in (None, "None"):
+        return ()
+    if isinstance(v, str):
+        import ast
+
+        v = ast.literal_eval(v.replace("L", ""))
+    if not isinstance(v, (tuple, list)):
+        v = (v,)
+    return tuple(None if e in (None, "None") else int(e) for e in v)
+
+
+def _slice_tuple(x, begin, end, step):
+    """Canonical python slices from MXNet begin/end/step attrs (shared by
+    slice / _slice_assign*, reference `matrix_op-inl.h` GetIndexRange)."""
+    begin, end = _opt_int_tuple(begin), list(_opt_int_tuple(end))
+    step = tuple(1 if s is None else s for s in _opt_int_tuple(step)) \
+        or (1,) * len(begin)
+    slices = []
+    for i in range(x.ndim):
+        if i < len(begin):
+            b = begin[i]
+            e = end[i] if i < len(end) else None
+            s = step[i] if i < len(step) else 1
+            slices.append(slice(None if b is None else int(b),
+                                None if e is None else int(e),
+                                int(s) if s else 1))
+        else:
+            slices.append(slice(None))
+    return tuple(slices)
+
+
+@register("_slice_assign", aliases=["_crop_assign"])
+def _slice_assign(lhs, rhs, begin=None, end=None, step=None, **kw):
+    """`_slice_assign` (`matrix_op.cc:477`): lhs with lhs[begin:end:step]
+    replaced by rhs — the differentiable sliced write behind
+    `nd[...] = nd` under autograd. One XLA dynamic-update-slice (or
+    scatter for strided steps); gradients flow to BOTH lhs (zeroed in the
+    window) and rhs (the window) via jax's native `.at[].set` vjp."""
+    return lhs.at[_slice_tuple(lhs, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=["_crop_assign_scalar"])
+def _slice_assign_scalar(lhs, begin=None, end=None, step=None, scalar=0.0, **kw):
+    """`_slice_assign_scalar` (`matrix_op.cc:527`): lhs with the slice
+    window filled by a scalar (`nd[1:3] = 2.5`)."""
+    return lhs.at[_slice_tuple(lhs, begin, end, step)].set(
+        jnp.asarray(float(scalar), lhs.dtype))
+
+
+def _split_v2_nout(attrs):
+    sections = int(attrs.get("sections", 0) or 0)
+    if sections > 0:
+        return sections
+    return len(as_tuple(attrs.get("indices")) or ()) + 1
+
+
+@register("_split_v2", num_outputs=_split_v2_nout)
+def _split_v2(x, indices=(), axis=0, squeeze_axis=False, sections=0, **kw):
+    """`_split_v2` (`matrix_op.cc:1147`): numpy-style split — by equal
+    `sections` or at explicit `indices` boundaries (ragged parts allowed,
+    unlike SliceChannel). Static shapes: both forms resolve at trace time."""
+    axis = int(axis) % x.ndim
+    sections = int(sections or 0)
+    if sections > 0:
+        parts = jnp.split(x, sections, axis=axis)
+    else:
+        parts = jnp.split(x, [int(i) for i in (as_tuple(indices) or ())], axis=axis)
+    if parse_bool(squeeze_axis):
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("reshape_like")
+def _reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                  rhs_end=None, **kw):
+    """`reshape_like` (`elemwise_unary_op_basic.cc:443`): reshape lhs to
+    rhs's shape; the optional [lhs_begin, lhs_end) dim range of lhs is
+    replaced by the [rhs_begin, rhs_end) dim range of rhs (reference
+    GetReshapeLikeParams, `elemwise_unary_op_basic.cc:392`)."""
+
+    def canon(v, ndim, default):
+        if v in (None, "None"):
+            return default
+        v = int(v)
+        return v + ndim if v < 0 else v
+
+    lb = canon(lhs_begin, lhs.ndim, 0)
+    le = canon(lhs_end, lhs.ndim, lhs.ndim)
+    rb = canon(rhs_begin, rhs.ndim, 0)
+    re = canon(rhs_end, rhs.ndim, rhs.ndim)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re] + lhs.shape[le:]
+    return jnp.reshape(lhs, new_shape)

@@ -103,3 +103,14 @@ def seed(seed_state, ctx="all"):
 
 
 # nd.random / sym.random namespaces are populated by ndarray/symbol register.
+
+
+def derive_host_seed():
+    """A 32-bit seed for HOST-side randomized ops (graph samplers, shuffle
+    fallbacks): drawn from the active key provider so `mx.random.seed`
+    controls host RNG reproducibly too."""
+    import numpy as _np
+
+    k = next_key()
+    data = jax.random.key_data(k) if hasattr(jax.random, "key_data") else k
+    return int(_np.asarray(data).ravel()[-1]) & 0x7FFFFFFF

@@ -366,8 +366,19 @@ MX_API int MXImperativeInvoke(const char* op_name, int num_inputs,
     PyList_SET_ITEM(ins, i, o);
   }
   for (int i = 0; i < num_params; ++i) {
-    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
-    PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+    PyObject* k = PyUnicode_FromString(keys[i]);
+    PyObject* v = PyUnicode_FromString(vals[i]);
+    if (k == nullptr || v == nullptr) {  // e.g. invalid UTF-8 in a raw char*
+      set_error_from_py();
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      Py_DECREF(ins);
+      Py_DECREF(ks);
+      Py_DECREF(vs);
+      return -1;
+    }
+    PyList_SET_ITEM(ks, i, k);
+    PyList_SET_ITEM(vs, i, v);
   }
   PyObject* outs = PyObject_CallFunction(fn, "sOOO", op_name, ins, ks, vs);
   Py_DECREF(ins);
@@ -405,6 +416,7 @@ MX_API int MXListAllOpNames(int* out_size, const char*** out_array) {
   g_name_store.reserve(static_cast<size_t>(n));
   for (Py_ssize_t i = 0; i < n; ++i) {
     const char* c = PyUnicode_AsUTF8(PyList_GET_ITEM(lst, i));
+    if (c == nullptr) PyErr_Clear();  // never leave an exception pending
     g_name_store.emplace_back(c != nullptr ? c : "");
   }
   Py_DECREF(lst);

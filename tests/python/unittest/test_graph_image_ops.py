@@ -1,0 +1,384 @@
+"""Round-5 gap-closure ops: DGL graph family, cv* codec ops, sparse
+embedding, NB samplers, gradientmultiplier backward, recorded __setitem__.
+
+Reference parity anchors: `src/operator/contrib/dgl_graph.cc` (the doc
+examples at :744/:1115/:1300 are replayed verbatim), `src/io/image_io.cc`,
+`src/operator/tensor/matrix_op.cc:477` (_slice_assign autograd).
+"""
+import io
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray.register import invoke_nd
+
+
+def _k5_graph():
+    """The 5-vertex complete graph (no self loops) with edge ids 1..20 —
+    the exact example from `dgl_graph.cc:744`."""
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], np.int64)
+    return mx.nd.sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+# ---------------------------------------------------------------------------
+# DGL family — CSR frontends (exact) + registered dense ops
+# ---------------------------------------------------------------------------
+
+
+def test_edge_id_csr():
+    a = _k5_graph()
+    u = mx.nd.array(np.array([0, 0, 1, 2], np.int64), dtype="int64")
+    v = mx.nd.array(np.array([1, 0, 0, 4], np.int64), dtype="int64")
+    out = mx.nd.contrib.edge_id(a, u, v).asnumpy()
+    # (0,1)=edge 1; (0,0) absent -> -1; (1,0)=edge 5; (2,4)=edge 12
+    np.testing.assert_array_equal(out, [1, -1, 5, 12])
+
+
+def test_edge_id_dense_op():
+    a = _k5_graph()
+    u = mx.nd.array(np.array([0, 0], np.int64), dtype="int64")
+    v = mx.nd.array(np.array([1, 0], np.int64), dtype="int64")
+    dense = mx.nd.array(a.tostype("default").asnumpy())
+    out = invoke_nd("_contrib_edge_id", dense, u, v).asnumpy()
+    np.testing.assert_array_equal(out, [1, -1])
+
+
+def test_dgl_adjacency():
+    a = _k5_graph()
+    adj = mx.nd.contrib.dgl_adjacency(a)
+    d = adj.tostype("default").asnumpy()
+    expect = 1.0 - np.eye(5, dtype=np.float32)
+    np.testing.assert_array_equal(d, expect)
+
+
+def test_dgl_subgraph_reference_example():
+    a = _k5_graph()
+    v = mx.nd.array(np.array([0, 1, 2], np.int64), dtype="int64")
+    new, old = mx.nd.contrib.dgl_subgraph(a, v, return_mapping=True)
+    np.testing.assert_array_equal(
+        old.tostype("default").asnumpy(),
+        [[0, 1, 2], [5, 0, 6], [9, 10, 0]])
+    # new ids are 1..E row-major over the same sparsity
+    np.testing.assert_array_equal(
+        new.tostype("default").asnumpy(),
+        [[0, 1, 2], [3, 0, 4], [5, 6, 0]])
+
+
+def test_dgl_uniform_sample_invariants():
+    a = _k5_graph()
+    seed = mx.nd.array(np.array([0, 1], np.int64), dtype="int64")
+    mx.random.seed(7)
+    verts, sub, layer = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    vn = verts.asnumpy()
+    assert vn.shape == (6,)
+    count = int(vn[-1])
+    assert 2 <= count <= 5                     # seeds + sampled neighbors
+    valid = vn[:count]
+    assert len(set(valid.tolist())) == count   # unique
+    assert {0, 1} <= set(valid.tolist())       # seeds present
+    ln = layer.asnumpy()
+    assert ln[0] == 0 and ln[1] == 0           # seeds are layer 0
+    assert sub.shape == (5, 5)
+    # every sampled edge id exists in the parent graph
+    parent = a.tostype("default").asnumpy()
+    sd = sub.tostype("default").asnumpy()
+    for r in range(count):
+        row_ids = sd[r][sd[r] != 0]
+        assert set(row_ids.tolist()) <= set(parent[valid[r]].tolist())
+
+
+def test_dgl_non_uniform_sample():
+    a = _k5_graph()
+    prob = mx.nd.array(np.array([0.0, 1.0, 1.0, 1.0, 1.0], np.float32))
+    seed = mx.nd.array(np.array([1], np.int64), dtype="int64")
+    mx.random.seed(3)
+    # reference output order (`dgl_graph.cc` ComputeEx): verts, csr, prob, layer
+    verts, sub, probs, layer = \
+        mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            a, prob, seed, num_args=3, num_hops=1, num_neighbor=3,
+            max_num_vertices=5)
+    vn = verts.asnumpy()
+    count = int(vn[-1])
+    # vertex 0 has probability 0 -> never sampled (seed 1 always present)
+    assert 0 not in vn[:count].tolist()
+    assert sub.shape == (5, 5)          # the sub-CSR sits at out[1]
+    pv = probs.asnumpy()
+    assert pv.shape == (5,)
+    assert pv[0] == 1.0  # probability of seed vertex 1
+
+
+def test_dgl_non_uniform_sample_few_candidates():
+    """num_neighbor larger than the nonzero-probability candidate pool must
+    keep all candidates, not raise (reference GetNonUniformSample,
+    `dgl_graph.cc:490`)."""
+    a = _k5_graph()
+    prob = mx.nd.array(np.array([0.0, 1.0, 1.0, 1.0, 1.0], np.float32))
+    seed = mx.nd.array(np.array([1], np.int64), dtype="int64")
+    mx.random.seed(1)
+    verts, sub, probs, layer = \
+        mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+            a, prob, seed, num_args=3, num_hops=1, num_neighbor=4,
+            max_num_vertices=5)
+    vn = verts.asnumpy()
+    count = int(vn[-1])
+    # vertex 1's candidates with p>0 are {2, 3, 4} — all kept
+    assert set(vn[:count].tolist()) == {1, 2, 3, 4}
+
+
+def test_edge_id_large_ids_exact():
+    """Edge ids above 2^24 must survive exactly: the output dtype follows
+    the stored integer dtype (reference EdgeIDType, `dgl_graph.cc:1197`) —
+    a float32 output would silently corrupt them. Ids here stay within
+    int32 because the framework's documented dtype policy maps int64 to
+    int32 unless jax x64 is enabled (`mxnet_tpu/base.py:105`, the
+    large-tensor-build rendering)."""
+    big = (np.int64(1) << 30) + 3       # > 2^24: not float32-representable
+    data = np.array([big, big + 1], np.int64)
+    indices = np.array([1, 0], np.int64)
+    indptr = np.array([0, 1, 2], np.int64)
+    a = mx.nd.sparse.csr_matrix((data, indices, indptr), shape=(2, 2))
+    u = mx.nd.array(np.array([0, 1, 0], np.int64), dtype="int64")
+    v = mx.nd.array(np.array([1, 0, 0], np.int64), dtype="int64")
+    out = mx.nd.contrib.edge_id(a, u, v).asnumpy()
+    assert np.issubdtype(out.dtype, np.integer)
+    np.testing.assert_array_equal(out, [big, big + 1, -1])
+
+
+def test_dgl_graph_compact():
+    a = _k5_graph()
+    seed = mx.nd.array(np.array([0, 1, 2, 3, 4], np.int64), dtype="int64")
+    mx.random.seed(5)
+    verts, sub, _ = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=8)
+    count = int(verts.asnumpy()[-1])
+    comp = mx.nd.contrib.dgl_graph_compact(sub, graph_sizes=[count])
+    assert comp.shape == (count, count)
+
+
+def test_getnnz():
+    a = _k5_graph()
+    assert mx.nd.contrib.getnnz(a).asnumpy()[0] == 20
+    per_col = mx.nd.contrib.getnnz(a, axis=0).asnumpy()
+    np.testing.assert_array_equal(per_col, [4, 4, 4, 4, 4])
+
+
+# ---------------------------------------------------------------------------
+# cv* codec ops
+# ---------------------------------------------------------------------------
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    b = io.BytesIO()
+    Image.fromarray(arr).save(b, "PNG")
+    return b.getvalue()
+
+
+def test_cvimdecode_roundtrip():
+    rng = np.random.RandomState(0)
+    img = (rng.rand(8, 6, 3) * 255).astype(np.uint8)
+    buf = mx.nd.array(np.frombuffer(_png_bytes(img), np.uint8), dtype="uint8")
+    out = invoke_nd("_cvimdecode", buf).asnumpy()
+    np.testing.assert_array_equal(out, img)      # PNG is lossless
+    bgr = invoke_nd("_cvimdecode", buf, to_rgb=False).asnumpy()
+    np.testing.assert_array_equal(bgr, img[:, :, ::-1])
+    gray = invoke_nd("_cvimdecode", buf, flag=0).asnumpy()
+    assert gray.shape == (8, 6, 1)
+
+
+def test_cvimread(tmp_path):
+    rng = np.random.RandomState(1)
+    img = (rng.rand(5, 7, 3) * 255).astype(np.uint8)
+    p = tmp_path / "x.png"
+    p.write_bytes(_png_bytes(img))
+    out = invoke_nd("_cvimread", filename=str(p)).asnumpy()
+    np.testing.assert_array_equal(out, img)
+
+
+def test_cvimresize_and_border():
+    rng = np.random.RandomState(2)
+    img = mx.nd.array((rng.rand(8, 6, 3) * 255).astype(np.uint8),
+                      dtype="uint8")
+    out = invoke_nd("_cvimresize", img, w=3, h=4)
+    assert out.shape == (4, 3, 3)
+    pad = invoke_nd("_cvcopyMakeBorder", img, top=1, bot=2, left=3, right=4,
+                    value=0)
+    assert pad.shape == (11, 13, 3)
+    pn = pad.asnumpy()
+    assert (pn[0] == 0).all() and (pn[:, :3] == 0).all()
+    np.testing.assert_array_equal(pn[1:9, 3:9], img.asnumpy())
+    rep = invoke_nd("_cvcopyMakeBorder", img, top=1, bot=0, left=0, right=0,
+                    type=1).asnumpy()
+    np.testing.assert_array_equal(rep[0], img.asnumpy()[0])
+
+
+# ---------------------------------------------------------------------------
+# sparse embedding, gradientmultiplier, NB samplers, recorded setitem
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_embedding_row_sparse_grad():
+    table = mx.nd.array(np.random.RandomState(3).rand(10, 4)
+                        .astype(np.float32))
+    table.attach_grad(stype="row_sparse")
+    idx = mx.nd.array(np.array([1, 3, 3], np.float32))
+    with autograd.record():
+        out = invoke_nd("_contrib_SparseEmbedding", idx, table,
+                        input_dim=10, output_dim=4)
+        loss = out.sum()
+    loss.backward()
+    g = table.grad
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    assert isinstance(g, RowSparseNDArray)
+    assert set(g.indices.asnumpy().tolist()) == {1, 3}
+    dense = g.tostype("default").asnumpy()
+    np.testing.assert_allclose(dense[1], np.ones(4))
+    np.testing.assert_allclose(dense[3], 2 * np.ones(4))
+
+
+def test_gradientmultiplier_backward():
+    x = mx.nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = invoke_nd("_contrib_gradientmultiplier", x, scalar=-0.5)
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [-0.5, -0.5, -0.5])
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())  # identity forward
+
+
+def test_sample_negative_binomial_moments():
+    mx.random.seed(9)
+    k = mx.nd.array(np.array([5.0, 20.0], np.float32))
+    p = mx.nd.array(np.array([0.5, 0.5], np.float32))
+    out = invoke_nd("_sample_negative_binomial", k, p,
+                    shape=(4000,)).asnumpy()
+    assert out.shape == (2, 4000)
+    # NB(k, p): mean = k(1-p)/p
+    assert abs(out[0].mean() - 5.0) < 0.5
+    assert abs(out[1].mean() - 20.0) < 1.5
+    mu = mx.nd.array(np.array([2.0], np.float32))
+    alpha = mx.nd.array(np.array([0.5], np.float32))
+    g = invoke_nd("_sample_generalized_negative_binomial", mu, alpha,
+                  shape=(4000,)).asnumpy()
+    assert abs(g.mean() - 2.0) < 0.3
+    # var = mu + alpha*mu^2 = 4
+    assert abs(g.std() - 2.0) < 0.4
+
+
+def test_recorded_setitem_gradients():
+    """`nd[a:b] = v` inside record routes through `_slice_assign`
+    (`matrix_op.cc:477`) — grads flow around AND into the window."""
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    v = mx.nd.array(np.array([10.0, 20.0, 30.0], np.float32))
+    v.attach_grad()
+    with autograd.record():
+        y = x * 3
+        y[0] = v
+        loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy()[0], 0)
+    np.testing.assert_allclose(x.grad.asnumpy()[1], 18 * np.arange(3, 6))
+    np.testing.assert_allclose(v.grad.asnumpy(), 2 * np.array([10., 20., 30.]))
+
+
+def test_recorded_setitem_on_leaf():
+    """Writing a marked leaf: grad is wrt the PRE-write value (the leaf
+    the tape saw), zero inside the overwritten window."""
+    w = mx.nd.array(np.ones((3,), np.float32))
+    w.attach_grad()
+    with autograd.record():
+        w[1:] = 5.0
+        loss = (w * w).sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [2.0, 0.0, 0.0])
+
+
+def test_recorded_setitem_scalar_and_int_key():
+    x = mx.nd.array(np.zeros((2, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x + 1
+        y[1] = 0.0          # int key
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[1, 1], [0, 0]])
+    np.testing.assert_allclose(y.asnumpy(), [[1, 1], [0, 0]])
+
+
+def test_plain_setitem_outside_record_unchanged():
+    x = mx.nd.array(np.zeros((3,), np.float32))
+    x[1] = 4.0
+    np.testing.assert_allclose(x.asnumpy(), [0, 4, 0])
+    x[:] = 1.0
+    np.testing.assert_allclose(x.asnumpy(), [1, 1, 1])
+
+
+def test_adamw_skips_on_nonfinite_scale():
+    """`_adamw_update` (`contrib/adamw.cc:98`): rescale_grad rides as a
+    TENSOR and a NaN/Inf/0 value (overflowed dynamic loss scale) skips the
+    whole update — weight and states unchanged, no host sync."""
+    w0 = np.ones((2, 2), np.float32)
+    for bad in (np.nan, np.inf, 0.0):
+        w = mx.nd.array(w0.copy())
+        g = mx.nd.array(np.full((2, 2), 2.0, np.float32))
+        m = mx.nd.array(np.zeros((2, 2), np.float32))
+        v = mx.nd.array(np.zeros((2, 2), np.float32))
+        rs = mx.nd.array(np.array([bad], np.float32))
+        out = invoke_nd("_adamw_update", w, g, m, v, rs, lr=0.1)
+        np.testing.assert_array_equal(out.asnumpy(), w0)
+        np.testing.assert_array_equal(m.asnumpy(), 0)
+        np.testing.assert_array_equal(v.asnumpy(), 0)
+    # and a finite scale does update
+    w = mx.nd.array(w0.copy())
+    g = mx.nd.array(np.full((2, 2), 2.0, np.float32))
+    m = mx.nd.array(np.zeros((2, 2), np.float32))
+    v = mx.nd.array(np.zeros((2, 2), np.float32))
+    rs = mx.nd.array(np.array([1.0], np.float32))
+    out = invoke_nd("_adamw_update", w, g, m, v, rs, lr=0.1)
+    assert not np.allclose(out.asnumpy(), w0)
+    assert not np.allclose(m.asnumpy(), 0)
+
+
+def test_dgl_subgraph_dense_csr_parity_unsorted():
+    """Dense op and CSR frontend must assign identical new edge ids even
+    for UNSORTED vertex arrays (both walk parent columns in ascending
+    order, like the reference's indptr walk)."""
+    data = np.array([1, 2, 3, 4, 5, 6, 7], np.int64)
+    ind = np.array([1, 3, 0, 2, 1, 0, 2], np.int64)
+    ptr = np.array([0, 2, 4, 5, 7], np.int64)
+    a = mx.nd.sparse.csr_matrix((data, ind, ptr), shape=(4, 4))
+    vs = mx.nd.array(np.array([2, 0, 1], np.int64), dtype="int64")
+    new_csr, old_csr = mx.nd.contrib.dgl_subgraph(a, vs, return_mapping=True)
+    dense = mx.nd.array(a.tostype("default").asnumpy())
+    new_d, old_d = invoke_nd("_contrib_dgl_subgraph", dense, vs, num_args=2,
+                             return_mapping=True)
+    np.testing.assert_array_equal(new_csr.tostype("default").asnumpy(),
+                                  new_d.asnumpy())
+    np.testing.assert_array_equal(old_csr.tostype("default").asnumpy(),
+                                  old_d.asnumpy())
+
+
+def test_dgl_sample_more_seeds_than_budget():
+    """Seeds beyond max_num_vertices are dropped and the sub-graph never
+    references a vertex absent from the output list."""
+    a = _k5_graph()
+    seed = mx.nd.array(np.array([0, 1, 2, 3, 4], np.int64), dtype="int64")
+    mx.random.seed(2)
+    verts, sub, layer = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=3)
+    vn = verts.asnumpy()
+    count = int(vn[-1])
+    assert count <= 3
+    kept = set(vn[:count].tolist())
+    cols = sub.indices.asnumpy().tolist()
+    assert set(cols) <= kept, (cols, kept)
