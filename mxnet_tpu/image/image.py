@@ -61,14 +61,44 @@ def imread(filename, flag=1, to_rgb=True):
         return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
 
 
+def _bilinear_resize_np(arr, h, w):
+    """Align-corners sample bilinear on uint8 HWC — the SAME arithmetic as
+    the native decode workers (`src/imgpipe.cc` resize_bilinear), so
+    interp=1 output is identical whether or not the .so is built."""
+    sh, sw = arr.shape[:2]
+    if (sh, sw) == (h, w):
+        return arr.copy()
+    ry = (sh - 1) / (h - 1) if h > 1 else 0.0
+    rx = (sw - 1) / (w - 1) if w > 1 else 0.0
+    fy = _np.arange(h, dtype=_np.float32) * _np.float32(ry)
+    fx = _np.arange(w, dtype=_np.float32) * _np.float32(rx)
+    y0 = fy.astype(_np.int32)
+    x0 = fx.astype(_np.int32)
+    y1 = _np.minimum(y0 + 1, sh - 1)
+    x1 = _np.minimum(x0 + 1, sw - 1)
+    wy = (fy - y0)[:, None, None].astype(_np.float32)
+    wx = (fx - x0)[None, :, None].astype(_np.float32)
+    a = arr.astype(_np.float32)
+    top = a[y0][:, x0] + (a[y0][:, x1] - a[y0][:, x0]) * wx
+    bot = a[y1][:, x0] + (a[y1][:, x1] - a[y1][:, x0]) * wx
+    return (top + (bot - top) * wy + 0.5).astype(arr.dtype)
+
+
 def imresize(src, w, h, interp=1):
-    """Resize an HWC image NDArray with PIL (reference imresize)."""
+    """Resize an HWC image NDArray (reference imresize over cv2).
+
+    interp=1 (INTER_LINEAR) uses the repo's own bilinear — bit-identical
+    between the python chain and the native decode workers; other interp
+    codes map to PIL filters."""
     from PIL import Image
 
     arr = src.asnumpy() if isinstance(src, nd.NDArray) else _np.asarray(src)
+    if int(interp) == 1:
+        return nd.array(_bilinear_resize_np(arr.astype("uint8"), h, w)
+                        .astype(arr.dtype.name), dtype=arr.dtype.name)
     squeeze = arr.shape[-1] == 1
     img = Image.fromarray(arr[:, :, 0] if squeeze else arr.astype("uint8"))
-    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+    resample = {0: Image.NEAREST, 2: Image.BICUBIC,
                 3: Image.LANCZOS, 4: Image.LANCZOS}.get(interp, Image.BILINEAR)
     img = img.resize((w, h), resample)
     out = _np.asarray(img)
@@ -494,14 +524,48 @@ class ImageIter(DataIter):
             n_per = len(self.seq) // num_parts
             self.seq = self.seq[part_index * n_per:(part_index + 1) * n_per]
 
+        aug_kwargs = {k: v for k, v in kwargs.items()
+                      if k in ("resize", "rand_crop", "rand_resize",
+                               "rand_mirror", "mean", "std", "brightness",
+                               "contrast", "saturation", "hue", "pca_noise",
+                               "rand_gray", "inter_method")}
         if aug_list is None:
-            self.auglist = CreateAugmenter(data_shape, **{
-                k: v for k, v in kwargs.items()
-                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
-                         "mean", "std", "brightness", "contrast", "saturation",
-                         "hue", "pca_noise", "rand_gray", "inter_method")})
+            self.auglist = CreateAugmenter(data_shape, **aug_kwargs)
         else:
             self.auglist = aug_list
+        # native decode workers (src/imgpipe.cc; reference
+        # iter_image_recordio_2.cc:873): taken when the augmenter chain is
+        # exactly the standard resize/crop/mirror/normalize set this C++
+        # path implements — any exotic augmenter keeps the python chain
+        self._native_cfg = None
+        # the C++ resize is bilinear (INTER_LINEAR): when a resize happens
+        # the native path is taken only for inter_method=1, so pixels never
+        # silently depend on whether the .so is built (python's default is
+        # inter_method=2, bicubic)
+        interp_ok = (not aug_kwargs.get("resize")) or \
+            int(aug_kwargs.get("inter_method", 2)) == 1
+        if aug_list is None and tuple(data_shape)[0] == 3 and interp_ok and \
+                not any(aug_kwargs.get(k) for k in
+                        ("rand_resize", "brightness", "contrast",
+                         "saturation", "hue", "pca_noise", "rand_gray")):
+            from .. import lib as _lib
+
+            pipe = _lib.native_imgpipe(self._num_threads)
+            if pipe is not None:
+                mean = aug_kwargs.get("mean")
+                std = aug_kwargs.get("std")
+                if mean is True:
+                    mean = _np.array([123.68, 116.28, 103.53])
+                if std is True:
+                    std = _np.array([58.395, 57.12, 57.375])
+                self._native_cfg = {
+                    "pipe": pipe,
+                    "resize": int(aug_kwargs.get("resize", 0) or 0),
+                    "rand_crop": bool(aug_kwargs.get("rand_crop", False)),
+                    "rand_mirror": bool(aug_kwargs.get("rand_mirror", False)),
+                    "mean": mean if mean is not None else None,
+                    "std": std if std is not None else None,
+                }
 
         self.provide_data = [DataDesc(data_name, (batch_size,) + self.data_shape)]
         self.provide_label = [DataDesc(label_name, (batch_size, label_width)
@@ -539,6 +603,31 @@ class ImageIter(DataIter):
         header, img = recordio.unpack(s)
         return header.label, img
 
+    def _decode_batch_native(self, samples):
+        """One GIL-free C call decodes+augments the whole batch
+        (`src/imgpipe.cc`); None -> fall back to the python chain (e.g. a
+        record that is not a JPEG)."""
+        raws = []
+        for _, raw in samples:
+            if not isinstance(raw, (bytes, bytearray)) or \
+                    not bytes(raw[:2]) == b"\xff\xd8":
+                return None  # not a JPEG: python path handles it
+            raws.append(bytes(raw))
+        cfg = self._native_cfg
+        from .. import random as _rand
+
+        out, failed = cfg["pipe"].decode_batch(
+            raws, self.data_shape[1], self.data_shape[2],
+            resize_short=cfg["resize"], rand_crop=cfg["rand_crop"],
+            rand_mirror=cfg["rand_mirror"], seed=_rand.derive_host_seed(),
+            mean=cfg["mean"], std=cfg["std"], nthreads=self._num_threads)
+        if out is None:
+            return None
+        for i in failed:  # re-decode ONLY the natively-undecodable records
+            _, arr = self._decode_augment(*samples[i])
+            out[i] = arr
+        return [(label, arr) for (label, _), arr in zip(samples, out)]
+
     def _decode_augment(self, label, raw):
         img = imdecode(raw)
         for aug in self.auglist:
@@ -563,13 +652,17 @@ class ImageIter(DataIter):
                 raise StopIteration
             pad = self.batch_size - len(samples)
 
-        if self._num_threads > 1 and len(samples) > 1:
-            if not hasattr(self, "_pool"):
-                self._pool = ThreadPoolExecutor(self._num_threads)
-            decoded = list(self._pool.map(
-                lambda s: self._decode_augment(*s), samples))
-        else:
-            decoded = [self._decode_augment(*s) for s in samples]
+        decoded = None
+        if self._native_cfg is not None:
+            decoded = self._decode_batch_native(samples)
+        if decoded is None:
+            if self._num_threads > 1 and len(samples) > 1:
+                if not hasattr(self, "_pool"):
+                    self._pool = ThreadPoolExecutor(self._num_threads)
+                decoded = list(self._pool.map(
+                    lambda s: self._decode_augment(*s), samples))
+            else:
+                decoded = [self._decode_augment(*s) for s in samples]
 
         while len(decoded) < self.batch_size:  # pad by repeating the first
             decoded.append(decoded[0])

@@ -118,3 +118,35 @@ def shared_memory(name, size=None, create=False):
     from .native_engine import SharedMemoryArena
 
     return SharedMemoryArena(lib, name, size=size, create=create)
+
+
+_imgpipe = None
+
+
+def native_imgpipe(num_threads=4):
+    """Native JPEG decode+augment pipe; None when the .so (or its libjpeg
+    support) is absent."""
+    global _imgpipe
+    lib = get_lib()
+    if lib is None:
+        return None
+    with _lock:
+        if _imgpipe is None:
+            from .native_engine import NativeImagePipe
+
+            try:
+                _imgpipe = NativeImagePipe(lib, num_threads=num_threads)
+            except OSError:
+                _imgpipe = False
+    return _imgpipe or None
+
+
+def shm_unlink(name):
+    """Unlink a named shm segment without attaching (cleanup of segments
+    whose content will never be read — abandoned DataLoader batches)."""
+    lib = get_lib()
+    if lib is None:
+        return
+    from .native_engine import _bind
+
+    _bind(lib).rt_shm_unlink(name.encode())

@@ -212,3 +212,60 @@ class SharedMemoryArena:
             self.detach()
         except Exception:
             pass
+
+
+class NativeImagePipe:
+    """Batch JPEG decode+augment workers (`src/imgpipe.cc`; reference
+    `iter_image_recordio_2.cc:873` decode threads): one GIL-free C call
+    decodes a whole batch to CHW float32 with shorter-side resize,
+    random/center crop, mirror and mean/std normalize."""
+
+    def __init__(self, lib, num_threads=4):
+        self._lib = lib
+        fn = getattr(lib, "rt_imgpipe_decode_batch", None)
+        if fn is None:
+            raise OSError("librt_tpu.so built without libjpeg support")
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint8)]
+        self._fn = fn
+        self._nthreads = max(1, int(num_threads))
+
+    def decode_batch(self, buffers, out_h, out_w, resize_short=0,
+                     rand_crop=False, rand_mirror=False, seed=0,
+                     mean=None, std=None, nthreads=None):
+        """Decode a list of JPEG byte buffers -> ((n, 3, out_h, out_w)
+        float32, failed_indices). Images whose native decode failed
+        (corrupt/exotic JPEG) are listed in failed_indices and their out
+        rows are undefined — the caller re-decodes ONLY those in python.
+        Returns (None, None) on argument-level failure."""
+        n = len(buffers)
+        bufs = (ctypes.c_char_p * n)(*buffers)
+        lens = (ctypes.c_uint64 * n)(*[len(b) for b in buffers])
+        out = np.empty((n, 3, out_h, out_w), np.float32)
+        status = np.zeros((n,), np.uint8)
+
+        def f3(v):
+            if v is None:
+                return None
+            # scalars broadcast across channels, like ColorNormalizeAug
+            vals = np.broadcast_to(np.ravel(np.asarray(v, np.float64)), (3,))
+            return (ctypes.c_float * 3)(*[float(x) for x in vals])
+
+        m, s = f3(mean), f3(std)
+        rc = self._fn(
+            n, ctypes.cast(bufs, ctypes.POINTER(ctypes.c_char_p)), lens,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            int(out_h), int(out_w), int(resize_short), int(bool(rand_crop)),
+            int(bool(rand_mirror)), int(seed) & 0xFFFFFFFFFFFFFFFF,
+            m, s, int(nthreads or self._nthreads),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if rc < 0:
+            return None, None
+        failed = np.nonzero(status == 0)[0].tolist()
+        return out, failed

@@ -3,6 +3,7 @@
 // of decoded batches between DataLoader worker processes and the trainer.
 // Workers write into a named shm segment; the parent maps the same name.
 
+#include <cerrno>
 #include <cstdint>
 #include <cstring>
 
@@ -27,7 +28,11 @@ void* rt_shm_create(const char* name, uint64_t size) {
   ::shm_unlink(name);  // replace any stale segment
   int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
-  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+  // posix_fallocate actually reserves the pages: a full /dev/shm fails
+  // HERE (caller falls back to pickle) instead of SIGBUS-ing the worker
+  // mid-memcpy the way a sparse ftruncate mapping would.
+  int rc = ::posix_fallocate(fd, 0, static_cast<off_t>(size));
+  if (rc != 0 && (rc == ENOSPC || ftruncate(fd, static_cast<off_t>(size)) != 0)) {
     ::close(fd);
     ::shm_unlink(name);
     return nullptr;

@@ -230,3 +230,154 @@ def test_detection_augmenters_and_flip_boxes():
     for a in augs:
         out, l2 = a(out, l2)
     assert out.shape == (24, 24, 3)
+
+
+# --------------------------------------------------------------------------
+# native decode workers (src/imgpipe.cc — reference
+# iter_image_recordio_2.cc:873 decode threads)
+# --------------------------------------------------------------------------
+
+from mxnet_tpu import lib as _lib
+
+native_jpeg = pytest.mark.skipif(_lib.native_imgpipe() is None,
+                                 reason="imgpipe not built (no libjpeg)")
+
+
+@native_jpeg
+def test_imageiter_native_path_taken_and_matches():
+    """Same-size records + center crop: the native batch decode must equal
+    the python PIL chain bit-for-bit (both are libjpeg underneath)."""
+    with tempfile.TemporaryDirectory() as d:
+        rec = _write_rec(d, n=8)
+        it_native = img.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                                  path_imgrec=rec)
+        assert it_native._native_cfg is not None, \
+            "standard augment config must take the native path"
+        b_native = it_native.next().data[0].asnumpy()
+
+        it_py = img.ImageIter(batch_size=8, data_shape=(3, 32, 32),
+                              path_imgrec=rec)
+        it_py._native_cfg = None  # force the python chain
+        b_py = it_py.next().data[0].asnumpy()
+        np.testing.assert_array_equal(b_native, b_py)
+
+
+@native_jpeg
+def test_imageiter_native_resize_crop_mirror_normalize():
+    with tempfile.TemporaryDirectory() as d:
+        rec_path = os.path.join(d, "data.rec")
+        idx_path = os.path.join(d, "data.idx")
+        record = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+        for i in range(8):
+            raw = _jpeg_bytes(48 + i, 40, (i * 20 % 255, 80, 120))
+            record.write_idx(i, recordio.pack(
+                recordio.IRHeader(0, float(i % 2), i, 0), raw))
+        record.close()
+        it = img.ImageIter(batch_size=8, data_shape=(3, 28, 28),
+                           path_imgrec=rec_path, resize=32, rand_crop=True,
+                           rand_mirror=True, mean=True, std=True,
+                           inter_method=1)
+        assert it._native_cfg is not None
+        b = it.next().data[0].asnumpy()
+        assert b.shape == (8, 3, 28, 28)
+        # normalized output: roughly zero-centered, not raw 0..255
+        assert abs(b.mean()) < 3 and b.min() < 0
+
+
+@native_jpeg
+def test_imageiter_exotic_augment_falls_back():
+    with tempfile.TemporaryDirectory() as d:
+        rec = _write_rec(d, n=4)
+        it = img.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                           path_imgrec=rec, brightness=0.3)
+        assert it._native_cfg is None  # python chain handles color jitter
+        assert it.next().data[0].shape == (4, 3, 28, 28)
+
+
+@native_jpeg
+def test_native_decode_throughput():
+    """Verdict #7 done-criterion: native decode workers >=2x the python
+    thread pool on a synthetic record file."""
+    import time
+
+    from PIL import Image
+    import io as _io
+
+    rng = np.random.RandomState(0)
+    bufs = []
+    for i in range(64):
+        # ImageNet-like source sizes: the resize-short step actually runs
+        arr = (rng.rand(300, 340, 3) * 255).astype(np.uint8)
+        b = _io.BytesIO()
+        Image.fromarray(arr).save(b, "JPEG", quality=90)
+        bufs.append(b.getvalue())
+    samples = [(float(i), raw) for i, raw in enumerate(bufs)]
+
+    it = img.ImageIter(batch_size=4, data_shape=(3, 224, 224),
+                       path_imgrec=None, imglist=[(0.0, "x")], path_root=".",
+                       resize=256, rand_crop=True, inter_method=1)
+    # drive the two decode paths directly on identical samples
+    assert it._native_cfg is not None
+
+    def run_native():
+        return it._decode_batch_native(samples)
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(4)
+
+    def run_python():
+        return list(pool.map(lambda s: it._decode_augment(*s), samples))
+
+    run_native(); run_python()  # warm
+    t0 = time.perf_counter(); run_native(); t_nat = time.perf_counter() - t0
+    t0 = time.perf_counter(); run_python(); t_py = time.perf_counter() - t0
+    print(f"\nnative decode {t_nat*1e3:.0f} ms vs python pool "
+          f"{t_py*1e3:.0f} ms for 64x 300px JPEGs")
+    assert t_nat * 2 <= t_py, (t_nat, t_py)
+
+
+@native_jpeg
+def test_imageiter_bicubic_resize_stays_python():
+    """Default inter_method=2 (bicubic) has no native kernel: a resizing
+    config must keep the python chain so pixels don't depend on whether
+    the .so is built."""
+    with tempfile.TemporaryDirectory() as d:
+        rec = _write_rec(d, n=4)
+        it = img.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                           path_imgrec=rec, resize=32)
+        assert it._native_cfg is None
+        it2 = img.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                            path_imgrec=rec, resize=32, inter_method=1)
+        assert it2._native_cfg is not None
+
+
+@native_jpeg
+def test_imageiter_native_resize_matches_python():
+    """WITH a resize (inter_method=1): native and python paths share the
+    same align-corners bilinear arithmetic (imresize interp=1 vs
+    src/imgpipe.cc resize_bilinear) — output must be bit-identical."""
+    with tempfile.TemporaryDirectory() as d:
+        rec_path = os.path.join(d, "data.rec")
+        idx_path = os.path.join(d, "data.idx")
+        record = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+        rng = np.random.RandomState(5)
+        for i in range(6):
+            from PIL import Image
+            import io as _io
+
+            arr = (rng.rand(45 + i, 37, 3) * 255).astype(np.uint8)
+            b = _io.BytesIO()
+            Image.fromarray(arr).save(b, "JPEG", quality=92)
+            record.write_idx(i, recordio.pack(
+                recordio.IRHeader(0, float(i), i, 0), b.getvalue()))
+        record.close()
+        kw = dict(batch_size=6, data_shape=(3, 24, 24),
+                  path_imgrec=rec_path, resize=28, inter_method=1)
+        it_native = img.ImageIter(**kw)
+        assert it_native._native_cfg is not None
+        b_native = it_native.next().data[0].asnumpy()
+        it_py = img.ImageIter(**kw)
+        it_py._native_cfg = None
+        b_py = it_py.next().data[0].asnumpy()
+        np.testing.assert_array_equal(b_native, b_py)
