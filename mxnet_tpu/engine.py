@@ -7,21 +7,50 @@ TPU-native redesign (SURVEY.md §7): **on-device** ordering/fusion is the
 compiled XLA program — jax dispatches asynchronously and XLA's runtime owns
 device streams, so the reference's dependency-variable scheduler is not
 re-implemented for compute. What remains host-side is ordering of IO,
-checkpoint and collective-issue work; that engine lives in the native C++
-runtime (``src/engine.cc`` via :mod:`mxnet_tpu.lib`) with this module
-exposing the reference's Python surface (bulk, engine-type query).
+checkpoint and prefetch work; that engine lives in the native C++ runtime
+(``src/engine.cc`` via :mod:`mxnet_tpu.lib`) and THIS module is its
+production frontend: `nd.save` / `save_checkpoint` push file writes here
+with per-path write-var ordering (reference Engine::PushAsync with a
+mutable var per resource, `src/engine/threaded_engine.cc`), and
+`io.PrefetchingIter` pushes batch fetches with a per-iterator var.
 """
 from __future__ import annotations
 
+import atexit
 import contextlib
+import threading
 
 from .base import getenv
 
-__all__ = ["bulk", "engine_type", "push", "wait_all"]
+__all__ = ["bulk", "engine_type", "push", "push_io", "wait_all", "path_var"]
+
+_io_state = threading.local()
+_path_vars = {}
+_var_pool = []
+# epoch-numbered checkpoints create unbounded distinct paths; past this
+# many live path→var entries the engine is drained and every var recycled
+# (safe: after wait_all no write is in flight, so remapping a var to a new
+# path cannot reorder anything)
+_PATH_VAR_CAP = 512
+_path_lock = threading.Lock()
+# exceptions raised by async-pushed fns; re-raised at the next wait_all()
+# so failures are not silently swallowed (the reference engine aborts the
+# process on an op error — here the error surfaces at the sync point)
+_async_error = []
 
 
 def engine_type():
     return getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+def async_io_enabled():
+    """Async file IO through the native engine is ON by default when the
+    native library is built; `MXNET_ENGINE_ASYNC_IO=0` forces synchronous
+    writes (documented in docs/faq/env_var.md)."""
+    from . import lib
+
+    return getenv("MXNET_ENGINE_ASYNC_IO", "1") == "1" and \
+        lib.native_engine() is not None
 
 
 @contextlib.contextmanager
@@ -31,16 +60,52 @@ def bulk(size):
     yield
 
 
-def push(fn, *args, **kwargs):
-    """Push host-side async work onto the native engine (falls back to inline
-    execution when the native library is unavailable)."""
+def path_var(path):
+    """The per-path write variable: pushes naming the same path serialize
+    (reference: one engine var per output resource)."""
+    from . import lib
+
+    eng = lib.native_engine()
+    if eng is None:
+        return None
+    with _path_lock:
+        v = _path_vars.get(path)
+        if v is None:
+            if len(_path_vars) >= _PATH_VAR_CAP:
+                eng.wait_all()
+                _var_pool.extend(_path_vars.values())
+                _path_vars.clear()
+            v = _path_vars[path] = (_var_pool.pop() if _var_pool
+                                    else eng.new_var())
+    return v
+
+
+def _guarded(fn):
+    def run(*a, **kw):
+        try:
+            fn(*a, **kw)
+        except Exception as e:  # KeyboardInterrupt/SystemExit propagate
+            _async_error.append(e)
+
+    return run
+
+
+def push(fn, *args, const_vars=(), mutable_vars=(), **kwargs):
+    """Push host-side async work onto the native engine (falls back to
+    inline execution when the native library is unavailable)."""
     from . import lib
 
     eng = lib.native_engine()
     if eng is not None:
-        return eng.push(fn, args, kwargs)
+        return eng.push(_guarded(fn), args, kwargs,
+                        const_vars=const_vars, mutable_vars=mutable_vars)
     fn(*args, **kwargs)
     return None
+
+
+def push_io(path, fn, *args, **kwargs):
+    """Push a file write ordered against other writes to `path`."""
+    return push(fn, *args, mutable_vars=(path_var(path),), **kwargs)
 
 
 def wait_all():
@@ -51,3 +116,22 @@ def wait_all():
     if eng is not None:
         eng.wait_all()
     waitall()
+    if _async_error:
+        errs = list(_async_error)
+        _async_error.clear()
+        if len(errs) == 1:
+            raise errs[0]
+        raise ExceptionGroup("async engine IO failures", errs)
+
+
+@atexit.register
+def _flush_at_exit():
+    """Pending async checkpoint writes must land before the process dies."""
+    from . import lib
+
+    eng = lib._engine  # do not CREATE an engine at exit
+    if eng is not None:
+        try:
+            eng.wait_all()
+        except Exception:
+            pass

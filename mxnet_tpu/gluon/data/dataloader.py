@@ -9,14 +9,22 @@ ships NDArrays through POSIX shared memory (`cpu_shared_storage_manager.h`,
 Here workers run in a thread pool by default: batch assembly is
 numpy-bound (releases the GIL) and the device transfer happens once per
 batch on the main thread via a single `jax.device_put` — the host→HBM DMA
-queue replaces the reference's shm+pickle relay. `num_workers>0` uses a
-`multiprocessing.Pool` with numpy (picklable) batches when
-`thread_pool=False`.
+queue replaces the reference's shm+pickle relay.
+
+`num_workers>0, thread_pool=False` uses a `multiprocessing.Pool`; when the
+native runtime is built, worker→parent batches travel through the
+`SharedMemoryArena` (`src/arena.cc`, the CPUSharedStorageManager role):
+the worker writes the assembled numpy batch into a named POSIX shm
+segment and returns only metadata; the parent maps the segment zero-copy
+and feeds `jax.device_put` straight from it — no multi-MB pickle through
+the pool pipe. Pickle remains the fallback when the .so is absent or shm
+creation fails.
 """
 from __future__ import annotations
 
 import multiprocessing
 import multiprocessing.pool
+import os
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -55,6 +63,91 @@ class _WorkerFn:
 
     def __call__(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
+
+
+def _flatten_batch(batch):
+    """Flatten a (possibly nested list) numpy batch into (leaves, treespec);
+    treespec is 'a' for an array or a list of specs."""
+    if isinstance(batch, (list, tuple)):
+        leaves, spec = [], []
+        for b in batch:
+            sub_leaves, sub_spec = _flatten_batch(b)
+            leaves.extend(sub_leaves)
+            spec.append(sub_spec)
+        return leaves, spec
+    return [np.ascontiguousarray(batch)], "a"
+
+
+def _unflatten_batch(leaves, spec, cursor=None):
+    cursor = cursor if cursor is not None else [0]
+    if spec == "a":
+        out = leaves[cursor[0]]
+        cursor[0] += 1
+        return out
+    return [_unflatten_batch(leaves, s, cursor) for s in spec]
+
+
+class _ShmWorkerFn:
+    """Worker fn shipping batches through the SharedMemoryArena
+    (`src/arena.cc`; reference `cpu_shared_storage_manager.h` +
+    `dataloader.py:55` rebuild_ndarray): writes the assembled batch into a
+    named shm segment, returns (segment_name, per-leaf metadata, treespec)
+    — a few hundred bytes through the pool pipe instead of the batch."""
+
+    def __init__(self, dataset, batchify_fn, tag):
+        self._dataset = dataset
+        self._batchify_fn = batchify_fn
+        self._tag = tag
+
+    def __call__(self, job):
+        slot, indices = job
+        batch = self._batchify_fn([self._dataset[i] for i in indices])
+        leaves, spec = _flatten_batch(batch)
+        metas, total = [], 0
+        for leaf in leaves:
+            off = total
+            total += leaf.nbytes
+            metas.append((leaf.shape, leaf.dtype.str, off))
+        from ... import lib
+
+        name = f"/mxtpu_dl_{self._tag}_{os.getpid()}_{slot}"
+        try:
+            seg = lib.shared_memory(name, size=max(total, 1), create=True)
+        except OSError:
+            seg = None  # e.g. /dev/shm full (arena.cc reserves pages up
+            #             front, so exhaustion fails here, not as SIGBUS)
+        if seg is None:  # .so missing or shm unavailable: pickle fallback
+            return ("pickle", leaves, spec)
+        mv = memoryview(seg.asarray())  # uint8 view over the segment
+        for leaf, (_, _, off) in zip(leaves, metas):
+            dst = np.ndarray(leaf.shape, leaf.dtype, buffer=mv, offset=off)
+            np.copyto(dst, leaf)  # ONE memcpy into the mapped segment
+        seg.detach()
+        return ("shm", name, metas, spec)
+
+
+def _read_shm_batch(msg):
+    """Parent side: map the worker's segment, copy out per-leaf arrays
+    (the device_put is the real consumer), then unlink the segment."""
+    from ... import lib
+
+    if msg[0] == "pickle":
+        _, leaves, spec = msg
+        return _unflatten_batch(leaves, spec)
+    _, name, metas, spec = msg
+    seg = lib.shared_memory(name, create=False)
+    if seg is None:
+        raise OSError(f"DataLoader: cannot attach shm segment {name}")
+    try:
+        mv = memoryview(seg.asarray())
+        leaves = []
+        for shape, dtype, off in metas:
+            src = np.ndarray(shape, np.dtype(dtype), buffer=mv, offset=off)
+            leaves.append(src.copy())  # ONE memcpy out of the segment
+    finally:
+        seg.unlink()
+        seg.detach()
+    return _unflatten_batch(leaves, spec)
 
 
 def _to_nd(batch, pin_memory=False):
@@ -122,15 +215,23 @@ class _MultiWorkerIter:
 
     def __init__(self, loader):
         self._loader = loader
+        self._shm = False
+        self._slot = 0
         bf = loader._batchify_fn
         if loader._thread_pool:
             self._pool = ThreadPoolExecutor(max_workers=loader._num_workers)
             self._fn = _WorkerFn(loader._dataset, bf)
         else:
+            from ... import lib
+
             self._pool = multiprocessing.Pool(loader._num_workers)
-            self._fn = _WorkerFn(
-                loader._dataset,
-                _as_numpy_batchify if bf is default_batchify_fn else bf)
+            np_bf = _as_numpy_batchify if bf is default_batchify_fn else bf
+            if lib.native_available():
+                # batches ride the SharedMemoryArena, not the pool pipe
+                self._shm = True
+                self._fn = _ShmWorkerFn(loader._dataset, np_bf, id(self))
+            else:
+                self._fn = _WorkerFn(loader._dataset, np_bf)
         self._batch_iter = iter(loader._batch_sampler)
         self._pending = []
         self._exhausted = False
@@ -144,6 +245,10 @@ class _MultiWorkerIter:
             return
         if isinstance(self._pool, ThreadPoolExecutor):
             self._pending.append(self._pool.submit(self._fn, indices))
+        elif self._shm:
+            self._slot += 1
+            self._pending.append(
+                self._pool.apply_async(self._fn, ((self._slot, indices),)))
         else:
             self._pending.append(self._pool.apply_async(self._fn, (indices,)))
 
@@ -154,13 +259,34 @@ class _MultiWorkerIter:
         fut = self._pending.pop(0)
         self._push_next()
         batch = fut.result() if hasattr(fut, "result") else fut.get()
+        if self._shm:
+            batch = _read_shm_batch(batch)
         return _to_nd(batch, self._loader._pin_memory)
 
     def __iter__(self):
         return self
 
     def _shutdown(self):
+        if self._shm and self._pending:
+            # drain in-flight batches and unlink their segments — an
+            # abandoned epoch must not leak named /dev/shm files
+            from ... import lib
+
+            for fut in self._pending:
+                try:
+                    msg = fut.get(timeout=10)
+                except Exception:  # noqa: BLE001 — worker already gone
+                    continue
+                if isinstance(msg, tuple) and msg and msg[0] == "shm":
+                    lib.shm_unlink(msg[1])
+            self._pending = []
         if isinstance(self._pool, ThreadPoolExecutor):
             self._pool.shutdown(wait=False)
         else:
             self._pool.terminate()
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:  # noqa: BLE001
+            pass

@@ -264,8 +264,15 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Background-thread prefetch over one or more iterators (the role of
-    the reference's `PrefetcherIter`, `src/io/iter_prefetcher.h`)."""
+    """Background prefetch over one or more iterators (the role of the
+    reference's `PrefetcherIter`, `src/io/iter_prefetcher.h`).
+
+    When the native runtime is built, batch fetches are PUSHED onto the
+    native dependency engine (`src/engine.cc`) with one mutable var per
+    prefetcher — fetches serialize in push order on an engine worker
+    thread while the trainer consumes from the queue, exactly the
+    reference's engine-scheduled IO pattern. Python-thread fallback
+    otherwise."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth=2):
@@ -278,6 +285,11 @@ class PrefetchingIter(DataIter):
         self._depth = prefetch_depth
         self._queue = None
         self._thread = None
+        from .. import lib
+
+        self._engine = lib.native_engine()
+        self._var = self._engine.new_var() if self._engine is not None else None
+        self._epoch = 0
         self._start()
 
     @property
@@ -296,49 +308,88 @@ class PrefetchingIter(DataIter):
                      for d in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
+    def _fetch_one(self):
+        """Fetch one combined batch (runs on an engine worker or the
+        fallback thread); returns DataBatch | None (end) | Exception."""
+        try:
+            batches = []
+            try:
+                for it in self.iters:
+                    batches.append(it.next())
+            except StopIteration:
+                return None
+            data = sum([b.data for b in batches], [])
+            label = sum([(b.label or []) for b in batches], [])
+            return DataBatch(data=data, label=label, pad=batches[0].pad,
+                             index=batches[0].index)
+        except Exception as e:  # surface worker errors to the consumer
+            return e
+
+    def _push_fetch(self):
+        """One engine-scheduled fetch; the per-prefetcher var orders it
+        after every previously pushed fetch."""
+        from .. import engine
+
+        epoch = self._epoch
+        q = self._queue
+
+        def task():
+            if epoch != self._epoch:
+                return  # stale push from before a reset
+            q.put(self._fetch_one())
+
+        engine.push(task, mutable_vars=(self._var,))
+
     def _start(self):
-        self._queue = _queue.Queue(maxsize=self._depth)
+        self._queue = _queue.Queue(maxsize=max(1, self._depth))
         self._stop = threading.Event()
+        if self._engine is not None:
+            self._thread = None
+            self._done = False
+            for _ in range(max(1, self._depth)):
+                self._push_fetch()
+            return
 
         def worker():
-            try:
-                while not self._stop.is_set():
-                    batches = []
-                    try:
-                        for it in self.iters:
-                            batches.append(it.next())
-                    except StopIteration:
-                        self._queue.put(None)
-                        return
-                    data = sum([b.data for b in batches], [])
-                    label = sum([(b.label or []) for b in batches], [])
-                    self._queue.put(DataBatch(data=data, label=label,
-                                              pad=batches[0].pad,
-                                              index=batches[0].index))
-            except Exception as e:  # surface worker errors to the consumer
-                self._queue.put(e)
+            while not self._stop.is_set():
+                item = self._fetch_one()
+                self._queue.put(item)
+                if item is None or isinstance(item, Exception):
+                    return
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 
     def reset(self):
         self._stop.set()
+        self._epoch += 1  # stale engine pushes become no-ops
+        if self._engine is not None:
+            from .. import engine
+
+            engine.wait_all()  # drain in-flight fetches before reusing iters
         try:
             while True:
                 self._queue.get_nowait()
         except _queue.Empty:
             pass
-        self._thread.join(timeout=5)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
         for it in self.iters:
             it.reset()
         self._start()
 
     def next(self):
+        if self._engine is not None and self._done:
+            raise StopIteration
         item = self._queue.get()
         if item is None:
+            if self._engine is not None:
+                self._done = True
             raise StopIteration
         if isinstance(item, Exception):
             raise item
+        if self._engine is not None and not self._done:
+            self._push_fetch()  # keep the pipeline `depth` deep
         return item
 
     def iter_next(self):

@@ -48,7 +48,15 @@ def _read_array(f):
 
 
 def save(fname, data):
-    """Save NDArray / list / dict of NDArrays (parity `mx.nd.save`)."""
+    """Save NDArray / list / dict of NDArrays (parity `mx.nd.save`).
+
+    The device fetch (`asnumpy`) happens on the calling thread; the
+    serialization + disk write is PUSHED onto the native engine with a
+    write-var keyed on the path (reference: checkpoint writes ride
+    Engine::PushAsync with the output NDArray vars,
+    `src/engine/threaded_engine.cc`), so training does not stall on disk.
+    `load` and `engine.wait_all()` are the sync points; writes to the same
+    path stay ordered by the path var."""
     from .ndarray import NDArray
 
     if isinstance(data, NDArray):
@@ -59,6 +67,39 @@ def save(fname, data):
         names, arrays = [], list(data)
     else:
         raise MXNetError("save expects NDArray, list or dict of NDArrays")
+    # snapshot on the caller thread: the values written are the values at
+    # save() time even if the caller mutates the arrays right after
+    snaps = [a.asnumpy() if hasattr(a, "asnumpy") else _np.asarray(a)
+             for a in arrays]
+
+    from .. import engine
+
+    if engine.async_io_enabled():
+        # the file EXISTS when save() returns (callers legitimately check
+        # that, and a tmpdir may be torn down before the engine runs) —
+        # created WITHOUT truncating: overwriting an existing checkpoint
+        # must keep the old content readable until the atomic replace in
+        # _write_file lands (a crash before then loses only the new
+        # write, never both). nd.load / wait_all are the content sync
+        # points.
+        open(fname, "ab").close()
+        engine.push_io(fname, _write_file, fname, names, snaps)
+    else:
+        _write_file(fname, names, snaps)
+
+
+def _write_file(fname, names, arrays):
+    """Write to a temp file then atomically rename: an out-of-band reader
+    racing the async engine sees the empty placeholder or the complete
+    file, never torn content."""
+    import os
+
+    tmp = fname + ".tmp~"
+    _write_payload(tmp, names, arrays)
+    os.replace(tmp, fname)
+
+
+def _write_payload(fname, names, arrays):
     with open(fname, "wb") as f:
         f.write(struct.pack("<Q", _MAGIC))
         f.write(struct.pack("<Q", 0))  # reserved
@@ -73,7 +114,13 @@ def save(fname, data):
 
 
 def load(fname):
-    """Load arrays saved by :func:`save` (parity `mx.nd.load`)."""
+    """Load arrays saved by :func:`save` (parity `mx.nd.load`): waits for
+    any pending async writes first (the read side of the engine's
+    write-var ordering)."""
+    from .. import engine
+
+    if engine.async_io_enabled():
+        engine.wait_all()
     with open(fname, "rb") as f:
         (magic,) = struct.unpack("<Q", f.read(8))
         if magic != _MAGIC:

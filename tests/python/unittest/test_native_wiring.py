@@ -1,0 +1,261 @@
+"""The native runtime wired into PRODUCTION paths (round-5 verdict #2):
+
+* `nd.save` / checkpoints ride `engine.push_io` with per-path write vars
+  (`mxnet_tpu/ndarray/utils.py`, reference: checkpoint writes through
+  Engine::PushAsync, `src/engine/threaded_engine.cc`);
+* `DataLoader(num_workers>0, thread_pool=False)` ships batches through
+  the SharedMemoryArena (`src/arena.cc`, reference
+  `cpu_shared_storage_manager.h` + `gluon/data/dataloader.py:55`);
+* `io.PrefetchingIter` pushes fetches onto the engine with a
+  per-prefetcher var (reference `src/io/iter_prefetcher.h`).
+"""
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, lib, nd
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+native = pytest.mark.skipif(not lib.native_available(),
+                            reason="librt_tpu.so not built")
+
+
+# ---------------------------------------------------------------------------
+# async checkpoint writes
+# ---------------------------------------------------------------------------
+
+
+@native
+def test_async_save_is_engine_backed(tmp_path):
+    assert engine.async_io_enabled()
+    p = str(tmp_path / "w.params")
+    arrs = {f"k{i}": nd.array(np.full((64, 64), i, np.float32))
+            for i in range(4)}
+    nd.save(p, arrs)
+    engine.wait_all()
+    assert os.path.exists(p)
+    loaded = nd.load(p)
+    for k, v in arrs.items():
+        np.testing.assert_array_equal(loaded[k].asnumpy(), v.asnumpy())
+
+
+@native
+def test_async_save_snapshot_semantics(tmp_path):
+    """The values written are the values at save() time, even if the caller
+    mutates the array right after (the caller-thread snapshot)."""
+    p = str(tmp_path / "snap.params")
+    a = nd.array(np.zeros((256, 256), np.float32))
+    nd.save(p, {"w": a})
+    a[:] = 7.0  # mutate immediately after the (async) save
+    out = nd.load(p)["w"].asnumpy()  # load waits for pending writes
+    np.testing.assert_array_equal(out, 0.0)
+
+
+@native
+def test_async_save_same_path_ordering(tmp_path):
+    """Writes to the same path serialize on the path var — the LAST save
+    wins, never a torn interleaving."""
+    p = str(tmp_path / "ordered.params")
+    for i in range(8):
+        nd.save(p, {"w": nd.array(np.full((128, 128), i, np.float32))})
+    out = nd.load(p)["w"].asnumpy()
+    np.testing.assert_array_equal(out, 7.0)
+
+
+@native
+def test_async_save_error_surfaces():
+    """A failed async write raises at the sync point, not silently."""
+    with pytest.raises(OSError):
+        nd.save("/nonexistent_dir_xyz/file.params", {"w": nd.zeros((2,))})
+        engine.wait_all()
+
+
+def test_sync_save_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_ASYNC_IO", "0")
+    assert not engine.async_io_enabled()
+    p = str(tmp_path / "sync.params")
+    nd.save(p, {"w": nd.ones((3,))})
+    assert os.path.exists(p)  # written before save() returned
+    np.testing.assert_array_equal(nd.load(p)["w"].asnumpy(), 1.0)
+
+
+@native
+def test_gluon_save_parameters_async(tmp_path):
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    p = str(tmp_path / "net.params")
+    net.save_parameters(p)
+    net2 = nn.Dense(4, in_units=3)
+    net2.load_parameters(p)  # waits for the pending write
+    np.testing.assert_array_equal(net.weight.data().asnumpy(),
+                                  net2.weight.data().asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# DataLoader through the SharedMemoryArena
+# ---------------------------------------------------------------------------
+
+
+def _make_dataset(n=64, shape=(3, 8, 8)):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, *shape).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.float32)
+    return ArrayDataset(x, y), x, y
+
+
+@native
+def test_dataloader_shm_path_taken_and_correct():
+    ds, x, y = _make_dataset()
+    dl = DataLoader(ds, batch_size=16, num_workers=2, thread_pool=False)
+    it = iter(dl)
+    assert it._shm, "native lib present: the shm path must be taken"
+    got_x, got_y = [], []
+    for bx, by in it:
+        got_x.append(bx.asnumpy())
+        got_y.append(by.asnumpy())
+    np.testing.assert_allclose(np.concatenate(got_x), x)
+    np.testing.assert_allclose(np.concatenate(got_y), y)
+
+
+@native
+def test_dataloader_shm_nested_batchify():
+    """Tuple datasets flatten/unflatten through the shm segment."""
+    ds, x, y = _make_dataset(n=20)
+    dl = DataLoader(ds, batch_size=7, num_workers=2, thread_pool=False,
+                    last_batch="keep")
+    batches = list(iter(dl))
+    assert len(batches) == 3
+    assert batches[-1][0].shape[0] == 6  # 20 = 7+7+6
+
+
+@native
+def test_dataloader_shm_segments_cleaned():
+    """No /dev/shm leaks after an epoch."""
+    before = set(os.listdir("/dev/shm")) if os.path.isdir("/dev/shm") else set()
+    ds, _, _ = _make_dataset(n=32)
+    dl = DataLoader(ds, batch_size=8, num_workers=2, thread_pool=False)
+    list(iter(dl))
+    after = set(os.listdir("/dev/shm")) if os.path.isdir("/dev/shm") else set()
+    leaked = [f for f in after - before if f.startswith("mxtpu_dl_")]
+    assert not leaked, leaked
+
+
+@native
+def test_shm_beats_pickle_microbench(monkeypatch):
+    """The wire-format motivation (verdict #2 done-criterion): an epoch of
+    224x224 b=64 batches through worker processes is faster over the
+    arena than over the mp.Pool pickle pipe — the PRODUCTION comparison
+    (same workers, same dataset; only the transport differs)."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 3, 224, 224).astype(np.float32)
+    ds = ArrayDataset(x, np.arange(128, dtype=np.float32))
+
+    def epoch():
+        dl = DataLoader(ds, batch_size=64, num_workers=2, thread_pool=False)
+        it = iter(dl)
+        out = [bx.asnumpy().sum() for bx, _ in it]
+        return it, out
+
+    def timed(n=3):
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            it, _ = epoch()
+            best = min(best, time.perf_counter() - t0)
+        return it, best
+
+    it, _ = epoch()  # warm (fork, imports, jit)
+    assert it._shm
+    it_shm, t_shm = timed()
+    assert it_shm._shm
+    monkeypatch.setattr(lib, "native_available", lambda: False)
+    it_pkl, t_pickle = timed()
+    assert not it_pkl._shm
+    print(f"\nepoch over shm {t_shm*1e3:.0f} ms vs pickle pipe "
+          f"{t_pickle*1e3:.0f} ms (2 batches x 36.75MB)")
+    assert t_shm < t_pickle, (t_shm, t_pickle)
+
+
+# ---------------------------------------------------------------------------
+# PrefetchingIter on the engine
+# ---------------------------------------------------------------------------
+
+
+@native
+def test_prefetching_iter_engine_path():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    base = NDArrayIter(x, np.arange(10, dtype=np.float32), batch_size=2)
+    pf = PrefetchingIter(base)
+    assert pf._engine is not None and pf._thread is None, \
+        "native lib present: fetches must ride the engine"
+    seen = [b.data[0].asnumpy() for b in pf]
+    assert len(seen) == 5
+    np.testing.assert_allclose(np.concatenate(seen), x)
+    # reset + second epoch
+    pf.reset()
+    seen2 = [b.data[0].asnumpy() for b in pf]
+    np.testing.assert_allclose(np.concatenate(seen2), x)
+
+
+@native
+def test_dataloader_abandoned_epoch_unlinks_segments():
+    """Breaking out of an epoch must not leak the in-flight batches'
+    /dev/shm segments (drained + unlinked in _shutdown)."""
+    before = set(os.listdir("/dev/shm")) if os.path.isdir("/dev/shm") else set()
+    ds, _, _ = _make_dataset(n=64)
+    dl = DataLoader(ds, batch_size=8, num_workers=2, thread_pool=False,
+                    prefetch=4)
+    it = iter(dl)
+    next(it)  # consume ONE batch, abandon the rest mid-flight
+    it._shutdown()
+    after = set(os.listdir("/dev/shm")) if os.path.isdir("/dev/shm") else set()
+    leaked = [f for f in after - before if f.startswith("mxtpu_dl_")]
+    assert not leaked, leaked
+
+
+@native
+def test_imgpipe_partial_batch_survives_corrupt_record():
+    """One corrupt JPEG re-decodes via python; the other 255^W majority of
+    the native batch is kept (imgpipe status array contract)."""
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu import image as img
+
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(40, 40, 3) * 255).astype(np.uint8)
+    b = _io.BytesIO()
+    Image.fromarray(arr).save(b, "JPEG")
+    good = b.getvalue()
+    bad = good[:60]  # truncated: native decode fails, PIL tolerates it
+    it = img.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                       imglist=[(0.0, "x")], path_root=".")
+    assert it._native_cfg is not None
+    samples = [(0.0, good), (1.0, bad), (2.0, good), (3.0, good)]
+    # the python chain stands in for "PIL tolerates what libjpeg rejects"
+    fallback_calls = []
+    orig = it._decode_augment
+
+    def patched(label, raw):
+        if raw == bad:
+            fallback_calls.append(label)
+            return label, np.zeros((3, 32, 32), np.float32)
+        return orig(label, raw)
+
+    it._decode_augment = patched
+    decoded = it._decode_batch_native(samples)
+    assert decoded is not None and len(decoded) == 4
+    assert fallback_calls == [1.0]          # ONLY the corrupt record
+    np.testing.assert_array_equal(decoded[1][1], 0)
+    assert decoded[0][1].shape == (3, 32, 32)
+    assert not np.allclose(decoded[0][1], 0)  # native results kept
