@@ -277,9 +277,15 @@ class KVStoreDistTPUSync(KVStoreBase):
             self._push_dense(dense_keys, dense_arrs)
 
     def _push_dense(self, keys, arrs):
-        """Bucketed allreduce: flatten+concat per dtype (fp16 rides an fp32
-        wire — gloo/ICI-friendly, exact for gradient magnitudes), one
-        collective per bucket, split back per key."""
+        """Bucketed allreduce: flatten+concat per dtype, one collective per
+        bucket, split back per key.
+
+        Wire dtype for 16-bit keys (round-5 verdict #9): fp16 gradients
+        ship over a **bf16 wire** — the same bytes as the reference's
+        native-dtype allreduce (`src/kvstore/comm.h:451`) but with fp32's
+        exponent range, so large-key sums cannot overflow the way a raw
+        fp16 wire can; bf16 keys stay bf16. `MXNET_KVSTORE_FP32_WIRE=1`
+        restores the (exact, 2x bytes) fp32 wire for either."""
         buckets = []  # list of (keys, arrs)
         groups = {}
         for k, a in zip(keys, arrs):
@@ -295,8 +301,12 @@ class KVStoreDistTPUSync(KVStoreBase):
                 cur_n += a.size
             if cur_k:
                 buckets.append((cur_k, cur_a))
+        fp32_wire = os.environ.get("MXNET_KVSTORE_FP32_WIRE", "0") == "1"
         for bkeys, barrs in buckets:
-            wire_dtype = jnp.float32 if barrs[0].dtype == jnp.float16 else barrs[0].dtype
+            if barrs[0].dtype in (jnp.float16, jnp.bfloat16):
+                wire_dtype = jnp.float32 if fp32_wire else jnp.bfloat16
+            else:
+                wire_dtype = barrs[0].dtype
             if len(barrs) == 1:
                 reduced = _allreduce_sum(barrs[0].astype(wire_dtype))
                 parts = [reduced]
