@@ -208,16 +208,17 @@ def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
         if p.sum() == 0:
             continue
         is_nonzero = (p != 0)
-        num_merged = sliced.size // num_quantized_bins
-        q = np.zeros_like(sliced)
-        for j in range(num_quantized_bins):
-            start = j * num_merged
-            stop = sliced.size if j == num_quantized_bins - 1 \
-                else start + num_merged
-            total = sliced[start:stop].sum()
-            norm = is_nonzero[start:stop].sum()
-            if norm:
-                q[start:stop] = total / norm
+        # vectorized 255-bin merge (the reference vectorizes the same
+        # sweep): groups 0..253 are equal length, the last takes the rest
+        m = sliced.size // num_quantized_bins
+        k = num_quantized_bins - 1
+        totals = np.concatenate([sliced[: m * k].reshape(k, m).sum(1),
+                                 [sliced[m * k:].sum()]])
+        norms = np.concatenate([is_nonzero[: m * k].reshape(k, m).sum(1),
+                                [is_nonzero[m * k:].sum()]])
+        vals = np.where(norms > 0, totals / np.maximum(norms, 1), 0.0)
+        q = np.concatenate([np.repeat(vals[:k], m),
+                            np.full(sliced.size - m * k, vals[-1])])
         q[~is_nonzero] = 0
         try:
             p_s = _smooth_distribution(p)
