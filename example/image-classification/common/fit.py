@@ -1,0 +1,132 @@
+"""Shared sweepable training harness (parity:
+`example/image-classification/common/fit.py` — the arg surface every
+reference image-classification trainer composes: network/kvstore/optimizer
+/lr-schedule/batch/shape/monitor flags, plus the `--benchmark` synthetic
+path that measures img/s without touching disk).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    """The reference's fit.add_fit_args surface (subset with TPU meaning;
+    accepted-but-inert flags are kept for CLI compatibility)."""
+    train = parser.add_argument_group("Training")
+    train.add_argument("--network", type=str, default="resnet18_v1",
+                       help="model zoo network name")
+    train.add_argument("--num-classes", type=int, default=10)
+    train.add_argument("--num-examples", type=int, default=256)
+    train.add_argument("--image-shape", type=str, default="3,32,32")
+    train.add_argument("--batch-size", type=int, default=32)
+    train.add_argument("--num-epochs", type=int, default=1)
+    train.add_argument("--lr", type=float, default=0.05)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str, default="",
+                       help="comma-separated epochs to step the lr")
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=1e-4)
+    train.add_argument("--kv-store", type=str, default="local")
+    train.add_argument("--disp-batches", type=int, default=10)
+    train.add_argument("--num-batches", type=int, default=0,
+                       help="cap batches per epoch (0 = full epoch)")
+    train.add_argument("--benchmark", type=int, default=0,
+                       help="1: synthetic data, report img/s only")
+    train.add_argument("--dtype", type=str, default="float32",
+                       choices=["float32", "bfloat16"])
+    train.add_argument("--top-k", type=int, default=0)
+    return parser
+
+
+def synthetic_iter(args):
+    shape = tuple(int(s) for s in args.image_shape.split(","))
+    rng = np.random.RandomState(0)
+    x = rng.rand(args.num_examples, *shape).astype(np.float32)
+    y = rng.randint(0, args.num_classes, args.num_examples).astype(np.float32)
+    # blobs keyed to the label so accuracy is learnable when training
+    for i, cls in enumerate(y.astype(int)):
+        x[i, 0, (cls * 3) % shape[1]] += 1.0
+    return mx.io.NDArrayIter(x, y, batch_size=args.batch_size,
+                             shuffle=True)
+
+
+def make_lr_scheduler(args, steps_per_epoch):
+    if not args.lr_step_epochs:
+        return None
+    steps = [int(e) * steps_per_epoch
+             for e in args.lr_step_epochs.split(",") if e]
+    return mx.lr_scheduler.MultiFactorScheduler(step=steps,
+                                                factor=args.lr_factor)
+
+
+def fit(args, net, train_iter, val_iter=None):
+    """gluon training loop with the reference fit.py reporting format
+    (`Epoch[k] Batch [j] Speed: N samples/sec accuracy=...`)."""
+    kv = mx.kvstore.create(args.kv_store) if args.kv_store else None
+    if args.dtype == "bfloat16":
+        net.cast("bfloat16")
+    net.hybridize()
+    steps = max(1, args.num_examples // args.batch_size)
+    opt_params = {"learning_rate": args.lr, "wd": args.wd}
+    if args.optimizer in ("sgd", "nag"):
+        opt_params["momentum"] = args.mom
+        opt_params["multi_precision"] = args.dtype != "float32"
+    sched = make_lr_scheduler(args, steps)
+    if sched is not None:
+        opt_params["lr_scheduler"] = sched
+    trainer = mx.gluon.Trainer(net.collect_params(), args.optimizer,
+                               opt_params, kvstore=kv)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    top_k = mx.metric.TopKAccuracy(args.top_k) if args.top_k else None
+
+    for epoch in range(args.num_epochs):
+        train_iter.reset()
+        metric.reset()
+        tic = time.time()
+        n_img = 0
+        for i, batch in enumerate(train_iter):
+            if args.num_batches and i >= args.num_batches:
+                break
+            data, label = batch.data[0], batch.label[0]
+            if args.dtype == "bfloat16":
+                data = data.astype("bfloat16")
+            with mx.autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([label], [out])
+            n_img += args.batch_size
+            if args.disp_batches and (i + 1) % args.disp_batches == 0:
+                speed = n_img / (time.time() - tic)
+                logging.info("Epoch[%d] Batch [%d] Speed: %.2f samples/sec "
+                             "accuracy=%.4f", epoch, i + 1, speed,
+                             metric.get()[1])
+        speed = n_img / max(time.time() - tic, 1e-9)
+        logging.info("Epoch[%d] Train-accuracy=%.4f Speed=%.2f img/s",
+                     epoch, metric.get()[1], speed)
+
+    if args.benchmark:
+        print(f"benchmark-img-per-sec:{speed:.2f}")
+        return speed
+    if val_iter is not None:
+        val_iter.reset()
+        metric.reset()
+        for batch in val_iter:
+            out = net(batch.data[0].astype(args.dtype))
+            metric.update([batch.label[0]], [out])
+            if top_k:
+                top_k.update([batch.label[0]], [out])
+        logging.info("Validation-accuracy=%.4f", metric.get()[1])
+        print(f"validation-accuracy:{metric.get()[1]:.4f}")
+        return metric.get()[1]
+    print(f"train-accuracy:{metric.get()[1]:.4f}")
+    return metric.get()[1]

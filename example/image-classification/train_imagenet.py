@@ -1,0 +1,48 @@
+"""Sweepable ImageNet-style trainer (parity:
+`example/image-classification/train_imagenet.py` + `common/fit.py`): any
+model-zoo network x optimizer x lr-schedule x kvstore x dtype from the
+CLI; `--benchmark 1` runs the synthetic-data throughput mode the
+reference uses for its perf tables (`docs/faq/perf.md:196`).
+
+  # throughput sweep (synthetic data, like the reference's --benchmark 1)
+  JAX_PLATFORMS=cpu python example/image-classification/train_imagenet.py \
+      --network resnet18_v1 --batch-size 8 --image-shape 3,32,32 \
+      --benchmark 1 --num-batches 4
+
+  # bf16 on the MXU
+  python example/image-classification/train_imagenet.py \
+      --network resnet50_v2 --dtype bfloat16 --benchmark 1
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from common import fit
+
+logging.basicConfig(level=logging.INFO)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train an image-classification model (sweepable)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    args = parser.parse_args()
+
+    net = mx.gluon.model_zoo.vision.get_model(
+        args.network, classes=args.num_classes)
+    net.initialize(mx.init.Xavier(magnitude=2))
+
+    train_iter = fit.synthetic_iter(args)
+    val_iter = None if args.benchmark else fit.synthetic_iter(args)
+    fit.fit(args, net, train_iter, val_iter)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""INT8 quantization walkthrough (parity:
+`example/quantization/imagenet_gen_qsym.py` + `imagenet_inference.py`):
+train a small fp32 CNN, calibrate + quantize it, save the quantized
+symbol/params checkpoint, and compare fp32 vs int8 accuracy.
+
+TPU note: the quantized graph runs int8xint8->int32 matmuls/convs with
+`preferred_element_type` (MXU-native); calibration thresholds fold into
+static scales XLA constant-folds. Synthetic shapes data stands in for
+ImageNet (zero-egress environment).
+
+  JAX_PLATFORMS=cpu python example/quantization/quantize_model.py \
+      --calib-mode entropy --num-calib-batches 4
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.quantization import quantize_model
+
+logging.basicConfig(level=logging.INFO)
+
+parser = argparse.ArgumentParser(
+    description="fp32 -> int8 quantization walkthrough",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--calib-mode", default="entropy",
+                    choices=["none", "naive", "entropy"])
+parser.add_argument("--num-calib-batches", type=int, default=4)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--num-epochs", type=int, default=4)
+parser.add_argument("--out-prefix", default="/tmp/quantized_cnn")
+
+
+def make_data(n=640, seed=0):
+    """Synthetic 3-class 'shapes' images: class = which quadrant carries
+    the bright blob (learnable by a small conv net)."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, 16, 16).astype(np.float32) * 0.3
+    y = rng.randint(0, 3, n)
+    for i, cls in enumerate(y):
+        r, c = [(2, 2), (2, 10), (10, 6)][cls]
+        x[i, 0, r:r + 4, c:c + 4] += 0.9
+    return x, y.astype(np.float32)
+
+
+def cnn_symbol():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c2 = mx.sym.Convolution(p1, kernel=(3, 3), num_filter=16, name="conv2")
+    a2 = mx.sym.Activation(c2, act_type="relu")
+    p2 = mx.sym.Pooling(a2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    fl = mx.sym.Flatten(p2)
+    fc = mx.sym.FullyConnected(fl, num_hidden=3, name="fc")
+    return mx.sym.SoftmaxOutput(fc, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def accuracy(mod, it):
+    it.reset()
+    metric = mx.metric.Accuracy()
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        metric.update(batch.label, mod.get_outputs())
+    return metric.get()[1]
+
+
+def main():
+    args = parser.parse_args()
+    x, y = make_data()
+    xv, yv = make_data(n=192, seed=1)
+    train = mx.io.NDArrayIter(x, y, batch_size=args.batch_size)
+    val = mx.io.NDArrayIter(xv, yv, batch_size=args.batch_size)
+
+    # 1. train fp32
+    mod = mx.mod.Module(cnn_symbol(), context=mx.cpu())
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3},
+            num_epoch=args.num_epochs, initializer=mx.init.Xavier())
+    fp32_acc = accuracy(mod, val)
+    logging.info("fp32 accuracy: %.4f", fp32_acc)
+
+    # 2. calibrate + quantize (reference imagenet_gen_qsym.py flow)
+    arg_params, aux_params = mod.get_params()
+    calib = mx.io.NDArrayIter(x, y, batch_size=args.batch_size)
+    qsym, qarg, qaux = quantize_model(
+        mod.symbol, arg_params, aux_params,
+        calib_mode=args.calib_mode, calib_data=calib,
+        num_calib_examples=args.num_calib_batches * args.batch_size,
+        quantized_dtype="int8", logger=logging)
+
+    # 3. save the quantized checkpoint (same format as the reference)
+    mx.model.save_checkpoint(args.out_prefix, 0, qsym, qarg, qaux)
+    logging.info("saved %s-symbol.json / %s-0000.params",
+                 args.out_prefix, args.out_prefix)
+
+    # 4. int8 inference + accuracy comparison
+    qmod = mx.mod.Module(qsym, context=mx.cpu())
+    qmod.bind(val.provide_data, val.provide_label, for_training=False)
+    qmod.set_params(qarg, qaux)
+    int8_acc = accuracy(qmod, val)
+    logging.info("int8 accuracy: %.4f (drop %.4f)", int8_acc,
+                 fp32_acc - int8_acc)
+    print(f"fp32-accuracy:{fp32_acc:.4f}")
+    print(f"int8-accuracy:{int8_acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -70,3 +70,40 @@ def test_distributed_example_two_workers():
     out = proc.stdout
     assert proc.returncode == 0, out[-3000:]
     assert "rank 0: done" in out and "rank 1: done" in out
+
+
+@pytest.mark.slow
+def test_lstm_bucketing_example():
+    """Bucketed symbolic LSTM LM (reference example/rnn/bucketing/): the
+    Markov corpus is learnable, so perplexity must fall well below the
+    uniform-vocab 60."""
+    out = _run("rnn/lstm_bucketing.py", "--num-epochs", "3",
+               "--num-sentences", "300", timeout=500)
+    ppl = float(out.strip().splitlines()[-1].split(":")[1])
+    assert ppl < 20, out[-500:]
+
+
+@pytest.mark.slow
+def test_quantization_walkthrough_example():
+    """fp32 train -> calibrate -> int8 (reference
+    example/quantization/imagenet_gen_qsym.py flow)."""
+    out = _run("quantization/quantize_model.py", "--num-epochs", "3",
+               "--calib-mode", "entropy", timeout=500)
+    lines = out.strip().splitlines()
+    fp32 = float(lines[-2].split(":")[1])
+    int8 = float(lines[-1].split(":")[1])
+    assert fp32 > 0.9, out[-500:]
+    assert int8 > fp32 - 0.05, (fp32, int8)
+
+
+@pytest.mark.slow
+def test_train_imagenet_sweepable():
+    """The sweepable trainer (reference train_imagenet.py + common/fit.py):
+    benchmark mode prints img/s; lr stepping and top-k flags parse."""
+    out = _run("image-classification/train_imagenet.py",
+               "--network", "resnet18_v1", "--batch-size", "8",
+               "--image-shape", "3,32,32", "--benchmark", "1",
+               "--num-batches", "3", "--lr-step-epochs", "1",
+               timeout=500)
+    speed = float(out.strip().splitlines()[-1].split(":")[1])
+    assert speed > 0, out[-500:]
