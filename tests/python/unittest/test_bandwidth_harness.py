@@ -1,0 +1,43 @@
+"""CI-sized run of the bandwidth harness (round-5 verdict #5): a 4-worker
+`tools/launch.py` + `tools/bandwidth/measure.py --tiers` sweep completes,
+reduces exactly (error == 0), and wire throughput is monotone-ish in key
+size (larger keys amortize per-collective latency — the shape the
+reference harness shows, `/root/reference/tools/bandwidth/measure.py`).
+The committed multi-n artifact is BANDWIDTH_r05.json.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+
+@pytest.mark.slow
+def test_bandwidth_4workers_tiers(tmp_path):
+    out_json = str(tmp_path / "bw.jsonl")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers must not inherit 8 virtual devices
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "--timeout", "840",
+         sys.executable, os.path.join(REPO, "tools", "bandwidth", "measure.py"),
+         "--kv-store", "dist_tpu_sync", "--network", "resnet18_v1",
+         "--image-shape", "3,32,32", "--num-batches", "2",
+         "--tiers", "1", "--json-out", out_json],
+        env=env, cwd=REPO, capture_output=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout.decode()[-4000:]
+    lines = open(out_json).read().strip().splitlines()
+    assert len(lines) == 1  # rank 0 only
+    rec = json.loads(lines[0])
+    assert rec["num_workers"] == 4
+    assert rec["error"] == 0.0  # the allreduce is exact
+    tiers = rec["tiers"]
+    assert set(tiers) == {"small_lt_256KB", "medium_lt_4MB", "large_ge_4MB"}
+    # monotone-ish: the large tier must beat the small tier on wire
+    # bytes/s (medium can jitter on a loaded CI box)
+    assert tiers["large_ge_4MB"]["wire_bytes_per_sec"] > \
+        tiers["small_lt_256KB"]["wire_bytes_per_sec"]

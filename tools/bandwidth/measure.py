@@ -22,10 +22,16 @@ multi-process launcher uses (tools/launch.py).
 """
 import argparse
 import logging
+import os
+import sys
 import time
 from collections import namedtuple
 
 import numpy as np
+
+# importable regardless of launch cwd (launcher workers inherit theirs)
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
 
 logger = logging.getLogger()
 logger.setLevel(logging.INFO)
@@ -47,6 +53,11 @@ def parse_args():
     p.add_argument("--optimizer", type=str, default="None")
     p.add_argument("--gc-type", type=str, default="none",
                    help="gradient compression type (2bit)")
+    p.add_argument("--tiers", type=int, default=0,
+                   help="1: also time push+pull per key-size tier "
+                        "(small <256KB / medium <4MB / large >=4MB)")
+    p.add_argument("--json-out", type=str, default="",
+                   help="rank-0 appends one JSON result line to this file")
     return p.parse_args()
 
 
@@ -68,6 +79,12 @@ def run(args):
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvs
     from mxnet_tpu import optimizer as opt
+
+    if args.kv_store.startswith("dist"):
+        # the process group must come up before ANY jax backend touch
+        from mxnet_tpu.parallel.dist import init_process_group
+
+        init_process_group()
 
     import jax
 
@@ -147,9 +164,61 @@ def run(args):
                              r.iter, r.time, r.bandwidth, r.error)
                 res.append(r)
             toc = 0.0
+    avg = 0.0
     if res:
         avg = sum(r.bandwidth for r in res) / len(res)
         logging.info("average %f GB/sec per device over %d iters", avg, len(res))
+
+    tier_stats = {}
+    if args.tiers:
+        # per-key-size tiers (the reference harness reports one number per
+        # key-size regime; BANDWIDTH_r*.json keeps the tiers explicit)
+        n_eff = max(ndev, getattr(kv, "num_workers", 1))
+        tiers = {"small_lt_256KB": [], "medium_lt_4MB": [], "large_ge_4MB": []}
+        for i, s in enumerate(shapes):
+            nbytes = float(np.prod(s)) * 4
+            if nbytes < 256 << 10:
+                tiers["small_lt_256KB"].append(i)
+            elif nbytes < 4 << 20:
+                tiers["medium_lt_4MB"].append(i)
+            else:
+                tiers["large_ge_4MB"].append(i)
+        for tname, idxs in tiers.items():
+            if not idxs:
+                continue
+            tbytes = sum(float(np.prod(shapes[i])) * 4 for i in idxs)
+            for _ in range(2):  # warm + measure
+                tic = time.time()
+                for _b in range(args.num_batches):
+                    for i in idxs:
+                        kv.push(i, grads[i], priority=i)
+                    for i in idxs:
+                        kv.pull(i, weights[i], priority=i)
+                    for i in idxs:
+                        for w in weights[i]:
+                            w.wait_to_read()
+                dt = time.time() - tic
+            per_iter = dt / args.num_batches
+            wire_bytes_s = tbytes * 2 * (n_eff - 1) / max(n_eff, 1) / \
+                max(per_iter, 1e-12)
+            tier_stats[tname] = {
+                "keys": len(idxs), "bytes": tbytes,
+                "sec_per_iter": per_iter, "wire_bytes_per_sec": wire_bytes_s}
+            logging.info("tier %s: %d keys, %.1f MB, %.4f s/iter, "
+                         "%.3f GB/s wire", tname, len(idxs), tbytes / 1e6,
+                         per_iter, wire_bytes_s / 1e9)
+
+    if args.json_out and getattr(kv, "rank", 0) == 0:
+        import json
+
+        line = {"kv_store": args.kv_store, "network": args.network,
+                "num_workers": int(getattr(kv, "num_workers", 1)),
+                "ndev_local": ndev, "total_MB": size_mb,
+                "avg_gb_per_sec_per_device": avg,
+                "error": float(res[-1].error) if res else None,
+                "tiers": tier_stats}
+        with open(args.json_out, "a") as f:
+            f.write(json.dumps(line) + "\n")
     return res
 
 
