@@ -1,13 +1,15 @@
 """contrib — experimental / auxiliary frontends (parity
-`python/mxnet/contrib/`): quantization, ONNX, text utilities, SVRG."""
+`python/mxnet/contrib/`): quantization, ONNX, text utilities, SVRG,
+DGL graph helpers, legacy autograd, DataLoaderIter, tensorboard."""
 from . import quantization  # noqa: F401
 from . import text          # noqa: F401
 
 
 def __getattr__(name):
-    # onnx / svrg_optimization import lazily (protobuf + Module deps);
-    # importlib (not `from . import`) — the latter re-enters this hook
-    if name in ("onnx", "svrg_optimization"):
+    # heavier / optional-dep modules import lazily; importlib (not
+    # `from . import`) — the latter re-enters this hook
+    if name in ("onnx", "svrg_optimization", "dgl", "io", "autograd",
+                "tensorboard"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

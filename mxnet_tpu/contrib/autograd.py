@@ -1,0 +1,95 @@
+"""Legacy experimental autograd API (parity:
+`python/mxnet/contrib/autograd.py` — the pre-`mx.autograd` surface some
+old scripts still import). Thin adapters over :mod:`mxnet_tpu.autograd`.
+"""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Set training mode + recording (the legacy API coupled them)."""
+    prev_rec = _ag.set_recording(bool(is_train))
+    _ag.set_training(bool(is_train))
+    return prev_rec
+
+
+class TrainingStateScope:
+    def __init__(self, enter_state):
+        self._enter_state = enter_state
+        self._prev_rec = None
+        self._prev_train = None
+
+    def __enter__(self):
+        self._prev_rec = _ag.set_recording(self._enter_state)
+        self._prev_train = _ag.set_training(self._enter_state)
+        return self
+
+    def __exit__(self, *a):
+        _ag.set_recording(self._prev_rec)
+        _ag.set_training(self._prev_train)
+
+
+def train_section():
+    """`with autograd.train_section():` — record + train mode."""
+    return TrainingStateScope(True)
+
+
+def test_section():
+    """`with autograd.test_section():` — pause inside a train_section."""
+    return TrainingStateScope(False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    if not isinstance(outputs, (list, tuple)):
+        raise TypeError("outputs must be a list or tuple of NDArrays")
+    _ag.backward(list(outputs), head_grads=out_grads,
+                 retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated alias of backward."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorate `func` to return (gradients, loss) (reference
+    contrib/autograd.py:163)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for v in variables:
+            if v.grad is None:
+                v.attach_grad()
+        with _ag.record():
+            outputs = func(*args)
+        _ag.backward([outputs] if not isinstance(outputs, (list, tuple))
+                     else list(outputs))
+        return [v.grad for v in variables], outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorate `func` to return only the gradients."""
+    wrapped = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def only_grads(*args):
+        return wrapped(*args)[0]
+
+    return only_grads

@@ -1,0 +1,51 @@
+"""Contrib data iterators (parity: `python/mxnet/contrib/io.py`):
+DataLoaderIter adapts a gluon DataLoader to the Module-side DataIter
+contract so the symbolic fit loop can consume gluon datasets."""
+from __future__ import annotations
+
+from ..io.io import DataIter, DataDesc, DataBatch
+from .. import ndarray as nd
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a `gluon.data.DataLoader` as a DataIter (reference
+    contrib/io.py DataLoaderIter): each loader batch must be a
+    (data, label) pair; shapes are probed from the first batch."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__(batch_size=getattr(loader, "_batch_sampler", None)
+                         and loader._batch_sampler._batch_size or 0)
+        self._loader = loader
+        self._dtype = dtype
+        self._iter = iter(loader)
+        try:
+            first = next(self._iter)
+        except StopIteration:
+            raise ValueError("DataLoaderIter: empty loader")
+        if not isinstance(first, (list, tuple)) or len(first) != 2:
+            raise ValueError("DataLoaderIter expects (data, label) batches")
+        self._pending = first
+        data0, label0 = first
+        self.batch_size = data0.shape[0]
+        self.provide_data = [DataDesc(data_name, tuple(data0.shape), dtype)]
+        self.provide_label = [DataDesc(label_name, tuple(label0.shape), dtype)]
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._pending = None
+
+    def next(self):
+        if self._pending is not None:
+            batch, self._pending = self._pending, None
+        else:
+            try:
+                batch = next(self._iter)
+            except StopIteration:
+                raise StopIteration
+        data, label = batch
+        return DataBatch(data=[data.astype(self._dtype)],
+                         label=[label.astype(self._dtype)],
+                         pad=self.batch_size - data.shape[0])

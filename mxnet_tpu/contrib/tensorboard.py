@@ -1,0 +1,41 @@
+"""TensorBoard logging callback (parity:
+`python/mxnet/contrib/tensorboard.py` LogMetricsCallback). The event
+writer is optional: `tensorboardX`/`torch.utils.tensorboard` when
+importable, else an in-memory record (so the callback is usable — and
+testable — without the dependency)."""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Log eval metrics each callback invocation (reference
+    contrib/tensorboard.py: works like callback.Speedometer but writes
+    TensorBoard events)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.records = []  # (tag, value) pairs, kept regardless of backend
+        self.summary_writer = None
+        for mod, cls in (("tensorboardX", "SummaryWriter"),
+                         ("torch.utils.tensorboard", "SummaryWriter")):
+            try:
+                import importlib
+
+                self.summary_writer = getattr(importlib.import_module(mod),
+                                              cls)(logging_dir)
+                break
+            except Exception:  # noqa: BLE001 — optional dependency
+                continue
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.records.append((name, value))
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(name, value, self._step)

@@ -1,0 +1,76 @@
+"""Contrib auxiliary modules (parity `python/mxnet/contrib/`):
+DataLoaderIter (contrib/io.py), the legacy experimental autograd API
+(contrib/autograd.py), tensorboard LogMetricsCallback."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_dataloader_iter_feeds_module():
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(40, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 3).astype(np.float32)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=10)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (10, 6)
+    batches = sum(1 for _ in iter(lambda: _next_or_none(it), None))
+    assert batches == 4
+    it.reset()
+
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2)
+    sym = mx.sym.SoftmaxOutput(sym, mx.sym.Variable("softmax_label"))
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.6
+
+
+def _next_or_none(it):
+    try:
+        return it.next()
+    except StopIteration:
+        return None
+
+
+def test_legacy_contrib_autograd():
+    from mxnet_tpu.contrib import autograd as old_ag
+
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    g = mx.nd.zeros((3,))
+    old_ag.mark_variables([x], [g])
+    with old_ag.train_section():
+        y = x * x
+    old_ag.backward([y])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+    # grad_and_loss / grad decorators
+    def f(a):
+        return (a * a).sum()
+
+    grads, loss = old_ag.grad_and_loss(f)(
+        mx.nd.array(np.array([2.0, -1.0], np.float32)))
+    np.testing.assert_allclose(grads[0].asnumpy(), [4.0, -2.0])
+    only = old_ag.grad(f)(mx.nd.array(np.array([3.0], np.float32)))
+    np.testing.assert_allclose(only[0].asnumpy(), [6.0])
+
+
+def test_tensorboard_callback_records():
+    from collections import namedtuple
+
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+
+    cb = LogMetricsCallback("/tmp/tb_events_test", prefix="train")
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array(np.array([1.0, 0.0], np.float32))],
+                  [mx.nd.array(np.array([[0.1, 0.9], [0.8, 0.2]],
+                                        np.float32))])
+    Param = namedtuple("Param", ["eval_metric"])
+    cb(Param(eval_metric=metric))
+    assert cb.records and cb.records[0][0] == "train-accuracy"
+    assert cb.records[0][1] == 1.0

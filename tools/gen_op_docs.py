@@ -1,0 +1,72 @@
+"""Generate per-operator documentation from the registry schemas — the
+role of the reference's generated op docs (`python/mxnet/ndarray/register.py`
+renders DMLC parameter structs into docstrings; here the op fn signature IS
+the schema, `mxnet_tpu/ops/registry.py attr_schema`).
+
+  JAX_PLATFORMS=cpu python tools/gen_op_docs.py > docs/ops.md
+"""
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..")))
+
+
+def main():
+    from mxnet_tpu.ops import registry
+
+    ops = {}
+    aliases = {}
+    for name in registry.list_ops():
+        op = registry.get_op(name)
+        if op.name == name:
+            ops[name] = op
+        else:
+            aliases.setdefault(op.name, []).append(name)
+
+    print("# Operator reference (generated)")
+    print()
+    print(f"{len(registry.list_ops())} registered names "
+          f"({len(ops)} canonical + aliases). Regenerate with "
+          f"`python tools/gen_op_docs.py > docs/ops.md`.")
+    print()
+    for name in sorted(ops):
+        op = ops[name]
+        print(f"## `{name}`")
+        alias_list = aliases.get(name)
+        if alias_list:
+            print(f"*aliases: {', '.join('`%s`' % a for a in sorted(alias_list))}*")
+            print()
+        doc = (op.doc or "").strip()
+        if doc:
+            print(doc)
+            print()
+        schema = registry.attr_schema(op)
+        if schema:
+            rows = [(n, d) for n, d in schema.items()
+                    if not n.startswith("_")]
+            if rows:
+                print("| parameter | default |")
+                print("|---|---|")
+                for n, d in rows:
+                    dv = "required tensor" if d is inspect.Parameter.empty \
+                        else repr(d)
+                    print(f"| `{n}` | {dv} |")
+                print()
+        flags = []
+        if op.needs_rng:
+            flags.append("consumes PRNG key")
+        if op.needs_mode:
+            flags.append("train/predict polymorphic")
+        if op.eager_only:
+            flags.append("eager-only (dynamic shape / host op)")
+        if op.mutate_aux:
+            flags.append("writes state back into inputs (FMutateInputs)")
+        if flags:
+            print(f"*{'; '.join(flags)}*")
+            print()
+
+
+if __name__ == "__main__":
+    main()
