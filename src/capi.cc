@@ -8,13 +8,18 @@
 // built by the Python layer, so the flat ABI delegates op dispatch to it
 // rather than duplicating a second op registry in C++.
 //
-// Covered slice (verdict order #6):
-//   MXGetVersion, MXGetLastError, MXListAllOpNames,
-//   MXNDArrayCreate / Free / GetShape / GetDType /
-//     SyncCopyFromCPU / SyncCopyToCPU,
+// Covered slice (verdict order #6, extended round 5):
+//   MXGetVersion, MXGetLastError, MXListAllOpNames, MXRandomSeed,
+//   MXNDArrayCreate / Free / GetShape / GetDType / GetContext /
+//     SyncCopyFromCPU / SyncCopyToCPU / Reshape / Slice / At /
+//     Save / Load / GetGrad,
 //   MXImperativeInvoke (op invoke-by-name, string-typed attrs — the
 //     c_api_ndarray.cc:132 role),
-//   MXSymbolCreateFromJSON / MXSymbolSaveToJSON / MXSymbolFree.
+//   MXSymbolCreateFromJSON / MXSymbolSaveToJSON / MXSymbolFree /
+//     MXSymbolListArguments / MXSymbolListOutputs,
+//   MXAutogradSetIsRecording / SetIsTraining / MarkVariables / Backward —
+//     enough for a NON-PYTHON frontend to train (the client test runs a
+//     full sgd regression loop with zero python imports).
 //
 // Conventions (mirroring the reference ABI):
 //   * every call returns 0 on success, -1 on failure; the message is
@@ -146,6 +151,84 @@ def capi_sym_from_json(s):
 
 def capi_sym_to_json(sym):
     return sym.tojson()
+
+
+def capi_sym_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def capi_sym_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def capi_get_context(arr):
+    dev = getattr(arr, '_ctx', None)
+    kind = getattr(dev, 'device_type', 'cpu')
+    # reference dev_type codes (c_api.h): cpu=1, accelerator=2
+    return (1, 0) if str(kind).startswith('cpu') else \
+        (2, int(getattr(dev, 'device_id', 0)))
+
+
+def capi_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def capi_slice(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def capi_at(arr, idx):
+    return arr[int(idx)]
+
+
+def capi_save(fname, arrs, keys):
+    if keys:
+        mx.nd.save(fname, dict(zip(keys, arrs)))
+    else:
+        mx.nd.save(fname, list(arrs))
+
+
+def capi_load(fname):
+    out = mx.nd.load(fname)
+    if isinstance(out, dict):
+        return list(out.keys()), list(out.values())
+    return [], list(out)
+
+
+def capi_random_seed(seed):
+    mx.random.seed(int(seed))
+
+
+def capi_set_recording(flag):
+    from mxnet_tpu import autograd
+    return int(autograd.set_recording(bool(flag)))
+
+
+def capi_set_training(flag):
+    from mxnet_tpu import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+_GRAD_REQ = {0: 'null', 1: 'write', 3: 'add'}
+
+
+def capi_mark_variables(variables, reqs, gradients):
+    from mxnet_tpu import autograd
+    autograd.mark_variables(list(variables), list(gradients),
+                            [_GRAD_REQ[int(r)] for r in reqs])
+
+
+def capi_backward(outputs, ograds, retain_graph):
+    from mxnet_tpu import autograd
+    autograd.backward(list(outputs),
+                      head_grads=list(ograds) if ograds else None,
+                      retain_graph=bool(retain_graph))
+
+
+def capi_get_grad(arr):
+    if arr.grad is None:
+        raise ValueError('NDArray has no gradient buffer (mark it first)')
+    return arr.grad
 )PY";
 
 void set_error(const char* msg) { g_last_error = msg ? msg : "unknown error"; }
@@ -459,5 +542,306 @@ MX_API int MXSymbolFree(SymbolHandle h) {
   if (h == nullptr) return 0;
   Gil gil;
   Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// round-5 surface extension: context/reshape/slice, save/load, symbol
+// introspection, RNG seed and the autograd slice — enough for a non-python
+// frontend to TRAIN (create -> mark -> record -> invoke -> backward -> read
+// grads), mirroring include/mxnet/c_api.h MXAutograd*/MXNDArray* names.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Call helper(name) with `args`; on success returns the result object
+// (new ref), else records the error and returns null.
+PyObject* call_helper(const char* name, PyObject* args) {
+  PyObject* fn = helper(name);
+  if (fn == nullptr) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(fn, args);
+  Py_XDECREF(args);
+  if (out == nullptr) set_error_from_py();
+  return out;
+}
+
+// Unpack a python list of NDArrays into g_out_handles (caller-owned refs).
+int store_handle_list(PyObject* lst, int* out_size, NDArrayHandle** outputs) {
+  Py_ssize_t n = PyList_Size(lst);
+  g_out_handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(lst, i);
+    Py_INCREF(o);
+    g_out_handles.push_back(static_cast<NDArrayHandle>(o));
+  }
+  *out_size = static_cast<int>(n);
+  *outputs = g_out_handles.data();
+  return 0;
+}
+
+// Unpack a python list of strings into the name stores.
+int store_name_list(PyObject* lst, int* out_size, const char*** out_array) {
+  Py_ssize_t n = PyList_Size(lst);
+  g_name_store.clear();
+  g_name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GET_ITEM(lst, i));
+    if (c == nullptr) PyErr_Clear();
+    g_name_store.emplace_back(c != nullptr ? c : "");
+  }
+  for (const auto& s : g_name_store) g_name_ptrs.push_back(s.c_str());
+  *out_size = static_cast<int>(n);
+  *out_array = g_name_ptrs.data();
+  return 0;
+}
+
+thread_local std::vector<std::string> g_load_names;
+thread_local std::vector<const char*> g_load_name_ptrs;
+
+}  // namespace
+
+MX_API int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type,
+                               int* out_dev_id) {
+  Gil gil;
+  PyObject* out = call_helper("capi_get_context",
+                              Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (out == nullptr) return -1;
+  int ok = PyArg_ParseTuple(out, "ii", out_dev_type, out_dev_id);
+  Py_DECREF(out);
+  if (!ok) {
+    set_error_from_py();
+    return -1;
+  }
+  return 0;
+}
+
+MX_API int MXNDArrayReshape(NDArrayHandle h, int ndim, const int64_t* dims,
+                            NDArrayHandle* out) {
+  Gil gil;
+  PyObject* shape = PyList_New(ndim);
+  if (shape == nullptr) {
+    set_error_from_py();
+    return -1;
+  }
+  for (int i = 0; i < ndim; ++i)
+    PyList_SET_ITEM(shape, i, PyLong_FromLongLong(dims[i]));
+  PyObject* o = call_helper(
+      "capi_reshape", Py_BuildValue("(ON)", static_cast<PyObject*>(h), shape));
+  if (o == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(o);
+  return 0;
+}
+
+MX_API int MXNDArraySlice(NDArrayHandle h, int64_t begin, int64_t end,
+                          NDArrayHandle* out) {
+  Gil gil;
+  PyObject* o = call_helper(
+      "capi_slice", Py_BuildValue("(OLL)", static_cast<PyObject*>(h),
+                                  static_cast<long long>(begin),
+                                  static_cast<long long>(end)));
+  if (o == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(o);
+  return 0;
+}
+
+MX_API int MXNDArrayAt(NDArrayHandle h, int64_t idx, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* o = call_helper(
+      "capi_at", Py_BuildValue("(OL)", static_cast<PyObject*>(h),
+                               static_cast<long long>(idx)));
+  if (o == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(o);
+  return 0;
+}
+
+MX_API int MXNDArraySave(const char* fname, int num, NDArrayHandle* handles,
+                         const char** keys) {
+  Gil gil;
+  PyObject* arrs = PyList_New(num);
+  PyObject* ks = keys != nullptr ? PyList_New(num) : PyList_New(0);
+  if (arrs == nullptr || ks == nullptr) {
+    set_error_from_py();
+    Py_XDECREF(arrs);
+    Py_XDECREF(ks);
+    return -1;
+  }
+  for (int i = 0; i < num; ++i) {
+    PyObject* o = static_cast<PyObject*>(handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(arrs, i, o);
+    if (keys != nullptr) {
+      PyObject* k = PyUnicode_FromString(keys[i]);
+      if (k == nullptr) {
+        set_error_from_py();
+        Py_DECREF(arrs);
+        Py_DECREF(ks);
+        return -1;
+      }
+      PyList_SET_ITEM(ks, i, k);
+    }
+  }
+  PyObject* out = call_helper("capi_save",
+                              Py_BuildValue("(sNN)", fname, arrs, ks));
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXNDArrayLoad(const char* fname, int* out_size,
+                         NDArrayHandle** out_arr, int* out_name_size,
+                         const char*** out_names) {
+  Gil gil;
+  PyObject* out = call_helper("capi_load", Py_BuildValue("(s)", fname));
+  if (out == nullptr) return -1;
+  PyObject* names = PyTuple_GetItem(out, 0);
+  PyObject* arrs = PyTuple_GetItem(out, 1);
+  if (names == nullptr || arrs == nullptr) {
+    set_error_from_py();
+    Py_DECREF(out);
+    return -1;
+  }
+  store_handle_list(arrs, out_size, out_arr);
+  g_load_names.clear();
+  g_load_name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GET_ITEM(names, i));
+    if (c == nullptr) PyErr_Clear();
+    g_load_names.emplace_back(c != nullptr ? c : "");
+  }
+  for (const auto& s : g_load_names) g_load_name_ptrs.push_back(s.c_str());
+  *out_name_size = static_cast<int>(g_load_names.size());
+  *out_names = g_load_name_ptrs.data();
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXSymbolListArguments(SymbolHandle h, int* out_size,
+                                 const char*** out_array) {
+  Gil gil;
+  PyObject* out = call_helper(
+      "capi_sym_arguments", Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (out == nullptr) return -1;
+  store_name_list(out, out_size, out_array);
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXSymbolListOutputs(SymbolHandle h, int* out_size,
+                               const char*** out_array) {
+  Gil gil;
+  PyObject* out = call_helper(
+      "capi_sym_outputs", Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (out == nullptr) return -1;
+  store_name_list(out, out_size, out_array);
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject* out = call_helper("capi_random_seed",
+                              Py_BuildValue("(i)", seed));
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  Gil gil;
+  PyObject* out = call_helper("capi_set_recording",
+                              Py_BuildValue("(i)", is_recording));
+  if (out == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(out));
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXAutogradSetIsTraining(int is_training, int* prev) {
+  Gil gil;
+  PyObject* out = call_helper("capi_set_training",
+                              Py_BuildValue("(i)", is_training));
+  if (out == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(out));
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXAutogradMarkVariables(int num, NDArrayHandle* var_handles,
+                                   unsigned* reqs_array,
+                                   NDArrayHandle* grad_handles) {
+  Gil gil;
+  PyObject* vars = PyList_New(num);
+  PyObject* reqs = PyList_New(num);
+  PyObject* grads = PyList_New(num);
+  if (vars == nullptr || reqs == nullptr || grads == nullptr) {
+    set_error_from_py();
+    Py_XDECREF(vars);
+    Py_XDECREF(reqs);
+    Py_XDECREF(grads);
+    return -1;
+  }
+  for (int i = 0; i < num; ++i) {
+    PyObject* v = static_cast<PyObject*>(var_handles[i]);
+    PyObject* g = static_cast<PyObject*>(grad_handles[i]);
+    Py_INCREF(v);
+    Py_INCREF(g);
+    PyList_SET_ITEM(vars, i, v);
+    PyList_SET_ITEM(grads, i, g);
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  }
+  PyObject* out = call_helper("capi_mark_variables",
+                              Py_BuildValue("(NNN)", vars, reqs, grads));
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXAutogradBackward(int num_output, NDArrayHandle* output_handles,
+                              NDArrayHandle* ograd_handles,
+                              int retain_graph) {
+  Gil gil;
+  PyObject* outs = PyList_New(num_output);
+  if (outs == nullptr) {
+    set_error_from_py();
+    return -1;
+  }
+  for (int i = 0; i < num_output; ++i) {
+    PyObject* o = static_cast<PyObject*>(output_handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(outs, i, o);
+  }
+  PyObject* ograds = nullptr;
+  if (ograd_handles != nullptr) {
+    ograds = PyList_New(num_output);
+    if (ograds == nullptr) {
+      set_error_from_py();
+      Py_DECREF(outs);
+      return -1;
+    }
+    for (int i = 0; i < num_output; ++i) {
+      PyObject* o = static_cast<PyObject*>(ograd_handles[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(ograds, i, o);
+    }
+  } else {
+    ograds = PyList_New(0);
+  }
+  PyObject* out = call_helper(
+      "capi_backward",
+      Py_BuildValue("(NNi)", outs, ograds, retain_graph));
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+MX_API int MXNDArrayGetGrad(NDArrayHandle h, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* o = call_helper("capi_get_grad",
+                            Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (o == nullptr) return -1;
+  *out = static_cast<NDArrayHandle>(o);
   return 0;
 }

@@ -110,6 +110,105 @@ assert "Activation" in ops and "null" in ops, ops
 check(lib.MXSymbolFree(sh))
 check(lib.MXNDArrayFree(out_h))
 check(lib.MXNDArrayFree(sum_h))
+
+# ---- round-5 extension: context / reshape / slice --------------------------
+devt, devid = ctypes.c_int(), ctypes.c_int()
+check(lib.MXNDArrayGetContext(h, ctypes.byref(devt), ctypes.byref(devid)))
+assert devt.value in (1, 2)
+r_h = ctypes.c_void_p()
+newdims = (ctypes.c_int64 * 2)(3, 2)
+check(lib.MXNDArrayReshape(h, 2, newdims, ctypes.byref(r_h)))
+check(lib.MXNDArrayGetShape(r_h, ctypes.byref(ndim), ctypes.byref(shp)))
+assert (shp[0], shp[1]) == (3, 2)
+s_h = ctypes.c_void_p()
+check(lib.MXNDArraySlice(r_h, 1, 3, ctypes.byref(s_h)))
+check(lib.MXNDArrayGetShape(s_h, ctypes.byref(ndim), ctypes.byref(shp)))
+assert (shp[0], shp[1]) == (2, 2)
+check(lib.MXNDArrayFree(r_h)); check(lib.MXNDArrayFree(s_h))
+
+# ---- save / load round-trip through the ABI --------------------------------
+import tempfile, os as _os
+tmpdir = tempfile.mkdtemp()
+pth = _os.path.join(tmpdir, "c_api.params").encode()
+save_keys = (ctypes.c_char_p * 1)(b"w")
+check(lib.MXNDArraySave(pth, 1, (ctypes.c_void_p * 1)(h), save_keys))
+ln = ctypes.c_int(); larr = ctypes.POINTER(ctypes.c_void_p)()
+nn_ = ctypes.c_int(); lnames = ctypes.POINTER(ctypes.c_char_p)()
+check(lib.MXNDArrayLoad(pth, ctypes.byref(ln), ctypes.byref(larr),
+                        ctypes.byref(nn_), ctypes.byref(lnames)))
+assert ln.value == 1 and nn_.value == 1 and lnames[0] == b"w"
+check(lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(larr[0]), got, 24))
+assert struct.unpack("<6f", got.raw) == tuple(data)
+
+# ---- symbol introspection --------------------------------------------------
+check(lib.MXSymbolCreateFromJSON(json.dumps(graph).encode(), ctypes.byref(sh)))
+check(lib.MXSymbolListArguments(sh, ctypes.byref(n), ctypes.byref(names)))
+assert [names[i] for i in range(n.value)] == [b"x"]
+check(lib.MXSymbolListOutputs(sh, ctypes.byref(n), ctypes.byref(names)))
+assert n.value == 1 and b"act0" in names[0]
+check(lib.MXSymbolFree(sh))
+
+# ---- TRAIN through the ABI: linear regression, no python imports -----------
+# y = x @ w_true; minimize mse by sgd. Everything below is C calls only.
+check(lib.MXRandomSeed(7))
+
+def make(shape_t, fill=None):
+    cshape = (ctypes.c_int64 * len(shape_t))(*shape_t)
+    hh = ctypes.c_void_p()
+    check(lib.MXNDArrayCreate(cshape, len(shape_t), 0, ctypes.byref(hh)))
+    if fill is not None:
+        b = struct.pack("<%df" % len(fill), *fill)
+        check(lib.MXNDArraySyncCopyFromCPU(hh, b, len(b)))
+    return hh
+
+def read(hh, count):
+    b = ctypes.create_string_buffer(4 * count)
+    check(lib.MXNDArraySyncCopyToCPU(hh, b, 4 * count))
+    return struct.unpack("<%df" % count, b.raw)
+
+def invoke(name, handles, **attrs):
+    ni = len(handles)
+    ins_ = (ctypes.c_void_p * ni)(*handles)
+    ks = (ctypes.c_char_p * len(attrs))(*[k.encode() for k in attrs])
+    vs = (ctypes.c_char_p * len(attrs))(*[str(v).encode() for v in attrs.values()])
+    no = ctypes.c_int(); os_ = ctypes.POINTER(ctypes.c_void_p)()
+    check(lib.MXImperativeInvoke(name.encode(), ni, ins_, ctypes.byref(no),
+                                 ctypes.byref(os_), len(attrs), ks, vs))
+    return [ctypes.c_void_p(os_[i]) for i in range(no.value)]
+
+import random
+random.seed(0)
+N, D = 32, 4
+w_true = [1.0, -2.0, 0.5, 3.0]
+xs = [random.uniform(-1, 1) for _ in range(N * D)]
+ys = [sum(xs[i * D + j] * w_true[j] for j in range(D)) for i in range(N)]
+x_h = make((N, D), xs)
+y_h = make((N, 1), ys)
+w_h = make((D, 1), [0.0] * D)
+g_h = make((D, 1), [0.0] * D)
+reqs = (ctypes.c_uint * 1)(1)  # write
+check(lib.MXAutogradMarkVariables(1, (ctypes.c_void_p * 1)(w_h), reqs,
+                                  (ctypes.c_void_p * 1)(g_h)))
+prev = ctypes.c_int()
+for step in range(60):
+    check(lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)))
+    pred, = invoke("dot", [x_h, w_h])
+    err, = invoke("elemwise_sub", [pred, y_h])
+    sq, = invoke("elemwise_mul", [err, err])
+    loss, = invoke("mean", [sq])
+    check(lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)))
+    check(lib.MXAutogradBackward(1, (ctypes.c_void_p * 1)(loss), None, 0))
+    grad = ctypes.c_void_p()
+    check(lib.MXNDArrayGetGrad(w_h, ctypes.byref(grad)))
+    new_w, = invoke("sgd_update", [w_h, grad], lr=0.5)
+    # write the update back into w via the byte path (pure-C client)
+    wb = struct.pack("<%df" % D, *read(new_w, D))
+    check(lib.MXNDArraySyncCopyFromCPU(w_h, wb, len(wb)))
+final_loss = read(loss, 1)[0]
+learned = read(w_h, D)
+assert final_loss < 1e-3, final_loss
+assert all(abs(a - b) < 0.05 for a, b in zip(learned, w_true)), learned
+
 check(lib.MXNDArrayFree(h))
 print("CAPI_CLIENT_OK")
 '''
