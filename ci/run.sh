@@ -27,6 +27,9 @@ build_native() {
   # without this assert a silent HAS_JPEG=0 build skips every native
   # image test and regressions in imgpipe.cc pass green)
   python -c "from mxnet_tpu import lib; assert lib.native_imgpipe() is not None, 'imgpipe (libjpeg) missing from native build'"
+  log "native self-test (engine race stress + shm), plain and ASAN+UBSAN"
+  make -C src check
+  make -C src check-asan
 }
 
 unit() {

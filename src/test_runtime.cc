@@ -1,0 +1,176 @@
+// Native runtime stress harness — built standalone (no Python) so the
+// host runtime can run under AddressSanitizer in CI, the role of the
+// reference's ASAN job (`ci/docker/runtime_functions.sh:432-438`) and of
+// its engine race stress test (`tests/nightly/test_tlocal_racecondition.py`):
+// many producer threads hammer the dependency engine with overlapping
+// read/write variable sets; the var discipline must serialize every write
+// while the final counter values stay exactly deterministic.
+//
+//   make -C src check        # fast native self-test
+//   make -C src check-asan   # same under -fsanitize=address,undefined
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rt_engine_create(int num_threads);
+void rt_engine_destroy(void* e);
+void* rt_engine_new_var(void* e);
+typedef void (*rt_callback)(void* payload);
+void rt_engine_push(void* e, rt_callback fn, void* payload, void** cvars,
+                    int n_const, void** mvars, int n_mut);
+void rt_engine_wait_all(void* e);
+
+void* rt_shm_create(const char* name, uint64_t size);
+void* rt_shm_attach(const char* name);
+void* rt_shm_ptr(void* h);
+uint64_t rt_shm_size(void* h);
+void rt_shm_detach(void* h);
+int rt_shm_unlink(const char* name);
+}
+
+namespace {
+
+int g_failures = 0;
+
+#define CHECK_MSG(cond, msg)                              \
+  do {                                                    \
+    if (!(cond)) {                                        \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__,  \
+                   __LINE__, msg);                        \
+      ++g_failures;                                       \
+    }                                                     \
+  } while (0)
+
+// ---- test 1: write exclusivity + ordering under contention ---------------
+// 8 producer threads each push 500 increments to a shared counter guarded
+// by ONE mutable var. If two increments ever overlap, the non-atomic
+// counter loses updates (and TSAN/ASAN flags the race).
+
+struct IncJob {
+  int64_t* counter;
+  std::atomic<int>* concurrent;
+};
+
+void inc_cb(void* p) {
+  IncJob* j = static_cast<IncJob*>(p);
+  int now = j->concurrent->fetch_add(1) + 1;
+  if (now != 1) {
+    std::fprintf(stderr, "FAIL: %d writers inside one write-var\n", now);
+    ++g_failures;
+  }
+  int64_t v = *j->counter;          // deliberately non-atomic RMW
+  std::this_thread::yield();
+  *j->counter = v + 1;
+  j->concurrent->fetch_sub(1);
+}
+
+void test_write_exclusive() {
+  void* eng = rt_engine_create(4);
+  void* var = rt_engine_new_var(eng);
+  int64_t counter = 0;
+  std::atomic<int> concurrent{0};
+  IncJob job{&counter, &concurrent};
+  const int kThreads = 8, kPer = 500;
+  std::vector<std::thread> producers;
+  for (int t = 0; t < kThreads; ++t) {
+    producers.emplace_back([&]() {
+      void* mv[1] = {var};
+      for (int i = 0; i < kPer; ++i)
+        rt_engine_push(eng, inc_cb, &job, nullptr, 0, mv, 1);
+    });
+  }
+  for (auto& th : producers) th.join();
+  rt_engine_wait_all(eng);
+  CHECK_MSG(counter == kThreads * kPer, "lost increments under write var");
+  rt_engine_destroy(eng);
+}
+
+// ---- test 2: reads concurrent, writes fenced -----------------------------
+// Readers on a var may overlap each other but never a writer.
+
+struct RwJob {
+  std::atomic<int>* readers;
+  std::atomic<int>* writers;
+  std::atomic<int>* max_readers;
+};
+
+void read_cb(void* p) {
+  RwJob* j = static_cast<RwJob*>(p);
+  int r = j->readers->fetch_add(1) + 1;
+  int m = j->max_readers->load();
+  while (r > m && !j->max_readers->compare_exchange_weak(m, r)) {
+  }
+  if (j->writers->load() != 0) {
+    std::fprintf(stderr, "FAIL: reader overlapped a writer\n");
+    ++g_failures;
+  }
+  std::this_thread::yield();
+  j->readers->fetch_sub(1);
+}
+
+void write_cb(void* p) {
+  RwJob* j = static_cast<RwJob*>(p);
+  if (j->writers->fetch_add(1) != 0 || j->readers->load() != 0) {
+    std::fprintf(stderr, "FAIL: writer overlapped reader/writer\n");
+    ++g_failures;
+  }
+  std::this_thread::yield();
+  j->writers->fetch_sub(1);
+}
+
+void test_readers_writers() {
+  void* eng = rt_engine_create(4);
+  void* var = rt_engine_new_var(eng);
+  std::atomic<int> readers{0}, writers{0}, max_readers{0};
+  RwJob job{&readers, &writers, &max_readers};
+  void* cv[1] = {var};
+  void* mv[1] = {var};
+  for (int round = 0; round < 200; ++round) {
+    for (int r = 0; r < 4; ++r)
+      rt_engine_push(eng, read_cb, &job, cv, 1, nullptr, 0);
+    rt_engine_push(eng, write_cb, &job, nullptr, 0, mv, 1);
+  }
+  rt_engine_wait_all(eng);
+  CHECK_MSG(max_readers.load() >= 2, "reads never ran concurrently");
+  rt_engine_destroy(eng);
+}
+
+// ---- test 3: shm arena round trip + unlink -------------------------------
+
+void test_shm_arena() {
+  const char* name = "/rt_selftest_seg";
+  void* w = rt_shm_create(name, 4096);
+  CHECK_MSG(w != nullptr, "shm create failed");
+  if (w == nullptr) return;
+  std::memset(rt_shm_ptr(w), 0x5a, 4096);
+  void* r = rt_shm_attach(name);
+  CHECK_MSG(r != nullptr, "shm attach failed");
+  if (r != nullptr) {
+    CHECK_MSG(rt_shm_size(r) == 4096, "shm size mismatch");
+    CHECK_MSG(static_cast<unsigned char*>(rt_shm_ptr(r))[4095] == 0x5a,
+              "shm content mismatch");
+    rt_shm_detach(r);
+  }
+  rt_shm_detach(w);
+  CHECK_MSG(rt_shm_unlink(name) == 0, "shm unlink failed");
+}
+
+}  // namespace
+
+int main() {
+  test_write_exclusive();
+  test_readers_writers();
+  test_shm_arena();
+  if (g_failures != 0) {
+    std::fprintf(stderr, "%d native runtime check(s) FAILED\n", g_failures);
+    return 1;
+  }
+  std::printf("native runtime self-test OK\n");
+  return 0;
+}
