@@ -31,7 +31,18 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
 sys.path.insert(0, os.path.join(REPO, "tests", "python", "unittest"))
 
 _DRIVER = r"""
-import pickle, sys
+import os, pickle, sys
+# honour JAX_PLATFORMS even though sitecustomize imports jax first
+# (config.update still wins as long as no backend has initialised --
+# same dance as tests/conftest.py; with the axon relay wedged the env
+# var alone no longer suffices)
+_plat = os.environ.get('JAX_PLATFORMS')
+if _plat:
+    import jax
+    try:
+        jax.config.update('jax_platforms', _plat)
+    except Exception:
+        pass
 import numpy as np
 sys.path.insert(0, {repo!r})
 sys.path.insert(0, {unittest_dir!r})
@@ -45,6 +56,51 @@ for name, (inputs, attrs) in cases.items():
         res, _ = C._run_op(name, inputs, attrs)
         res_np = C._to_np(res)
         out[name] = res_np if not isinstance(res_np, list) else list(res_np)
+    except Exception as e:  # noqa: BLE001
+        out[name] = f"ERROR: {{e}}"
+with open({outp!r}, "wb") as f:
+    pickle.dump(out, f)
+print("DONE", len(out))
+"""
+
+# gradient leg: compute d sum(op(x)) / dx0 on the accelerator via the
+# autograd tape (the reference GPU corpus reruns backward too)
+_GRAD_DRIVER = r"""
+import os, pickle, sys
+# honour JAX_PLATFORMS even though sitecustomize imports jax first
+# (config.update still wins as long as no backend has initialised --
+# same dance as tests/conftest.py; with the axon relay wedged the env
+# var alone no longer suffices)
+_plat = os.environ.get('JAX_PLATFORMS')
+if _plat:
+    import jax
+    try:
+        jax.config.update('jax_platforms', _plat)
+    except Exception:
+        pass
+import numpy as np
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {unittest_dir!r})
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray.register import invoke_nd
+
+with open({inp!r}, "rb") as f:
+    cases = pickle.load(f)
+out = {{}}
+for name, (inputs, attrs) in cases.items():
+    try:
+        x0 = mx.nd.array(inputs[0])
+        rest = [mx.nd.array(a) if isinstance(a, np.ndarray) else a
+                for a in inputs[1:]]
+        x0.attach_grad()
+        with autograd.record():
+            res = invoke_nd(name, x0, *rest, **attrs)
+            if isinstance(res, (list, tuple)):
+                res = res[0]
+            loss = res.sum()
+        loss.backward()
+        out[name] = x0.grad.asnumpy()
     except Exception as e:  # noqa: BLE001
         out[name] = f"ERROR: {{e}}"
 with open({outp!r}, "wb") as f:
@@ -74,6 +130,9 @@ def test_op_forward_consistency_cpu_vs_tpu():
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)   # default accelerator backend
         env.pop("XLA_FLAGS", None)
+        if os.environ.get("MXNET_TEST_TPU_PLATFORM"):
+            # harness dry-run without a chip (mechanics only)
+            env["JAX_PLATFORMS"] = os.environ["MXNET_TEST_TPU_PLATFORM"]
         proc = subprocess.run([sys.executable, "-c", driver],
                               capture_output=True, text=True, env=env,
                               cwd=REPO, timeout=3600)
@@ -107,4 +166,69 @@ def test_op_forward_consistency_cpu_vs_tpu():
             failures.append(f"{name}: {str(e).splitlines()[0]}")
     assert not failures, \
         f"{len(failures)} ops diverge on the accelerator:\n" + \
+        "\n".join(failures[:20])
+
+
+def test_op_gradient_consistency_cpu_vs_tpu():
+    """Gradient leg of the cross-backend sweep (round-5; the reference's
+    GPU corpus reruns backward as well): for every grad-enabled Spec,
+    d sum(op(x))/dx computed on the accelerator must match the same
+    quantity computed on CPU."""
+    import test_op_coverage as C
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray.register import invoke_nd
+    import mxnet_tpu as mx
+
+    cases = {name: (spec.inputs, spec.attrs)
+             for name, spec in C._spec_cases() if spec.grad}
+
+    # CPU oracle via the same tape
+    cpu_grads = {}
+    for name, (inputs, attrs) in cases.items():
+        x0 = mx.nd.array(inputs[0])
+        rest = [mx.nd.array(a) if isinstance(a, np.ndarray) else a
+                for a in inputs[1:]]
+        x0.attach_grad()
+        with autograd.record():
+            res = invoke_nd(name, x0, *rest, **attrs)
+            if isinstance(res, (list, tuple)):
+                res = res[0]
+            loss = res.sum()
+        loss.backward()
+        cpu_grads[name] = x0.grad.asnumpy()
+
+    with tempfile.TemporaryDirectory() as td:
+        inp = os.path.join(td, "cases.pkl")
+        outp = os.path.join(td, "out.pkl")
+        with open(inp, "wb") as f:
+            pickle.dump(cases, f)
+        driver = _GRAD_DRIVER.format(
+            repo=REPO,
+            unittest_dir=os.path.join(REPO, "tests", "python", "unittest"),
+            inp=inp, outp=outp)
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        if os.environ.get("MXNET_TEST_TPU_PLATFORM"):
+            # harness dry-run without a chip (mechanics only)
+            env["JAX_PLATFORMS"] = os.environ["MXNET_TEST_TPU_PLATFORM"]
+        proc = subprocess.run([sys.executable, "-c", driver],
+                              capture_output=True, text=True, env=env,
+                              cwd=REPO, timeout=3600)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        with open(outp, "rb") as f:
+            tpu_grads = pickle.load(f)
+
+    failures = []
+    for name, cg in sorted(cpu_grads.items()):
+        tg = tpu_grads.get(name)
+        if isinstance(tg, str):
+            failures.append(f"{name}: {tg}")
+            continue
+        try:
+            np.testing.assert_allclose(tg, cg, rtol=1e-2, atol=1e-3)
+        except AssertionError as e:
+            failures.append(f"{name}: {str(e).splitlines()[0]}")
+    assert not failures, \
+        f"{len(failures)} op GRADIENTS diverge on the accelerator:\n" + \
         "\n".join(failures[:20])
