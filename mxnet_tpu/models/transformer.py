@@ -53,6 +53,21 @@ class TransformerLMConfig:
     moe_aux_loss: float = 0.01
 
 
+def _use_pallas_attention():
+    """Fused flash kernel policy: ON by default on the TPU backend, OFF
+    elsewhere (the interpret path is a debugging tool, not a CPU win);
+    MXNET_PALLAS_ATTENTION=0/1 overrides either way."""
+    import os
+
+    flag = os.environ.get("MXNET_PALLAS_ATTENTION")
+    if flag is not None:
+        return flag == "1"
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def _spec(mesh, *axes):
     return NamedSharding(mesh, P(*[a if (a in mesh.shape and mesh.shape[a] > 1) else None
                                    for a in axes]))
@@ -194,6 +209,22 @@ class TransformerLM:
             fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
             return fn(q, k, v)
+        if _use_pallas_attention():
+            # fused VMEM-resident flash kernel (ops/pallas_attention.py):
+            # QK^T -> streaming softmax -> PV without the HBM round trip.
+            # Falls through to the XLA blockwise path on shapes the kernel
+            # does not tile.
+            try:
+                import os
+
+                from ..ops.pallas_attention import flash_attention
+
+                return flash_attention(
+                    q, k, v, causal=c.causal,
+                    interpret=os.environ.get(
+                        "MXNET_PALLAS_INTERPRET") == "1")
+            except (ValueError, RuntimeError):
+                pass
         from ..parallel.ring_attention import _block_attn, _bhql_to_bqhl, _full_causal_bias
         bias = _full_causal_bias(q.shape[1], k.shape[1]) if c.causal else None
         o, m, l = _block_attn(q, k, v, bias)

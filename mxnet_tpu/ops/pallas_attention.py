@@ -1,0 +1,158 @@
+"""Fused flash-attention Pallas kernel (beyond-parity TPU perf item).
+
+The transformer's local attention (`models/transformer.py._attention` →
+`parallel/ring_attention._block_attn`) is already streaming-softmax at the
+XLA level, but the S = QK^T logits still round-trip HBM between the two
+einsums. This kernel keeps the whole Q-block pipeline — QK^T, running
+max/sum-exp, PV accumulation — resident in VMEM (the flash-attention
+schedule; see /opt/skills/guides/pallas_guide.md), one grid step per
+(batch*head, q-block).
+
+Backward: `jax.custom_vjp` whose pullback is the vjp of the plain-XLA
+reference attention (recompute; exact same math, so gradients agree with
+the fused forward bit-for-bit up to reassociation). That is the standard
+"fast forward, recomputed backward" pattern — the backward stays one fused
+XLA program.
+
+Availability: TPU (or `interpret=True` anywhere — the CPU test path).
+`flash_attention` raises on shapes not divisible by the block sizes;
+callers (transformer) fall back to the XLA blockwise path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas ships with jax on TPU builds; guard for minimal CPU images
+    from jax.experimental import pallas as pl
+    HAVE_PALLAS = True
+except Exception:  # noqa: BLE001
+    pl = None
+    HAVE_PALLAS = False
+
+__all__ = ["flash_attention", "reference_attention", "HAVE_PALLAS"]
+
+_NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal=False, scale=None):
+    """Plain-XLA exact attention, fp32 softmax — the numerics contract the
+    kernel must reproduce (and the recomputed backward). Layout
+    [B, L, H, D] (the transformer's)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
+                block_k, seq_k):
+    """One (batch*head, q-block) grid step: stream every K/V block through
+    VMEM with the running-softmax update."""
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    nk = seq_k // block_k
+
+    def body(i, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    d = q.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, l = lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    # [B, L, H, D] -> [B*H, L, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, seq_k=lk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, lq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fa(scale, causal, block_q, block_k, interpret):
+    @jax.custom_vjp
+    def fa(q, k, v):
+        return _pallas_forward(q, k, v, scale, causal, block_q, block_k,
+                               interpret)
+
+    def fwd(q, k, v):
+        return fa(q, k, v), (q, k, v)
+
+    def bwd(res, do):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal,
+                                                   scale=scale), q, k, v)
+        return vjp(do)
+
+    fa.defvjp(fwd, bwd)
+    return fa
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """Fused attention over [B, L, H, D] tensors.
+
+    block sizes clamp to the sequence lengths; raises ValueError when the
+    lengths are not divisible by the (clamped) blocks — the caller keeps
+    the XLA blockwise path for such shapes."""
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable in this jax build")
+    lq, lk = q.shape[1], k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"flash_attention: seq lengths ({lq}, {lk}) not divisible by "
+            f"blocks ({block_q}, {block_k})")
+    scale = float(scale if scale is not None else 1.0 / math.sqrt(q.shape[-1]))
+    fn = _make_fa(scale, bool(causal), int(block_q), int(block_k),
+                  bool(interpret))
+    return fn(q, k, v)
