@@ -107,3 +107,13 @@ def test_train_imagenet_sweepable():
                timeout=500)
     speed = float(out.strip().splitlines()[-1].split(":")[1])
     assert speed > 0, out[-500:]
+
+
+@pytest.mark.slow
+def test_dcgan_example():
+    """Adversarial loop (reference example/gluon/dc_gan): alternating
+    D/G updates with two Trainers; after a few epochs the discriminator
+    must separate real from fake."""
+    out = _run("gluon/dcgan.py", "--epochs", "3", timeout=650)
+    margin = float(out.strip().splitlines()[-1].split(":")[1])
+    assert margin > 0.15, out[-500:]
