@@ -221,9 +221,23 @@ _SCALAR = {
     "_scatter_minus_scalar": lambda x, s: x - s,
 }
 
+def _scalar_operand(x, scalar):
+    """The reference parses the scalar AS the array's dtype
+    (`elemwise_binary_scalar_op.h` DType conversion): integer arrays keep
+    integer arithmetic (int64 + 1 stays int64 — the large-tensor build
+    depends on it) and a fractional scalar truncates, exactly as C++
+    static_cast<DType> does. Float arrays keep the python float (weak
+    typing preserves bf16/f16/f32)."""
+    s = float(scalar)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return jnp.asarray(int(s), x.dtype)
+    return s
+
+
 for _name, _f in _SCALAR.items():
     register(_name)(
-        (lambda f: lambda x, scalar=0.0, **kw: f(x, float(scalar)))(_f)
+        (lambda f: lambda x, scalar=0.0, **kw: f(
+            x, _scalar_operand(x, scalar)))(_f)
     )
 
 

@@ -1,0 +1,55 @@
+"""The large-tensor (int64) build rendering: the reference ships an
+optional int64 build (`USE_INT64_TENSOR_SIZE`); here the same contract is
+jax x64 (`mxnet_tpu/base.py` np_dtype docs). Runs in a SUBPROCESS because
+x64 is a process-wide jax flag the CPU suite must not inherit."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+DRIVER = r"""
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', True)
+import numpy as np
+import mxnet_tpu as mx
+
+# int64 survives end to end
+big = (np.int64(1) << 40) + 7
+a = mx.nd.array(np.array([big, big + 1], np.int64), dtype='int64')
+assert a.dtype == np.int64, a.dtype
+out = a + 1
+got = out.asnumpy()
+assert got.dtype == np.int64
+assert got[0] == big + 1 and got[1] == big + 2, got
+
+# DGL edge ids above 2^31 exact through the CSR frontend
+data = np.array([big, big + 1], np.int64)
+indices = np.array([1, 0], np.int64)
+indptr = np.array([0, 1, 2], np.int64)
+csr = mx.nd.sparse.csr_matrix((data, indices, indptr), shape=(2, 2))
+u = mx.nd.array(np.array([0, 1], np.int64), dtype='int64')
+v = mx.nd.array(np.array([1, 0], np.int64), dtype='int64')
+eid = mx.nd.contrib.edge_id(csr, u, v).asnumpy()
+assert eid.dtype == np.int64 and eid[0] == big and eid[1] == big + 1, eid
+
+# float64 compute path
+x = mx.nd.array(np.ones((4, 4)), dtype='float64')
+y = mx.nd.dot(x, x)
+assert y.dtype == np.float64 and float(y.asnumpy()[0, 0]) == 4.0
+print('X64_OK')
+"""
+
+
+def test_int64_large_tensor_mode():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "-c", DRIVER], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "X64_OK" in out.stdout
