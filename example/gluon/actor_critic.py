@@ -1,0 +1,137 @@
+"""Actor-critic policy gradient (parity: `example/gluon/actor_critic.py` —
+the REINFORCE-with-value-baseline loop: one shared trunk, policy + value
+heads, discounted returns, log-prob * advantage loss under autograd).
+
+A gym-free corridor environment stands in for CartPole (zero-egress): the
+agent starts mid-corridor, +1 reward for reaching the right end, -1 for
+the left, small step penalty — the optimal policy is "always right" and
+mean episode return must climb toward +1.
+
+  JAX_PLATFORMS=cpu python example/gluon/actor_critic.py --episodes 150
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Block, Trainer, nn
+
+logging.basicConfig(level=logging.INFO)
+
+parser = argparse.ArgumentParser(
+    description="actor-critic on a corridor MDP",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--episodes", type=int, default=150)
+parser.add_argument("--corridor", type=int, default=7)
+parser.add_argument("--gamma", type=float, default=0.95)
+parser.add_argument("--lr", type=float, default=0.02)
+parser.add_argument("--log-every", type=int, default=25)
+parser.add_argument("--seed", type=int, default=0)
+
+
+class Corridor:
+    """Positions 0..n-1; start in the middle; episode ends at either end.
+    Reward +1 at the right end, -1 at the left, -0.02 per step."""
+
+    def __init__(self, n):
+        self.n = n
+        self.pos = 0
+
+    def reset(self):
+        self.pos = self.n // 2
+        return self._obs()
+
+    def _obs(self):
+        one_hot = np.zeros(self.n, np.float32)
+        one_hot[self.pos] = 1.0
+        return one_hot
+
+    def step(self, action):  # 0 = left, 1 = right
+        self.pos += 1 if action == 1 else -1
+        if self.pos <= 0:
+            return self._obs(), -1.0, True
+        if self.pos >= self.n - 1:
+            return self._obs(), 1.0, True
+        return self._obs(), -0.02, False
+
+
+class ActorCritic(Block):
+    def __init__(self, n_obs, n_actions, hidden=32, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.trunk = nn.Dense(hidden, activation="relu",
+                                  in_units=n_obs)
+            self.policy = nn.Dense(n_actions, in_units=hidden)
+            self.value = nn.Dense(1, in_units=hidden)
+
+    def forward(self, x):
+        h = self.trunk(x)
+        return self.policy(h), self.value(h)
+
+
+def main():
+    args = parser.parse_args()
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+    env = Corridor(args.corridor)
+    net = ActorCritic(args.corridor, 2)
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+
+    returns_hist = []
+    for ep in range(args.episodes):
+        obs = env.reset()
+        observations, actions, rewards = [], [], []
+        done = False
+        steps = 0
+        while not done and steps < 4 * args.corridor:
+            logits, _ = net(mx.nd.array(obs[None]))
+            probs = logits.softmax().asnumpy()[0]
+            action = int(rng.choice(2, p=probs / probs.sum()))
+            observations.append(obs)
+            actions.append(action)
+            obs, r, done = env.step(action)
+            rewards.append(r)
+            steps += 1
+
+        # discounted returns
+        G, disc = [], 0.0
+        for r in reversed(rewards):
+            disc = r + args.gamma * disc
+            G.append(disc)
+        G = np.array(G[::-1], np.float32)
+        returns_hist.append(float(sum(rewards)))
+
+        obs_b = mx.nd.array(np.stack(observations))
+        act_b = mx.nd.array(np.array(actions, np.float32))
+        ret_b = mx.nd.array(G)
+        with autograd.record():
+            logits, values = net(obs_b)
+            values = values.reshape((-1,))
+            logp = (logits.log_softmax() *
+                    mx.nd.one_hot(act_b, 2)).sum(axis=1)
+            advantage = (ret_b - values).detach()
+            policy_loss = -(logp * advantage).sum()
+            value_loss = ((values - ret_b) ** 2).sum()
+            loss = policy_loss + 0.5 * value_loss
+        loss.backward()
+        trainer.step(len(actions))
+
+        if (ep + 1) % args.log_every == 0:
+            recent = np.mean(returns_hist[-args.log_every:])
+            logging.info("episode %d: mean return %.3f", ep + 1, recent)
+
+    final = float(np.mean(returns_hist[-25:]))
+    print(f"mean-return-last25:{final:.4f}")
+
+
+if __name__ == "__main__":
+    main()

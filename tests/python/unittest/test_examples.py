@@ -117,3 +117,13 @@ def test_dcgan_example():
     out = _run("gluon/dcgan.py", "--epochs", "3", timeout=650)
     margin = float(out.strip().splitlines()[-1].split(":")[1])
     assert margin > 0.15, out[-500:]
+
+
+@pytest.mark.slow
+def test_actor_critic_example():
+    """Policy-gradient loop (reference example/gluon/actor_critic.py):
+    REINFORCE + value baseline must learn the corridor's optimal policy
+    (mean return -> ~ +0.96 = goal reward minus step penalties)."""
+    out = _run("gluon/actor_critic.py", "--episodes", "150", timeout=550)
+    ret = float(out.strip().splitlines()[-1].split(":")[1])
+    assert ret > 0.7, out[-500:]
