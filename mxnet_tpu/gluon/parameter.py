@@ -153,9 +153,23 @@ class Parameter:
             if data is None:
                 data = nd.zeros(self._shape, dtype=self.dtype, ctx=cpu())
                 # `init` was resolved in initialize(): explicit arg > param.init
-                # > default_init (reference parameter.py _finish_deferred_init)
+                # > default_init (reference parameter.py _finish_deferred_init).
+                # A param-specific init rides the InitDesc `__init__` attr so
+                # it applies REGARDLESS of the name suffix (the reference's
+                # mechanism — a custom-named param like a CRF transition
+                # matrix must not hit the weight/bias pattern fallback).
+                attrs = {}
+                if self.init is not None:
+                    init_obj = initializer.create(self.init)
+                    # the attr route is a dumps/loads round trip, so only
+                    # REGISTERED initializer classes can ride it; ad-hoc
+                    # ones (Constant's closure Init) already bypass the
+                    # suffix dispatch themselves
+                    if type(init_obj).__name__.lower() in \
+                            initializer._INIT_REGISTRY:
+                        attrs["__init__"] = init_obj.dumps()
                 initializer.create(init if init is not None else default_init)(
-                    initializer.InitDesc(self.name), data)
+                    initializer.InitDesc(self.name, attrs), data)
             self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):

@@ -421,6 +421,15 @@ class NDArray:
 
     def __getitem__(self, key):
         key = _convert_index(key)
+        from .. import autograd
+
+        if autograd.is_recording():
+            # recorded read: gradients must flow through slicing
+            # (`ops/indexing._ag_getitem`; scatter-add back into the
+            # source's cotangent via jax's gather vjp)
+            from .register import invoke_nd
+
+            return invoke_nd("_ag_getitem", self, key=(key,))
         out = self._data[key]
         return NDArray(out, self._ctx)
 

@@ -261,3 +261,15 @@ def _sparse_embedding(data, weight, input_dim=None, output_dim=None,
     precursor of Embedding(sparse_grad=True); same forward gather."""
     idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
     return jnp.take(weight, idx, axis=0)
+
+
+@register("_ag_getitem", eager_only=True)
+def _ag_getitem(x, key=((),), **kw):
+    """Recorded basic/advanced indexing — the op behind
+    `NDArray.__getitem__` inside `autograd.record` (the reference records
+    slicing through its `slice`/`gather_nd` lowering,
+    `python/mxnet/ndarray/ndarray.py _get_nd_basic_indexing`): without a
+    tape node, `x[...]` inside a recorded region would silently BLOCK
+    gradients. The (static) key rides wrapped in a 1-tuple attr;
+    eager_only => differentiable in the data input, key closed over."""
+    return x[key[0]]

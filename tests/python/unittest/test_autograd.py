@@ -147,3 +147,33 @@ def test_mark_variables():
         y = (x * 5).sum()
     y.backward()
     assert_close(g, np.array([5.0, 5.0]))
+
+
+def test_getitem_gradients_inside_record():
+    """`x[...]` inside record is a tape node (`_ag_getitem`): gradients
+    scatter back into the source — the reference records slicing too
+    (`ndarray.py _get_nd_basic_indexing`). A CRF-style loop of per-step
+    slices must deliver grads to every parameter it touches."""
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        loss = (x[1] * x[1]).sum() + x[:, 2].sum() + x[2, 3] * 10
+    loss.backward()
+    expect = np.zeros((3, 4), np.float32)
+    expect[1] = 2 * np.arange(4, 8)
+    expect[:, 2] += 1
+    expect[2, 3] += 10
+    np.testing.assert_allclose(x.grad.asnumpy(), expect)
+
+
+def test_getitem_advanced_index_gradients():
+    x = mx.nd.array(np.arange(10, dtype=np.float32))
+    x.attach_grad()
+    idx = mx.nd.array(np.array([1, 3, 3], np.float32))
+    with autograd.record():
+        loss = x[idx].sum()
+    loss.backward()
+    expect = np.zeros(10, np.float32)
+    expect[1] = 1
+    expect[3] = 2
+    np.testing.assert_allclose(x.grad.asnumpy(), expect)

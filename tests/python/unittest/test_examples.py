@@ -127,3 +127,16 @@ def test_actor_critic_example():
     out = _run("gluon/actor_critic.py", "--episodes", "150", timeout=550)
     ret = float(out.strip().splitlines()[-1].split(":")[1])
     assert ret > 0.7, out[-500:]
+
+
+@pytest.mark.slow
+def test_lstm_crf_example():
+    """BiLSTM-CRF (reference example/gluon/lstm_crf): forward-algorithm
+    NLL + viterbi decode; the span structure is only learnable through
+    the transition matrix, so perfect val accuracy proves the CRF part."""
+    out = _run("gluon/lstm_crf.py", "--epochs", "10", timeout=650)
+    lines = out.strip().splitlines()
+    acc = float(lines[-2].split(":")[1])
+    trans_margin = float(lines[-1].split(":")[1])
+    assert acc > 0.97, out[-500:]
+    assert trans_margin > 0.1, trans_margin  # I-after-B >> I-after-O

@@ -906,6 +906,8 @@ COVERED_ELSEWHERE = {
     "_contrib_SyncBatchNorm": "test_gluon.py",
     "Dropout": "test_gluon.py",
     "arange_like": "test_operator.py", "contrib_arange_like": "test_operator.py",
+    # recorded __getitem__ (gradient-through-slicing) — test_autograd.py
+    "_ag_getitem": "test_autograd.py",
     # DGL graph family + cv codecs + sparse embedding — test_graph_image_ops.py
     "_contrib_dgl_adjacency": "test_graph_image_ops.py",
     "contrib_dgl_adjacency": "test_graph_image_ops.py",
