@@ -156,3 +156,150 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     fn = _make_fa(scale, bool(causal), int(block_q), int(block_k),
                   bool(interpret))
     return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Streaming-partial variant for RING attention (`parallel/ring_attention.py`):
+# one ring hop computes this Q-block x local-K/V-block partial — the fused
+# kernel returns the UNNORMALIZED (o, m, l) triple the ring's streaming
+# combine consumes, so each hop's QK^T/softmax/PV stays in VMEM while K/V
+# circulate the ICI ring around it.
+# ---------------------------------------------------------------------------
+
+
+def _partial_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref, *,
+                    scale, block_k, seq_k):
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    nk = seq_k // block_k
+
+    def body(i, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = s + bias_ref[0, pl.dslice(i * block_k, block_k)].astype(
+            jnp.float32).T
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    d = q.shape[-1]
+    acc0 = jnp.zeros((q.shape[0], d), jnp.float32)
+    m0 = jnp.full((q.shape[0], 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], 1), jnp.float32)
+    acc, m, l = lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    o_ref[0] = acc.astype(o_ref.dtype)
+    m_ref[0] = m
+    l_ref[0] = l
+
+
+def flash_block_partials(q, k, v, bias=None, scale=None, block_q=128,
+                         block_k=128, interpret=False):
+    """Fused partial attention over [B, L, H, D]: returns the
+    `(o, m, l)` triple with `_block_attn`'s exact contract
+    (o = exp(s - m) @ v UNNORMALIZED, m row max, l row sum-exp; all
+    fp32 stats, o in q.dtype; `bias` is the ring's additive [*, *, Lq, Lk]
+    mask). Raises ValueError on shapes the kernel does not tile."""
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable in this jax build")
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(f"flash_block_partials: ({lq}, {lk}) not divisible "
+                         f"by blocks ({block_q}, {block_k})")
+    scale = float(scale if scale is not None else 1.0 / math.sqrt(d))
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    if bias is None:
+        bias_f = jnp.zeros((1, lq, lk), jnp.float32)
+    else:
+        bias_f = jnp.broadcast_to(
+            jnp.asarray(bias, jnp.float32).reshape(-1, lq, lk)[-1:],
+            (1, lq, lk))
+    kernel = functools.partial(_partial_kernel, scale=scale,
+                               block_k=block_k, seq_k=lk)
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=(b * h, lq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
+            # bias blocked over q rows, transposed inside ((Lk, bq) slices)
+            pl.BlockSpec((1, lk, block_q),
+                         lambda i, j: (0, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, lq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, jnp.swapaxes(bias_f, 1, 2))
+    o = o.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    m = m.reshape(b, h, lq, 1)
+    l = l.reshape(b, h, lq, 1)
+    return o, m, l
+
+
+@functools.lru_cache(maxsize=None)
+def _make_partials_vjp(scale, block_q, block_k, interpret):
+    """Differentiable partials: forward is the fused kernel, backward is
+    the vjp of the plain-XLA `_block_attn` (same math recomputed) — the
+    ring loop stays end-to-end differentiable with the kernel inside."""
+    from ..parallel.ring_attention import _block_attn
+
+    @jax.custom_vjp
+    def partials(q, k, v, bias):
+        return flash_block_partials(q, k, v, bias=bias, scale=scale,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=interpret)
+
+    def fwd(q, k, v, bias):
+        return partials(q, k, v, bias), (q, k, v, bias)
+
+    def bwd(res, cts):
+        q, k, v, bias = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _block_attn(q_, k_, v_, bias, scale), q, k, v)
+        dq, dk, dv = vjp(cts)
+        return dq, dk, dv, jnp.zeros_like(bias)
+
+    partials.defvjp(fwd, bwd)
+    return partials
+
+
+def _divisor_block(n, target=128):
+    """Largest block <= target that divides n (power-of-two seq lengths
+    get the full target; anything else still tiles exactly)."""
+    b = min(target, n)
+    while n % b:
+        b -= 1
+    return b
+
+
+def block_partials_pallas(q, k, v, bias, scale, block_q=128, block_k=128,
+                          interpret=False):
+    """Ring-hop entry point: `_block_attn`'s contract with the fused
+    kernel forward and an exact recomputed backward. `bias` may be None."""
+    if bias is None:
+        bias = jnp.zeros((1, 1, q.shape[1], k.shape[1]), jnp.float32)
+    fn = _make_partials_vjp(float(scale),
+                            _divisor_block(q.shape[1], block_q),
+                            _divisor_block(k.shape[1], block_k),
+                            bool(interpret))
+    return fn(q, k, v, bias)

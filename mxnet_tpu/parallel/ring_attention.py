@@ -69,6 +69,34 @@ def _bhql_to_bqhl(x):
     return jnp.transpose(x, (0, 2, 1, 3))
 
 
+def _hop_fn(scale):
+    """Per-hop block attention: the fused Pallas kernel on the TPU backend
+    (VMEM-resident QK^T/softmax/PV while K/V ride the ICI ring; exact
+    recomputed backward), the XLA blockwise path elsewhere. Same policy
+    knobs as the transformer's local attention (MXNET_PALLAS_ATTENTION /
+    MXNET_PALLAS_INTERPRET)."""
+    import os
+
+    flag = os.environ.get("MXNET_PALLAS_ATTENTION")
+    if flag is not None:
+        enabled = flag == "1"
+    else:
+        try:
+            enabled = jax.default_backend() == "tpu"
+        except Exception:  # noqa: BLE001
+            enabled = False
+    if enabled:
+        try:
+            from ..ops.pallas_attention import block_partials_pallas
+
+            interpret = os.environ.get("MXNET_PALLAS_INTERPRET") == "1"
+            return lambda q, k, v, bias: block_partials_pallas(
+                q, k, v, bias, scale, interpret=interpret)
+        except Exception:  # noqa: BLE001 — pallas unavailable
+            pass
+    return lambda q, k, v, bias: _block_attn(q, k, v, bias, scale)
+
+
 def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None,
                    q_offset=None):
     """Exact attention where K/V circulate the 'sp' ring.
@@ -97,14 +125,16 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None,
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    o, m, l = _block_attn(q, k, v, bias_for(my_idx), scale)
+    block = _hop_fn(scale)
+
+    o, m, l = block(q, k, v, bias_for(my_idx))
 
     def body(i, carry):
         o, m, l, k, v = carry
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         kv_idx = (my_idx - i - 1) % axis_size
-        o2, m2, l2 = _block_attn(q, k, v, bias_for(kv_idx), scale)
+        o2, m2, l2 = block(q, k, v, bias_for(kv_idx))
         o, m, l = _combine(o, m, l, o2, m2, l2)
         return o, m, l, k, v
 
