@@ -159,8 +159,12 @@ class Parameter:
                 # mechanism — a custom-named param like a CRF transition
                 # matrix must not hit the weight/bias pattern fallback).
                 attrs = {}
-                if self.init is not None:
-                    init_obj = initializer.create(self.init)
+                # attrs ride the RESOLVED initializer (explicit arg >
+                # param.init — resolved in initialize()), never self.init
+                # directly, or an explicit initialize(init=...) would lose
+                # to the stored one
+                if init is not None:
+                    init_obj = initializer.create(init)
                     # the attr route is a dumps/loads round trip, so only
                     # REGISTERED initializer classes can ride it; ad-hoc
                     # ones (Constant's closure Init) already bypass the

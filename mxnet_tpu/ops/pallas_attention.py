@@ -146,6 +146,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     if not HAVE_PALLAS:
         raise RuntimeError("pallas unavailable in this jax build")
     lq, lk = q.shape[1], k.shape[1]
+    if causal and lq != lk:
+        # the kernel's causal mask assumes aligned self-attention
+        # positions; the XLA reference aligns sequence ENDS for lq != lk —
+        # callers keep the XLA path for cross-length causal attention
+        raise ValueError(
+            f"flash_attention: causal requires lq == lk, got ({lq}, {lk})")
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     if lq % block_q or lk % block_k:
@@ -222,9 +228,15 @@ def flash_block_partials(q, k, v, bias=None, scale=None, block_q=128,
     if bias is None:
         bias_f = jnp.zeros((1, lq, lk), jnp.float32)
     else:
-        bias_f = jnp.broadcast_to(
-            jnp.asarray(bias, jnp.float32).reshape(-1, lq, lk)[-1:],
-            (1, lq, lk))
+        bias = jnp.asarray(bias, jnp.float32)
+        if bias.size != lq * lk:
+            # the kernel shares ONE (Lq, Lk) bias across batch/heads (the
+            # ring's mask shape); silently collapsing a per-head bias
+            # would be wrong — callers fall back to the XLA path instead
+            raise ValueError(
+                f"flash_block_partials: bias shape {bias.shape} is not a "
+                f"broadcastable ({lq}, {lk}) mask")
+        bias_f = bias.reshape(1, lq, lk)
     kernel = functools.partial(_partial_kernel, scale=scale,
                                block_k=block_k, seq_k=lk)
     o, m, l = pl.pallas_call(

@@ -291,3 +291,16 @@ def test_constant_parameter():
     with autograd.record():
         out = net(mx.nd.zeros((2, 4)))
     out.backward()  # constant gets no grad; must not raise
+
+
+def test_explicit_initialize_overrides_param_init():
+    """Precedence: explicit Parameter.initialize(init=...) > param.init >
+    default (reference parameter.py)."""
+    from mxnet_tpu.gluon import Parameter
+
+    p = Parameter("anyname_weight", shape=(64,), init=mx.init.Zero())
+    p.initialize(init=mx.init.One())
+    np.testing.assert_allclose(p.data().asnumpy(), 1.0)
+    p2 = Parameter("custom_transitions", shape=(64,), init=mx.init.One())
+    p2.initialize()  # param-specific init despite the unknown suffix
+    np.testing.assert_allclose(p2.data().asnumpy(), 1.0)

@@ -168,3 +168,24 @@ def test_ring_attention_with_pallas_hops(monkeypatch):
         out_pl = np.asarray(ring_self_attention(q, k, v, mesh=mesh,
                                                 causal=True))
     np.testing.assert_allclose(out_pl, out_xla, rtol=1e-4, atol=1e-5)
+
+
+@pallas
+def test_flash_causal_cross_length_rejected():
+    """Causal with lq != lk aligns sequence ENDS in the XLA reference; the
+    kernel's aligned-position mask would differ, so it must refuse and
+    let callers keep the XLA path."""
+    q, _, _ = _qkv(l=32)
+    k, v, _ = _qkv(l=64, seed=1)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, causal=True, interpret=True)
+
+
+@pallas
+def test_partials_reject_per_head_bias():
+    from mxnet_tpu.ops.pallas_attention import flash_block_partials
+
+    q, k, v = _qkv(l=32)
+    per_head = jnp.zeros((2, 4, 32, 32), jnp.float32)
+    with pytest.raises(ValueError):
+        flash_block_partials(q, k, v, bias=per_head, interpret=True)
