@@ -55,6 +55,25 @@ if _FORCE_CPU:
 
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: through the axon relay a large first
+# compile is the operation that historically wedges the tunnel
+# (BENCH_NOTES_r04/r05). Caching serialized executables on disk means a
+# compile that succeeded ONCE (e.g. in a tools/compile_ladder.py warm-up
+# window) is reused by every later bench run instead of re-risking the
+# relay. Harmless on CPU; best-effort if the PJRT client can't serialize.
+try:
+    import jax as _jax_for_cache
+
+    _cache_dir = os.environ.get("BENCH_COMPILE_CACHE",
+                                os.path.join(os.path.dirname(
+                                    os.path.abspath(__file__)), ".jax_cache"))
+    os.makedirs(_cache_dir, exist_ok=True)
+    _jax_for_cache.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax_for_cache.config.update(
+        "jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # noqa: BLE001 — cache is an optimisation, never fatal
+    pass
+
 BASELINE_IMG_S = 298.51  # V100 fp32 b=32 training (BASELINE.md)
 
 
@@ -159,9 +178,19 @@ def _fetch_timed(run_n_steps, fetch_final, iters, batch, fetch_cost):
     return batch * iters / dt, dt
 
 
-def _measure_raw(on_tpu, fetch_cost):
-    """Hand-rolled jax train step on the traced graph — the upper bound.
-    Returns (img_s_fetch, img_s_dispatch, batch, size, iters, flops)."""
+def raw_shapes(on_tpu):
+    """Headline (batch, image_size) per backend. Single source of truth
+    shared with tools/compile_ladder.py: the ladder must pre-compile the
+    EXACT shapes the bench times or the persistent-cache key misses and
+    bench re-risks the big compile through the relay."""
+    return (32, 224) if on_tpu else (8, 32)
+
+
+def build_raw_step(batch, size):
+    """Construct the hand-rolled jax train step (resnet50 fwd+bwd+sgd-mom)
+    and its inputs. Split out of `_measure_raw` so `tools/compile_ladder.py`
+    can compile the IDENTICAL executable (same HLO → same persistent-cache
+    key) during a tunnel warm-up window without running the timed loops."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -169,9 +198,6 @@ def _measure_raw(on_tpu, fetch_cost):
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
     import __graft_entry__ as g
-
-    batch = 32 if on_tpu else 8
-    size = 224 if on_tpu else 32
 
     net = vision.resnet50_v1(classes=1000)
     net.initialize(mx.init.Xavier())
@@ -201,6 +227,16 @@ def _measure_raw(on_tpu, fetch_cost):
     rng = np.random.RandomState(0)
     xb = jnp.asarray(rng.uniform(-1, 1, (batch, 3, size, size)).astype(np.float32))
     yb = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
+    return train_step, params, momenta, key, xb, yb
+
+
+def _measure_raw(on_tpu, fetch_cost):
+    """Hand-rolled jax train step on the traced graph — the upper bound.
+    Returns (img_s_fetch, img_s_dispatch, batch, size, iters, flops)."""
+    import jax
+
+    batch, size = raw_shapes(on_tpu)
+    train_step, params, momenta, key, xb, yb = build_raw_step(batch, size)
 
     flops = None
     try:  # XLA's own FLOP count for one optimizer step (for the MFU figure)
@@ -252,8 +288,7 @@ def _measure_framework(on_tpu, fetch_cost, dtype="float32"):
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.io import NDArrayIter
 
-    batch = 32 if on_tpu else 8
-    size = 224 if on_tpu else 32
+    batch, size = raw_shapes(on_tpu)
     n_batches = 4
 
     net = vision.resnet50_v1(classes=1000)

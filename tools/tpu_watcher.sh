@@ -1,0 +1,63 @@
+#!/bin/bash
+# Tunnel-recovery watcher (round 5). Probes the axon relay every ~10 min;
+# when it answers, climbs tools/compile_ladder.py (persistent-cache-backed,
+# so progress survives wedges), then runs bench.py and the TPU operator
+# sweep, saving artifacts. Exits after a complete on-chip bench.
+#
+#   mkdir -p .watch && nohup bash tools/tpu_watcher.sh >> .watch/watcher.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p .watch
+
+log() { echo "[watcher $(date -u +%H:%M:%S)] $*"; }
+
+PROBE='import jax, jax.numpy as jnp
+v = jax.device_get(jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16))
+assert float(v[0,0]) == 256.0
+print("PROBE_OK", jax.devices()[0])'
+
+while true; do
+  if timeout 120 python -c "$PROBE" > .watch/probe.last 2>&1; then
+    log "probe OK: $(grep PROBE_OK .watch/probe.last)"
+    log "climbing compile ladder"
+    if timeout 2700 python tools/compile_ladder.py >> .watch/ladder.log 2>&1; then
+      log "ladder complete; running bench (BENCH_ITERS=${BENCH_ITERS:-20})"
+      if timeout 2700 env BENCH_ITERS="${BENCH_ITERS:-20}" BENCH_PROBE_TIMEOUT=300 \
+           python bench.py > .watch/bench.json.tmp 2> .watch/bench.err; then
+        tail -1 .watch/bench.json.tmp > .watch/bench.json
+        log "bench done: $(cat .watch/bench.json)"
+        if python - <<'EOF'
+import json, sys
+rec = json.load(open(".watch/bench.json"))
+sys.exit(0 if rec.get("backend") not in (None, "cpu") and "error" not in rec else 1)
+EOF
+        then
+          cp .watch/bench.json BENCH_ONCHIP_r05.json
+          log "on-chip bench artifact saved to BENCH_ONCHIP_r05.json"
+          log "running TPU operator sweep (forward+gradient legs)"
+          timeout 2700 env MXNET_TEST_TPU=1 python -m pytest \
+            tests/python/tpu/test_operator_tpu.py -q \
+            > .watch/tpu_sweep.log 2>&1
+          rc=$?
+          tail -3 .watch/tpu_sweep.log
+          if [ "$rc" -ne 0 ]; then
+            log "TPU sweep FAILED or timed out (rc=$rc; see .watch/tpu_sweep.log)"
+          else
+            log "TPU sweep passed"
+          fi
+          log "watcher done"
+          exit 0
+        else
+          log "bench emitted a fallback/error line; will retry next window"
+        fi
+      else
+        log "bench wedged or timed out (see .watch/bench.err); cache kept progress"
+      fi
+    else
+      log "ladder wedged/timed out; last rung: $(grep -E '^\[ladder' .watch/ladder.log | tail -1)"
+    fi
+  else
+    log "probe failed/hung (relay down)"
+  fi
+  sleep "${WATCH_INTERVAL:-600}"
+done
