@@ -131,6 +131,10 @@ def _reexec_cpu(err):
 
     env = dict(os.environ)
     env["BENCH_FORCE_CPU"] = "1"
+    # the CPU fallback interpreter must start even when the axon relay is
+    # half-wedged: sitecustomize register() blocks at interpreter start
+    # while PALLAS_AXON_POOL_IPS is set
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         out = subprocess.run([sys.executable, os.path.abspath(__file__)],
                              capture_output=True, text=True, timeout=1800,

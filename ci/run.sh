@@ -12,6 +12,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# CI is CPU-only; on an axon-tunnel host, sitecustomize register() would
+# block every python start while the relay is half-wedged, so keep the
+# relay out of the whole pipeline
+unset PALLAS_AXON_POOL_IPS || true
+
 stage="${1:-all}"
 
 log() { printf '\n== %s ==\n' "$*"; }

@@ -1,0 +1,71 @@
+"""Profiling a workload and reading the results (parity:
+`example/profiler/profiler_matmul.py` + `profiler_ndarray.py` — configure
+the profiler, run ops, dump a trace and the per-op aggregate table).
+
+TPU-native notes: op timings come from the dispatch layer (each
+registry-dispatched op records into the profiler when running); the dump
+is a chrome://tracing JSON plus the reference's `MXDumpAggregateStats`
+table (mxnet_tpu/profiler.py, reference `src/profiler/profiler.cc`).
+
+  JAX_PLATFORMS=cpu python example/profiler/profiler_demo.py
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+parser = argparse.ArgumentParser(
+    description="profile matmul + elementwise ops, dump trace and table",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--iters", type=int, default=20)
+parser.add_argument("--size", type=int, default=256)
+parser.add_argument("--trace-file", default=None,
+                    help="chrome trace output (default: tempdir)")
+
+
+def main(args):
+    trace = args.trace_file or os.path.join(
+        tempfile.mkdtemp(prefix="mxtpu_prof_"), "profile.json")
+    profiler.set_config(filename=trace, profile_symbolic=True,
+                        profile_imperative=True, aggregate_stats=True)
+    profiler.start()
+
+    a = nd.random.uniform(-1, 1, shape=(args.size, args.size))
+    b = nd.random.uniform(-1, 1, shape=(args.size, args.size))
+    c = None
+    for _ in range(args.iters):
+        c = nd.dot(a, b)
+        c = nd.relu(c) + a
+    c.wait_to_read()
+
+    # user-scoped region + counter, as the reference's custom instrumentation
+    with profiler.scope("user/epoch"):
+        mem = profiler.Counter("worker", "batches")
+        for i in range(4):
+            mem.set_value(i)
+            nd.dot(a, b).wait_to_read()
+
+    profiler.stop()
+    table = profiler.dumps_aggregate(sort_by="total")
+    print(table)
+    profiler.dump()
+
+    with open(trace) as f:
+        events = json.load(f)["traceEvents"]
+    ops = {e["name"] for e in events if e.get("ph") == "X"}
+    print(f"trace_file: {trace}")
+    print(f"trace_events: {len(events)}")
+    print(f"distinct_ops: {len(ops)}")
+    assert any("dot" in o for o in ops), ops
+    return trace
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

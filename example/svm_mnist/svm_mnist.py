@@ -1,0 +1,88 @@
+"""SVM output layer instead of softmax (parity:
+`example/svm_mnist/svm_mnist.py` — the reference trains the same MLP
+twice, once with `SVMOutput` (hinge loss, margin maximising) and once
+with `SoftmaxOutput`, and compares).
+
+TPU-native notes: `SVMOutput`'s forward is identity and its gradient is
+the (squared) hinge subgradient; both variants ride the same symbolic
+Module path and compile to one XLA program each
+(mxnet_tpu/ops — SVMOutput schema; reference `src/operator/svm_output.cc`).
+
+  JAX_PLATFORMS=cpu python example/svm_mnist/svm_mnist.py --epochs 5
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+
+parser = argparse.ArgumentParser(
+    description="hinge-loss (SVM) vs softmax output layers on one MLP",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--epochs", type=int, default=5)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--n-train", type=int, default=2048)
+parser.add_argument("--lr", type=float, default=0.1,
+                    help="softmax head learning rate")
+parser.add_argument("--svm-lr", type=float, default=0.01,
+                    help="hinge-head learning rate (the unsquashed hinge "
+                         "gradient is ~10x a softmax gradient; 0.1 diverges)")
+parser.add_argument("--margin", type=float, default=1.0)
+parser.add_argument("--reg-coeff", type=float, default=1.0)
+parser.add_argument("--seed", type=int, default=0)
+
+
+def build(head, margin=1.0, reg=1.0):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    if head == "svm":
+        return mx.sym.SVMOutput(h, label=label, margin=margin,
+                                regularization_coefficient=reg,
+                                use_linear=False, name="svm")
+    return mx.sym.SoftmaxOutput(h, label=label, name="softmax")
+
+
+def train_one(head, train_iter, val_iter, args):
+    lr = args.svm_lr if head == "svm" else args.lr
+    mod = Module(build(head, args.margin, args.reg_coeff),
+                 data_names=["data"],
+                 label_names=["softmax_label"])
+    mod.fit(train_iter, optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=args.epochs)
+    return dict(mod.score(val_iter, "acc"))["accuracy"]
+
+
+def main(args):
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    templates = rng.normal(0, 1, (10, 784)).astype(np.float32)
+    y = rng.randint(0, 10, args.n_train)
+    x = (templates[y] + rng.normal(0, 0.8, (args.n_train, 784))).astype(np.float32)
+    n_val = args.n_train // 4
+    train_iter = NDArrayIter(x[n_val:], y[n_val:].astype(np.float32),
+                             args.batch_size, shuffle=True,
+                             label_name="softmax_label")
+    val_iter = NDArrayIter(x[:n_val], y[:n_val].astype(np.float32),
+                           args.batch_size, label_name="softmax_label")
+
+    acc_svm = train_one("svm", train_iter, val_iter, args)
+    train_iter.reset()
+    acc_sm = train_one("softmax", train_iter, val_iter, args)
+    print(f"svm_accuracy: {acc_svm:.4f}")
+    print(f"softmax_accuracy: {acc_sm:.4f}")
+    return acc_svm, acc_sm
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

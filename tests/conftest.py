@@ -16,6 +16,16 @@ prev = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon sitecustomize calls register() at EVERY interpreter start when
+# PALLAS_AXON_POOL_IPS is set; with the relay half-wedged (accepting but
+# not answering) that blocks each test-spawned CHILD python before main()
+# runs. The suite is CPU-only, so drop the variable here — children
+# inherit the cleaned env. tests/python/tpu restores it from the stash
+# for its on-chip subprocesses.
+_axon_ips = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if _axon_ips and "MXNET_SAVED_AXON_POOL_IPS" not in os.environ:
+    os.environ["MXNET_SAVED_AXON_POOL_IPS"] = _axon_ips
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

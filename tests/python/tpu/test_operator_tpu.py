@@ -27,8 +27,29 @@ if os.environ.get("MXNET_TEST_TPU", "0") != "1":
                 allow_module_level=True)
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+
+
+
                                     "..", "..", ".."))
 sys.path.insert(0, os.path.join(REPO, "tests", "python", "unittest"))
+
+
+def _driver_env():
+    """Env for the on-chip driver subprocess: default accelerator backend,
+    no virtual-device XLA flags, and the axon relay variable restored from
+    the conftest stash — except in the chip-free platform-override
+    dry-run, which must stay off the relay entirely."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    if (env.get("MXNET_SAVED_AXON_POOL_IPS")
+            and not os.environ.get("MXNET_TEST_TPU_PLATFORM")):
+        env["PALLAS_AXON_POOL_IPS"] = env["MXNET_SAVED_AXON_POOL_IPS"]
+    if os.environ.get("MXNET_TEST_TPU_PLATFORM"):
+        # harness dry-run without a chip (mechanics only)
+        env["JAX_PLATFORMS"] = os.environ["MXNET_TEST_TPU_PLATFORM"]
+    return env
+
 
 _DRIVER = r"""
 import os, pickle, sys
@@ -127,12 +148,7 @@ def test_op_forward_consistency_cpu_vs_tpu():
             repo=REPO,
             unittest_dir=os.path.join(REPO, "tests", "python", "unittest"),
             inp=inp, outp=outp)
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)   # default accelerator backend
-        env.pop("XLA_FLAGS", None)
-        if os.environ.get("MXNET_TEST_TPU_PLATFORM"):
-            # harness dry-run without a chip (mechanics only)
-            env["JAX_PLATFORMS"] = os.environ["MXNET_TEST_TPU_PLATFORM"]
+        env = _driver_env()
         proc = subprocess.run([sys.executable, "-c", driver],
                               capture_output=True, text=True, env=env,
                               cwd=REPO, timeout=3600)
@@ -206,12 +222,7 @@ def test_op_gradient_consistency_cpu_vs_tpu():
             repo=REPO,
             unittest_dir=os.path.join(REPO, "tests", "python", "unittest"),
             inp=inp, outp=outp)
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env.pop("XLA_FLAGS", None)
-        if os.environ.get("MXNET_TEST_TPU_PLATFORM"):
-            # harness dry-run without a chip (mechanics only)
-            env["JAX_PLATFORMS"] = os.environ["MXNET_TEST_TPU_PLATFORM"]
+        env = _driver_env()
         proc = subprocess.run([sys.executable, "-c", driver],
                               capture_output=True, text=True, env=env,
                               cwd=REPO, timeout=3600)

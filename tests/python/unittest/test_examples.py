@@ -140,3 +140,65 @@ def test_lstm_crf_example():
     trans_margin = float(lines[-1].split(":")[1])
     assert acc > 0.97, out[-500:]
     assert trans_margin > 0.1, trans_margin  # I-after-B >> I-after-O
+
+
+@pytest.mark.slow
+def test_fgsm_adversary_example():
+    """FGSM (reference example/adversary): clean accuracy ~1.0, and the
+    signed-gradient perturbation must knock a large hole in it."""
+    out = _run("adversary/fgsm_mnist.py", "--epochs", "3", timeout=500)
+    lines = out.strip().splitlines()
+    clean = float(lines[-2].split(":")[1])
+    adv = float(lines[-1].split(":")[1])
+    assert clean > 0.95, out[-500:]
+    assert adv < clean - 0.15, (clean, adv)
+
+
+@pytest.mark.slow
+def test_numpy_ops_custom_softmax_example():
+    """CustomOp-as-loss-layer (reference example/numpy-ops): a numpy
+    forward/backward pair must train the net through the bridge."""
+    out = _run("numpy-ops/custom_softmax.py", timeout=500)
+    acc = float(out.strip().splitlines()[-1].split(":")[1])
+    assert acc > 0.8, out[-500:]
+
+
+def test_profiler_example():
+    """Profiler walkthrough (reference example/profiler): aggregate table
+    + chrome trace with the dispatched op names present."""
+    out = _run("profiler/profiler_demo.py", timeout=400)
+    n_events = int([l for l in out.splitlines()
+                    if l.startswith("trace_events:")][0].split(":")[1])
+    assert n_events > 10, out[-500:]
+    assert "dot" in out
+
+
+@pytest.mark.slow
+def test_module_mlp_example():
+    """Module API walkthrough (reference example/module): fit/score plus a
+    checkpoint round-trip that must reproduce the exact score."""
+    out = _run("module/mnist_mlp.py", "--epochs", "4", timeout=500)
+    lines = out.strip().splitlines()
+    acc = float(lines[-2].split(":")[1])
+    acc2 = float(lines[-1].split(":")[1])
+    assert acc > 0.9, out[-500:]
+    assert abs(acc - acc2) < 1e-6
+
+
+@pytest.mark.slow
+def test_multitask_example():
+    """Shared-trunk two-head training (reference example/multi-task)."""
+    out = _run("multi-task/multitask_mnist.py", "--epochs", "6", timeout=500)
+    lines = out.strip().splitlines()
+    assert float(lines[-2].split(":")[1]) > 0.9, out[-500:]
+    assert float(lines[-1].split(":")[1]) > 0.9, out[-500:]
+
+
+@pytest.mark.slow
+def test_svm_mnist_example():
+    """SVMOutput vs SoftmaxOutput (reference example/svm_mnist): both
+    heads must fit the same data."""
+    out = _run("svm_mnist/svm_mnist.py", "--epochs", "4", timeout=600)
+    lines = out.strip().splitlines()
+    assert float(lines[-2].split(":")[1]) > 0.9, out[-500:]
+    assert float(lines[-1].split(":")[1]) > 0.9, out[-500:]

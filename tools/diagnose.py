@@ -61,6 +61,7 @@ def check_mxnet_tpu(timeout=120):
              "print('ops          :', len(registry.list_ops()))")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU probe: skip relay register()
     try:
         out = subprocess.run([sys.executable, "-c", probe],
                              capture_output=True, text=True, timeout=timeout,

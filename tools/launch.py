@@ -51,6 +51,11 @@ def launch(num_workers, command, extra_env=None, platform="cpu", timeout=None):
     threads = []
     for rank in range(num_workers):
         env = dict(os.environ)
+        if platform == "cpu":
+            # CPU workers must not touch the axon relay: its sitecustomize
+            # register() runs at interpreter start and can block every
+            # child when the relay is half-wedged (accepting, not answering)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update(extra_env or {})
         env.update({
             "MXNET_COORDINATOR": f"127.0.0.1:{port}",
