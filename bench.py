@@ -78,6 +78,32 @@ BASELINE_IMG_S = 298.51  # V100 fp32 b=32 training (BASELINE.md)
 
 
 def _emit(payload):
+    # A CPU fallback/error line still carries the most recent REAL on-chip
+    # capture (tools/tpu_watcher.sh saves one whenever the flaky relay
+    # recovers long enough to complete a run) under `last_onchip`, clearly
+    # labelled with its capture time — the headline `value` is never
+    # substituted.
+    if "error" in payload or payload.get("backend") in (None, "cpu"):
+        try:
+            art = os.environ.get("BENCH_ONCHIP_ARTIFACT")
+            if not art:
+                import glob
+
+                cands = glob.glob(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_ONCHIP_*.json"))
+                art = max(cands, key=os.path.getmtime) if cands else None
+            if art:
+                with open(art) as f:
+                    rec = json.load(f)
+                if rec.get("backend") not in (None, "cpu"):
+                    payload["last_onchip"] = rec
+                    # the watcher stamps captured_at INSIDE the record at
+                    # save time (file mtime survives neither clone nor cp)
+                    payload["last_onchip_captured_at"] = rec.get(
+                        "captured_at", "unknown (artifact lacks captured_at)")
+        except Exception:  # noqa: BLE001 — the artifact is optional
+            pass
     print(json.dumps(payload))
     sys.stdout.flush()
 

@@ -1,0 +1,114 @@
+"""Stacked autoencoder with layer-wise pretraining then fine-tuning
+(parity: `example/autoencoder/` — the deep-embedded-clustering stack:
+greedy per-layer reconstruction pretraining, then end-to-end fine-tune;
+bottleneck features must organise the classes).
+
+TPU-native notes: each pretraining stage and the fine-tune are separate
+hybridized graphs; swapping a frozen encoder prefix in and out is just
+re-tracing — no executor rebinding (reference rebinds Modules per stage).
+
+  JAX_PLATFORMS=cpu python example/autoencoder/ae_mnist.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Block, Trainer, nn
+
+parser = argparse.ArgumentParser(
+    description="stacked autoencoder: layer-wise pretrain + fine-tune",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--pretrain-epochs", type=int, default=6)
+parser.add_argument("--finetune-epochs", type=int, default=8)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--n-train", type=int, default=1024)
+parser.add_argument("--bottleneck", type=int, default=8)
+parser.add_argument("--lr", type=float, default=0.003)
+parser.add_argument("--seed", type=int, default=0)
+
+DIM = 256           # 16x16 synthetic digits, flattened
+
+
+class AE(Block):
+    """One encoder/decoder pair; stacked greedily."""
+
+    def __init__(self, n_in, n_hidden, **kwargs):
+        super().__init__(**kwargs)
+        self.enc = nn.Dense(n_hidden, activation="relu", in_units=n_in)
+        self.dec = nn.Dense(n_in, in_units=n_hidden)
+
+    def forward(self, x):
+        return self.dec(self.enc(x))
+
+
+def train_recon(model, x, epochs, lr, batch_size, tag):
+    trainer = Trainer(model.collect_params(), "adam", {"learning_rate": lr})
+    nb = x.shape[0] // batch_size
+    last = None
+    for epoch in range(epochs):
+        tot = 0.0
+        for b in range(nb):
+            sl = slice(b * batch_size, (b + 1) * batch_size)
+            with autograd.record():
+                loss = ((model(x[sl]) - x[sl]) ** 2).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+        last = tot / nb
+        print(f"{tag} epoch {epoch} mse {last:.5f}")
+    return last
+
+
+def main(args):
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    templates = rng.uniform(0, 1, (4, DIM)).astype(np.float32)
+    y = rng.randint(0, 4, args.n_train)
+    xs = np.clip(templates[y] + rng.normal(0, 0.15, (args.n_train, DIM)), 0, 1)
+    x_all = nd.array(xs.astype(np.float32))
+
+    # --- greedy layer-wise pretraining (64 -> bottleneck)
+    ae1 = AE(DIM, 64)
+    ae1.initialize(mx.init.Xavier())
+    train_recon(ae1, x_all, args.pretrain_epochs, args.lr,
+                args.batch_size, "pretrain-1")
+    h1 = ae1.enc(x_all).detach()
+
+    ae2 = AE(64, args.bottleneck)
+    ae2.initialize(mx.init.Xavier())
+    train_recon(ae2, h1, args.pretrain_epochs, args.lr,
+                args.batch_size, "pretrain-2")
+
+    # --- stack and fine-tune end to end
+    class Stacked(Block):
+        def __init__(self, a, b, **kw):
+            super().__init__(**kw)
+            self.a, self.b = a, b
+
+        def forward(self, x):
+            return self.a.dec(self.b(self.a.enc(x)))
+
+    stacked = Stacked(ae1, ae2)
+    final = train_recon(stacked, x_all, args.finetune_epochs, args.lr,
+                        args.batch_size, "finetune")
+
+    # the bottleneck must separate the 4 modes: nearest-centroid purity
+    z = ae2.enc(ae1.enc(x_all)).asnumpy()
+    cents = np.stack([z[y == k].mean(axis=0) for k in range(4)])
+    assign = np.argmin(
+        ((z[:, None, :] - cents[None]) ** 2).sum(axis=2), axis=1)
+    purity = float((assign == y).mean())
+    print(f"final_mse: {final:.5f}")
+    print(f"bottleneck_purity: {purity:.4f}")
+    return final, purity
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

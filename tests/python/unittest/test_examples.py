@@ -268,3 +268,27 @@ def test_model_parallel_example():
     final = float([l for l in out.splitlines()
                    if l.startswith("final_mse")][0].split(":")[1])
     assert final < first * 0.2, (first, final)
+
+
+@pytest.mark.slow
+def test_ssd_example():
+    """Single-shot detector (reference example/ssd): MultiBoxTarget
+    matching + hard-negative mining trains the heads; MultiBoxDetection
+    decode must localise (IoU) and classify the synthetic boxes."""
+    out = _run("ssd/train_ssd.py", "--epochs", "6", timeout=900)
+    lines = out.strip().splitlines()
+    miou = float(lines[-2].split(":")[1])
+    cls_acc = float(lines[-1].split(":")[1])
+    assert miou > 0.5, out[-600:]
+    assert cls_acc > 0.9, out[-600:]
+
+
+@pytest.mark.slow
+def test_autoencoder_example():
+    """Stacked AE (reference example/autoencoder): layer-wise pretrain +
+    fine-tune; the bottleneck must separate the modes."""
+    out = _run("autoencoder/ae_mnist.py", "--pretrain-epochs", "4",
+               "--finetune-epochs", "6", timeout=600)
+    lines = out.strip().splitlines()
+    assert float(lines[-2].split(":")[1]) < 0.05, out[-500:]
+    assert float(lines[-1].split(":")[1]) > 0.8, out[-500:]

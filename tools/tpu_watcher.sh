@@ -32,7 +32,12 @@ rec = json.load(open(".watch/bench.json"))
 sys.exit(0 if rec.get("backend") not in (None, "cpu") and "error" not in rec else 1)
 EOF
         then
-          cp .watch/bench.json BENCH_ONCHIP_r05.json
+          python - <<'EOF'
+import json, time
+rec = json.load(open(".watch/bench.json"))
+rec["captured_at"] = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+json.dump(rec, open("BENCH_ONCHIP_r05.json", "w"))
+EOF
           log "on-chip bench artifact saved to BENCH_ONCHIP_r05.json"
           log "running TPU operator sweep (forward+gradient legs)"
           timeout 2700 env MXNET_TEST_TPU=1 python -m pytest \
