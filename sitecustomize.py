@@ -1,0 +1,91 @@
+"""Repo-local sitecustomize: the axon register() guard.
+
+Takes effect when this directory precedes /root/.axon_site on
+PYTHONPATH (`PYTHONPATH=/root/repo:/root/.axon_site python ...`) — at
+site-import time only PYTHONPATH entries are on sys.path (the script
+dir is prepended AFTER site runs, verified empirically), so THIS module
+then shadows the axon sitecustomize that registers the TPU PJRT plugin
+at interpreter start. tools/tpu_watcher.sh and the TPU operator sweep
+launch their children this way.
+
+Why shadow it: the axon relay has repeatedly entered a half-wedged state
+(accepting connections, never answering — BENCH_NOTES_r05.md) in which
+that register() call blocks EVERY python process before main() runs:
+bench.py, the test suite, the multichip dryrun — none of them can even
+start, and no in-script timeout can help because the hang happens before
+the script executes. This wrapper execs the original axon sitecustomize
+under a SIGALRM deadline and continues CPU-only when the relay is
+wedged, turning an infinite hang into a bounded delay plus the existing
+CPU-fallback paths.
+
+Behavior:
+- PALLAS_AXON_POOL_IPS unset        -> nothing to do (axon's own no-op).
+- JAX_PLATFORMS contains "cpu"      -> skip register entirely (a
+  CPU-pinned process must not touch the relay; same rule as
+  tests/conftest.py stripping the variable for children).
+- otherwise                         -> exec the axon sitecustomize with a
+  MXNET_AXON_REGISTER_TIMEOUT-second alarm (default 120; 0 disables the
+  guard). On timeout: warn and continue without the TPU backend.
+"""
+import os
+import signal
+import sys
+
+_AXON_SITE = "/root/.axon_site/sitecustomize.py"
+
+
+class _RegisterTimeout(BaseException):
+    # BaseException: the exec'd axon code wraps register() in a broad
+    # `except Exception`, which must NOT be able to swallow the deadline
+    pass
+
+
+def _load_axon():
+    if not os.path.exists(_AXON_SITE):
+        return
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        return
+
+    timeout = int(os.environ.get("MXNET_AXON_REGISTER_TIMEOUT", "120"))
+    # the exec'd code does `from axon.register import register`; that
+    # package lives inside /root/.axon_site, which may sit BEHIND this
+    # directory on sys.path (or be absent if PYTHONPATH was rewritten)
+    axon_dir = os.path.dirname(_AXON_SITE)
+    if axon_dir not in sys.path:
+        sys.path.append(axon_dir)
+    with open(_AXON_SITE) as f:
+        code = compile(f.read(), _AXON_SITE, "exec")
+    glb = {"__name__": "sitecustomize_axon", "__file__": _AXON_SITE}
+
+    use_alarm = timeout > 0 and hasattr(signal, "SIGALRM")
+    if not use_alarm:
+        try:
+            exec(code, glb)
+        except Exception as e:  # noqa: BLE001 — never take the interpreter down
+            print(f"[sitecustomize] axon site failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        return
+
+    def _on_alarm(signum, frame):
+        raise _RegisterTimeout()
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(timeout)
+    try:
+        exec(code, glb)
+    except _RegisterTimeout:
+        print(
+            f"[sitecustomize] axon register() exceeded {timeout}s "
+            "(relay wedged?); continuing without the TPU backend",
+            file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — never take the interpreter down
+        print(f"[sitecustomize] axon site failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+_load_axon()

@@ -45,6 +45,10 @@ def _driver_env():
     if (env.get("MXNET_SAVED_AXON_POOL_IPS")
             and not os.environ.get("MXNET_TEST_TPU_PLATFORM")):
         env["PALLAS_AXON_POOL_IPS"] = env["MXNET_SAVED_AXON_POOL_IPS"]
+        # repo sitecustomize first: bounded axon-register guard for the
+        # child (a wedged relay otherwise blocks interpreter start)
+        prev = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = REPO + (os.pathsep + prev if prev else "")
     if os.environ.get("MXNET_TEST_TPU_PLATFORM"):
         # harness dry-run without a chip (mechanics only)
         env["JAX_PLATFORMS"] = os.environ["MXNET_TEST_TPU_PLATFORM"]

@@ -292,3 +292,45 @@ def test_autoencoder_example():
     lines = out.strip().splitlines()
     assert float(lines[-2].split(":")[1]) < 0.05, out[-500:]
     assert float(lines[-1].split(":")[1]) > 0.8, out[-500:]
+
+
+@pytest.mark.slow
+def test_capsnet_example():
+    """Capsule routing (reference example/capsnet): 3-iteration static
+    routing unroll must classify the synthetic digits."""
+    out = _run("capsnet/capsnet.py", "--epochs", "5", timeout=900)
+    acc = float(out.strip().splitlines()[-1].split(":")[1])
+    assert acc > 0.9, out[-500:]
+
+
+@pytest.mark.slow
+def test_nce_loss_example():
+    """NCE (reference example/nce-loss): trained with k sampled negatives,
+    evaluated with the FULL softmax it approximates."""
+    out = _run("nce-loss/nce_lm.py", timeout=600)
+    acc = float(out.strip().splitlines()[-1].split(":")[1])
+    assert acc > 0.8, out[-500:]
+
+
+@pytest.mark.slow
+def test_rbm_example():
+    """CD-k RBM (reference example/restricted-boltzmann-machine): free
+    energy must drop and one Gibbs sweep must denoise the prototypes."""
+    out = _run("restricted-boltzmann-machine/binary_rbm.py", timeout=600)
+    lines = out.strip().splitlines()
+    drop = float(lines[-2].split(":")[1])
+    err = float(lines[-1].split(":")[1])
+    assert drop > 5.0, out[-500:]
+    assert err < 0.1, out[-500:]
+
+
+@pytest.mark.slow
+def test_lstnet_example():
+    """LSTNet (reference example/multivariate_time_series): must beat the
+    persistence baseline on held-out windows."""
+    out = _run("multivariate_time_series/lstnet.py", "--epochs", "8",
+               timeout=900)
+    lines = out.strip().splitlines()
+    persist = float(lines[-2].split(":")[1])
+    val = float(lines[-1].split(":")[1])
+    assert val < persist * 0.85, (persist, val)

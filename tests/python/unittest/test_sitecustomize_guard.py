@@ -1,0 +1,64 @@
+"""The repo-local sitecustomize axon-register guard (sitecustomize.py):
+a wedged relay must cost a bounded delay, never an interpreter hang, and
+no guard failure mode may take the interpreter down."""
+import importlib.util
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+
+def _load_guard():
+    spec = importlib.util.spec_from_file_location(
+        "repo_sitecustomize", os.path.join(REPO, "sitecustomize.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_blocking_register_is_bounded(tmp_path, monkeypatch):
+    guard = _load_guard()
+    fake = tmp_path / "fake_site.py"
+    fake.write_text("import time\ntime.sleep(60)\n")
+    monkeypatch.setattr(guard, "_AXON_SITE", str(fake))
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("MXNET_AXON_REGISTER_TIMEOUT", "2")
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    t0 = time.time()
+    guard._load_axon()                  # must return, not hang
+    dt = time.time() - t0
+    assert dt < 10, dt
+
+
+def test_cpu_pinned_process_skips_register(tmp_path, monkeypatch):
+    guard = _load_guard()
+    fake = tmp_path / "fake_site.py"
+    fake.write_text("raise RuntimeError('register must not run for cpu')\n")
+    monkeypatch.setattr(guard, "_AXON_SITE", str(fake))
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    guard._load_axon()                  # cpu pin -> no exec at all
+
+
+def test_unset_pool_ips_is_noop(tmp_path, monkeypatch):
+    guard = _load_guard()
+    fake = tmp_path / "fake_site.py"
+    fake.write_text("raise RuntimeError('must not run')\n")
+    monkeypatch.setattr(guard, "_AXON_SITE", str(fake))
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    guard._load_axon()
+
+
+def test_register_crash_does_not_propagate(tmp_path, monkeypatch, capsys):
+    guard = _load_guard()
+    fake = tmp_path / "fake_site.py"
+    fake.write_text("from axon_not_a_module import nothing\n")
+    monkeypatch.setattr(guard, "_AXON_SITE", str(fake))
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    guard._load_axon()                  # swallowed, warned
+    assert "axon site failed" in capsys.readouterr().err

@@ -9,6 +9,11 @@ set -u
 cd "$(dirname "$0")/.."
 mkdir -p .watch
 
+# Put the repo's sitecustomize ahead of /root/.axon_site so every child
+# python gets the bounded axon-register guard (a wedged relay otherwise
+# blocks interpreter start indefinitely — see sitecustomize.py)
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
 log() { echo "[watcher $(date -u +%H:%M:%S)] $*"; }
 
 PROBE='import jax, jax.numpy as jnp
