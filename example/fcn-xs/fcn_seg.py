@@ -1,0 +1,123 @@
+"""Fully-convolutional semantic segmentation with skip fusion (parity:
+`example/fcn-xs/` — FCN-16s-style: downsampling backbone, 1x1 class
+heads at two depths, Deconvolution upsampling, elementwise skip fusion,
+per-pixel softmax).
+
+TPU-native notes: Deconvolution lowers to `conv_transpose` (an MXU
+convolution); the per-pixel loss is one (B*H*W, C) log-softmax — no
+pixel loops anywhere. The skip connection is the reference's
+fcn-16s fuse (crop + sum) with static shapes so everything stays one
+compiled program.
+
+  JAX_PLATFORMS=cpu python example/fcn-xs/fcn_seg.py --epochs 8
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Block, Trainer, nn
+
+parser = argparse.ArgumentParser(
+    description="FCN-16s-style segmentation of synthetic shapes",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--epochs", type=int, default=8)
+parser.add_argument("--batch-size", type=int, default=16)
+parser.add_argument("--n-train", type=int, default=256)
+parser.add_argument("--lr", type=float, default=0.003)
+parser.add_argument("--seed", type=int, default=0)
+
+IMG = 32
+N_CLS = 3      # background, squares (ch0-bright), disks (ch2-bright)
+
+
+def make_data(n, rng):
+    x = rng.uniform(0, 0.2, (n, 3, IMG, IMG)).astype(np.float32)
+    y = np.zeros((n, IMG, IMG), np.int32)
+    yy, xx = np.mgrid[0:IMG, 0:IMG]
+    for i in range(n):
+        # one square (class 1)
+        s = rng.randint(6, 12)
+        r0, c0 = rng.randint(0, IMG - s, 2)
+        x[i, 0, r0:r0 + s, c0:c0 + s] += 0.8
+        y[i, r0:r0 + s, c0:c0 + s] = 1
+        # one disk (class 2)
+        rad = rng.randint(4, 7)
+        cy, cx = rng.randint(rad, IMG - rad, 2)
+        mask = (yy - cy) ** 2 + (xx - cx) ** 2 <= rad ** 2
+        x[i, 2][mask] += 0.8
+        y[i][mask] = 2
+    return x, y
+
+
+class FCN(Block):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.b1 = nn.Sequential()       # /2
+        self.b1.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+                    nn.MaxPool2D(2))
+        self.b2 = nn.Sequential()       # /4
+        self.b2.add(nn.Conv2D(32, 3, padding=1, activation="relu"),
+                    nn.MaxPool2D(2))
+        self.head4 = nn.Conv2D(N_CLS, 1)            # deep head at /4
+        self.head2 = nn.Conv2D(N_CLS, 1)            # skip head at /2
+        self.up2 = nn.Conv2DTranspose(N_CLS, 4, strides=2, padding=1)
+        self.up_final = nn.Conv2DTranspose(N_CLS, 4, strides=2, padding=1)
+
+    def forward(self, x):
+        f2 = self.b1(x)                 # (B, 16, 16, 16)
+        f4 = self.b2(f2)                # (B, 32, 8, 8)
+        score = self.up2(self.head4(f4))            # -> /2
+        score = score + self.head2(f2)              # fcn-16s skip fuse
+        return self.up_final(score)                 # -> full res (B, C, H, W)
+
+
+def main(args):
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    xs, ys = make_data(args.n_train, rng)
+    x_all = nd.array(xs)
+    y_all = nd.array(ys.astype(np.float32))
+
+    net = FCN()
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+
+    nb = args.n_train // args.batch_size
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for b in range(nb):
+            sl = slice(b * args.batch_size, (b + 1) * args.batch_size)
+            with autograd.record():
+                logits = net(x_all[sl])             # (B, C, H, W)
+                logp = nd.log_softmax(logits, axis=1)
+                loss = -nd.pick(logp.transpose((0, 2, 3, 1)),
+                                y_all[sl], axis=-1).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+        print(f"epoch {epoch} pixel_nll {tot / nb:.4f}")
+
+    # pixel accuracy and per-class IoU on held-out shapes
+    xv, yv = make_data(64, np.random.RandomState(args.seed + 1))
+    pred = net(nd.array(xv)).argmax(axis=1).asnumpy().astype(np.int32)
+    pix_acc = float((pred == yv).mean())
+    ious = []
+    for c in range(1, N_CLS):
+        inter = ((pred == c) & (yv == c)).sum()
+        union = ((pred == c) | (yv == c)).sum()
+        ious.append(inter / max(union, 1))
+    print(f"pixel_accuracy: {pix_acc:.4f}")
+    print(f"fg_miou: {float(np.mean(ious)):.4f}")
+    return pix_acc, float(np.mean(ious))
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

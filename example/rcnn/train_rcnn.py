@@ -1,0 +1,246 @@
+"""Two-stage detector: RPN -> Proposal -> ROIAlign -> region head
+(parity: `example/rcnn/` — Faster-RCNN's structure at toy scale: anchor
+classification/regression, NMS'd proposals, per-ROI pooled features,
+region classification).
+
+TPU-native notes: `_contrib_Proposal` (decode + clip + topk + NMS) and
+`_contrib_ROIAlign` are compiled ops with static output shapes
+(fixed post-NMS count), so the full two-stage forward is traceable;
+target assignment happens on host between steps (it is label-making, the
+same split the reference uses — `proposal_target.py` runs in python
+there too).
+
+  JAX_PLATFORMS=cpu python example/rcnn/train_rcnn.py --epochs 8
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Block, Trainer, nn
+
+parser = argparse.ArgumentParser(
+    description="toy Faster-RCNN on synthetic rectangles",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--epochs", type=int, default=8)
+parser.add_argument("--batch-size", type=int, default=16)
+parser.add_argument("--n-train", type=int, default=256)
+parser.add_argument("--lr", type=float, default=0.002)
+parser.add_argument("--seed", type=int, default=0)
+
+IMG = 64
+STRIDE = 4
+SCALES = (4.0, 6.0, 8.0)     # anchor sizes 16/24/32 px at stride 4
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+N_CLS = 2                    # foreground classes (+1 background in the head)
+POST_NMS = 8                 # proposals per image
+FEAT = IMG // STRIDE         # feature-map side at the RPN
+
+
+def gen_anchors(hf, wf):
+    """Replicates ops/vision.py _gen_anchors (proposal.cc GenerateAnchors)
+    for host-side target assignment."""
+    base = float(STRIDE)
+    ctr = (base - 1.0) / 2.0
+    anchors = []
+    for r in RATIOS:
+        ws = np.round(np.sqrt(base * base / r))
+        hs = np.round(ws * r)
+        for s in SCALES:
+            w2, h2 = ws * s / 2.0, hs * s / 2.0
+            anchors.append([ctr - w2 + 0.5, ctr - h2 + 0.5,
+                            ctr + w2 - 0.5, ctr + h2 - 0.5])
+    base_a = np.array(anchors, np.float32)                     # (A, 4)
+    sy = np.arange(hf, dtype=np.float32) * STRIDE
+    sx = np.arange(wf, dtype=np.float32) * STRIDE
+    gx, gy = np.meshgrid(sx, sy)
+    shifts = np.stack([gx, gy, gx, gy], axis=-1)[:, :, None, :]
+    return (shifts + base_a[None, None]).reshape(-1, 4)        # (hf*wf*A, 4)
+
+
+def iou_matrix(a, b):
+    """(N, 4) x (M, 4) -> (N, M) IoU."""
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.clip(ix2 - ix1 + 1, 0, None) * np.clip(iy2 - iy1 + 1, 0, None)
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    return inter / (area_a[:, None] + area_b[None] - inter + 1e-9)
+
+
+def encode(gt, anc):
+    aw = anc[:, 2] - anc[:, 0] + 1.0
+    ah = anc[:, 3] - anc[:, 1] + 1.0
+    acx = anc[:, 0] + 0.5 * (aw - 1.0)
+    acy = anc[:, 1] + 0.5 * (ah - 1.0)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1.0)
+    gcy = gt[:, 1] + 0.5 * (gh - 1.0)
+    return np.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                     np.log(gw / aw), np.log(gh / ah)], axis=1)
+
+
+def make_data(n, rng):
+    """One bright rectangle per image; class = lit channel (0 or 2).
+    gt boxes in pixel coords [x1, y1, x2, y2]."""
+    x = rng.uniform(0, 0.2, (n, 3, IMG, IMG)).astype(np.float32)
+    gt = np.zeros((n, 4), np.float32)
+    cls = rng.randint(0, N_CLS, n)
+    for i in range(n):
+        w = rng.randint(16, 33)
+        h = rng.randint(16, 33)
+        x1 = rng.randint(2, IMG - w - 2)
+        y1 = rng.randint(2, IMG - h - 2)
+        x[i, 0 if cls[i] == 0 else 2, y1:y1 + h, x1:x1 + w] += 0.8
+        gt[i] = [x1, y1, x1 + w - 1, y1 + h - 1]
+    return x, gt, cls.astype(np.int64)
+
+
+def rpn_targets(anchors, gt):
+    """Per-image RPN labels: 1 pos (IoU>=0.5 or best), 0 neg (IoU<0.3),
+    -1 ignore; bbox targets for positives."""
+    iou = iou_matrix(anchors, gt[None])[:, 0]
+    lab = -np.ones(len(anchors), np.float32)
+    lab[iou < 0.3] = 0.0
+    lab[iou >= 0.5] = 1.0
+    lab[np.argmax(iou)] = 1.0
+    bt = np.zeros((len(anchors), 4), np.float32)
+    pos = lab == 1.0
+    bt[pos] = encode(np.repeat(gt[None], pos.sum(), 0), anchors[pos])
+    return lab, bt
+
+
+class RCNN(Block):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.backbone = nn.Sequential()
+        self.backbone.add(
+            nn.Conv2D(16, 3, padding=1, activation="relu"), nn.MaxPool2D(2),
+            nn.Conv2D(32, 3, padding=1, activation="relu"), nn.MaxPool2D(2))
+        self.rpn_conv = nn.Conv2D(32, 3, padding=1, activation="relu")
+        self.rpn_cls = nn.Conv2D(2 * A, 1)     # [0:A) bg, [A:2A) fg
+        self.rpn_box = nn.Conv2D(4 * A, 1)
+        self.head = nn.Sequential()
+        self.head.add(nn.Dense(64, activation="relu"),
+                      nn.Dense(N_CLS + 1))
+
+    def rpn(self, x):
+        f = self.backbone(x)                   # (B, 32, 16, 16)
+        r = self.rpn_conv(f)
+        return f, self.rpn_cls(r), self.rpn_box(r)
+
+    def proposals(self, cls, box, batch):
+        """NMS'd rois off DETACHED rpn outputs (label-making path)."""
+        score = nd.softmax(cls.detach().reshape((0, 2, -1)), axis=1)
+        score = score.reshape((0, 2 * A, FEAT, FEAT))
+        im_info = nd.array(np.tile([IMG, IMG, 1.0], (batch, 1)))
+        return nd.contrib.Proposal(
+            score, box.detach(), im_info, rpn_pre_nms_top_n=64,
+            rpn_post_nms_top_n=POST_NMS, threshold=0.7, rpn_min_size=8,
+            scales=SCALES, ratios=RATIOS, feature_stride=STRIDE)
+
+    def roi_head(self, f, rois):
+        pooled = nd.contrib.ROIAlign(f, rois, pooled_size=(4, 4),
+                                     spatial_scale=1.0 / STRIDE)
+        return self.head(pooled.reshape((rois.shape[0], -1)))
+
+
+def main(args):
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    xs, gts, clss = make_data(args.n_train, rng)
+    x_all = nd.array(xs)
+
+    hf = wf = IMG // STRIDE
+    anchors = gen_anchors(hf, wf)
+    # RPN targets are anchor-vs-gt only: precompute for the whole set
+    labs, bts = zip(*(rpn_targets(anchors, gts[i])
+                      for i in range(args.n_train)))
+    lab_all = nd.array(np.stack(labs))                   # (N, na)
+    bt_all = nd.array(np.stack(bts))                     # (N, na, 4)
+
+    net = RCNN()
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+
+    nb = args.n_train // args.batch_size
+    for epoch in range(args.epochs):
+        tot_r = tot_h = 0.0
+        for b in range(nb):
+            sl = slice(b * args.batch_size, (b + 1) * args.batch_size)
+            xb, lab, bt = x_all[sl], lab_all[sl], bt_all[sl]
+            with autograd.record():
+                f, cls, box = net.rpn(xb)
+                # rpn cls: CE over labelled anchors (ignore -1). Channel
+                # halves are [0:A) bg / [A:2A) fg; flatten ANCHOR-FASTEST
+                # (h, w, A) to line up with the precomputed labels
+                logits = cls.reshape((0, 2, A, hf, wf))
+                logits = logits.transpose((0, 3, 4, 2, 1)).reshape((0, -1, 2))
+                logp = nd.log_softmax(logits, axis=-1)
+                keep = lab >= 0
+                ce = -nd.pick(logp, nd.maximum(lab, 0), axis=-1) * keep
+                rpn_cls_loss = ce.sum() / nd.maximum(keep.sum(), 1)
+                # rpn box: smooth-l1 on positives
+                pred_t = box.reshape((0, A, 4, hf, wf))
+                pred_t = pred_t.transpose((0, 3, 4, 1, 2)).reshape((0, -1, 4))
+                pos = (lab == 1.0).expand_dims(2)
+                sl1 = nd.smooth_l1((pred_t - bt) * pos, scalar=3.0)
+                rpn_box_loss = sl1.sum() / nd.maximum(pos.sum() * 4, 1)
+
+                # stage 2: proposals -> roi labels (host) -> head CE
+                rois = net.proposals(cls, box, xb.shape[0])
+                rois_np = rois.asnumpy()
+                gt_b, cls_b = gts[sl], clss[sl]
+                img_of = rois_np[:, 0].astype(np.int64)
+                iou = iou_matrix(rois_np[:, 1:5], gt_b)   # (R, B)
+                roi_iou = iou[np.arange(len(rois_np)), img_of]
+                roi_lab = np.where(roi_iou >= 0.5,
+                                   1 + cls_b[img_of], 0).astype(np.float32)
+                head_logits = net.roi_head(f, rois)
+                hlogp = nd.log_softmax(head_logits, axis=-1)
+                # proposals skew background; upweight the scarcer fg rois
+                hw = nd.array(np.where(roi_lab > 0, 3.0, 1.0))
+                ce_roi = -nd.pick(hlogp, nd.array(roi_lab), axis=-1) * hw
+                head_loss = ce_roi.sum() / hw.sum()
+
+                loss = rpn_cls_loss + rpn_box_loss + head_loss
+            loss.backward()
+            trainer.step(1)
+            tot_r += float((rpn_cls_loss + rpn_box_loss).asscalar())
+            tot_h += float(head_loss.asscalar())
+        print(f"epoch {epoch} rpn_loss {tot_r / nb:.4f} "
+              f"head_loss {tot_h / nb:.4f}")
+
+    # eval on fresh images: best-scoring non-background ROI per image
+    xv, gtv, clsv = make_data(64, np.random.RandomState(args.seed + 1))
+    f, cls, box = net.rpn(nd.array(xv))
+    rois = net.proposals(cls, box, len(xv))
+    scores = nd.softmax(net.roi_head(f, rois), axis=-1).asnumpy()
+    rois_np = rois.asnumpy()
+    ious, cls_ok = [], 0
+    for i in range(len(xv)):
+        mine = np.where(rois_np[:, 0] == i)[0]
+        fg = scores[mine, 1:]
+        r = mine[np.argmax(fg.max(axis=1))]
+        pred_cls = int(np.argmax(scores[r, 1:]))
+        iou = iou_matrix(rois_np[r:r + 1, 1:5], gtv[i][None])[0, 0]
+        ious.append(iou)
+        cls_ok += int(pred_cls == clsv[i])
+    print(f"mean_iou: {float(np.mean(ious)):.4f}")
+    print(f"cls_accuracy: {cls_ok / len(xv):.4f}")
+    return float(np.mean(ious)), cls_ok / len(xv)
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

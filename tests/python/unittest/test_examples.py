@@ -334,3 +334,44 @@ def test_lstnet_example():
     persist = float(lines[-2].split(":")[1])
     val = float(lines[-1].split(":")[1])
     assert val < persist * 0.85, (persist, val)
+
+
+@pytest.mark.slow
+def test_fcn_segmentation_example():
+    """FCN-16s-style segmentation (reference example/fcn-xs): deconv
+    upsampling + skip fusion must segment held-out shapes."""
+    out = _run("fcn-xs/fcn_seg.py", "--epochs", "6", timeout=900)
+    lines = out.strip().splitlines()
+    pix = float(lines[-2].split(":")[1])
+    miou = float(lines[-1].split(":")[1])
+    assert pix > 0.9, out[-500:]
+    assert miou > 0.5, out[-500:]
+
+
+@pytest.mark.slow
+def test_dsd_example():
+    """Dense-sparse-dense (reference example/dsd): pruning half the
+    weights and retraining must not lose accuracy, and the released
+    dense pass must finish at least as good as the first."""
+    out = _run("dsd/dsd_mlp.py", "--epochs-per-phase", "4", timeout=600)
+    lines = out.strip().splitlines()
+    d1 = float(lines[-3].split(":")[1])
+    sp = float(lines[-2].split(":")[1])
+    dsd = float(lines[-1].split(":")[1])
+    pruned_line = [l for l in out.splitlines() if l.startswith("pruned:")][0]
+    pruned = float(pruned_line.split(":")[1].split("%")[0]) / 100
+    assert 0.4 <= pruned <= 0.6, pruned               # ~50% really pruned
+    assert sp > d1 - 0.05, (d1, sp)
+    assert dsd > d1 - 0.02, (d1, dsd)
+
+
+@pytest.mark.slow
+def test_rcnn_example():
+    """Two-stage detector (reference example/rcnn): RPN -> Proposal NMS ->
+    ROIAlign -> region head; best proposal must localise and classify."""
+    out = _run("rcnn/train_rcnn.py", timeout=1200)
+    lines = out.strip().splitlines()
+    miou = float(lines[-2].split(":")[1])
+    acc = float(lines[-1].split(":")[1])
+    assert miou > 0.45, out[-600:]
+    assert acc > 0.85, out[-600:]
