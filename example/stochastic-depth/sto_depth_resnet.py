@@ -1,0 +1,121 @@
+"""Stochastic depth: residual blocks randomly skipped during training
+(parity: `example/stochastic-depth/sto_depth_mnist.py` — each block has
+survival probability p_l decaying linearly with depth; at test time every
+block runs, scaled by p_l).
+
+TPU-native notes: the gate is a bernoulli draw per block per batch from
+the framework RNG inside the recorded graph — a scalar multiply, not
+python control flow, so the compiled step stays branch-free (XLA sees
+`out = gate * f(x) + x`) and the same program serves every gate draw.
+
+  JAX_PLATFORMS=cpu python example/stochastic-depth/sto_depth_resnet.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Block, Trainer, loss as gloss, nn
+
+parser = argparse.ArgumentParser(
+    description="stochastic-depth residual net on synthetic digits",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--epochs", type=int, default=8)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--n-train", type=int, default=1024)
+parser.add_argument("--n-blocks", type=int, default=6)
+parser.add_argument("--p-last", type=float, default=0.5,
+                    help="survival probability of the deepest block")
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--seed", type=int, default=0)
+
+
+class ResBlock(Block):
+    def __init__(self, channels, survive_p, **kwargs):
+        super().__init__(**kwargs)
+        self.survive_p = survive_p
+        self.c1 = nn.Conv2D(channels, 3, padding=1, activation="relu")
+        self.c2 = nn.Conv2D(channels, 3, padding=1)
+
+    def forward(self, x):
+        f = self.c2(self.c1(x))
+        if autograd.is_training():
+            # one bernoulli gate per batch; straight-through residual
+            gate = (nd.random.uniform(0, 1, shape=(1,))
+                    < self.survive_p).astype("float32")
+            return nd.relu(x + gate * f)
+        return nd.relu(x + self.survive_p * f)     # expected-value scaling
+
+
+class StoDepthNet(Block):
+    def __init__(self, n_blocks, p_last, **kwargs):
+        super().__init__(**kwargs)
+        self.stem = nn.Conv2D(16, 3, padding=1, activation="relu")
+        self.blocks = nn.Sequential()
+        for l in range(n_blocks):
+            p = 1.0 - (l + 1) / n_blocks * (1.0 - p_last)   # linear decay
+            self.blocks.add(ResBlock(16, p))
+        # class identity lives in the block POSITION, so keep spatial
+        # structure: pool to 4x4, then a dense readout (GAP would average
+        # position away on this task)
+        self.pool = nn.MaxPool2D(4)
+        self.fc = nn.Dense(4)
+
+    def forward(self, x):
+        return self.fc(self.pool(self.blocks(self.stem(x))))
+
+
+def make_data(n, rng):
+    x = rng.uniform(0, 0.3, (n, 1, 16, 16)).astype(np.float32)
+    y = rng.randint(0, 4, n)
+    for i in range(n):
+        r, c = divmod(int(y[i]), 2)
+        x[i, 0, 2 + 6 * r:8 + 6 * r, 2 + 6 * c:8 + 6 * c] += 0.7
+    return x, y.astype(np.float32)
+
+
+def main(args):
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    xs, ys = make_data(args.n_train, rng)
+    n_val = args.n_train // 4
+    x_tr, y_tr = nd.array(xs[n_val:]), nd.array(ys[n_val:])
+    x_va, y_va = nd.array(xs[:n_val]), nd.array(ys[:n_val])
+
+    net = StoDepthNet(args.n_blocks, args.p_last)
+    net.initialize(mx.init.Xavier())
+    sce = gloss.SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": args.lr, "momentum": 0.9})
+
+    nb = x_tr.shape[0] // args.batch_size
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for b in range(nb):
+            sl = slice(b * args.batch_size, (b + 1) * args.batch_size)
+            with autograd.record():
+                loss = sce(net(x_tr[sl]), y_tr[sl])
+            loss.backward()
+            trainer.step(args.batch_size)
+            tot += float(loss.mean().asscalar())
+        print(f"epoch {epoch} loss {tot / nb:.4f}")
+
+    # eval runs every block deterministically (expected-value scaling)
+    acc = float((net(x_va).argmax(axis=1) == y_va).mean().asscalar())
+    # determinism check: two eval passes must agree exactly
+    same = float((net(x_va).argmax(axis=1) == net(x_va).argmax(axis=1))
+                 .mean().asscalar())
+    assert same == 1.0, "expected-value eval must be deterministic"
+    print(f"val_accuracy: {acc:.4f}")
+    print(f"eval_deterministic: {same:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -375,3 +375,29 @@ def test_rcnn_example():
     acc = float(lines[-1].split(":")[1])
     assert miou > 0.45, out[-600:]
     assert acc > 0.85, out[-600:]
+
+
+@pytest.mark.slow
+def test_stochastic_depth_example():
+    """Stochastic depth (reference example/stochastic-depth): random
+    block gates during training, deterministic expected-value eval."""
+    out = _run("stochastic-depth/sto_depth_resnet.py", timeout=600)
+    lines = out.strip().splitlines()
+    acc = float(lines[-2].split(":")[1])
+    det = float(lines[-1].split(":")[1])
+    assert acc > 0.9, out[-500:]
+    assert det == 1.0, det
+
+
+@pytest.mark.slow
+def test_bayes_by_backprop_example():
+    """Bayes by Backprop (reference example/bayesian-methods): the
+    posterior-sampled net must fit the data AND show inflated predictive
+    spread where there is no data (extrapolation)."""
+    out = _run("bayesian-methods/bayes_by_backprop.py",
+               "--epochs", "600", timeout=900)
+    lines = out.strip().splitlines()
+    rmse = float(lines[-2].split(":")[1])
+    ratio = float(lines[-1].split(":")[1])
+    assert rmse < 0.3, out[-500:]
+    assert ratio > 1.3, ratio
