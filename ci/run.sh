@@ -57,7 +57,12 @@ entrypoints() {
   env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python __graft_entry__.py
   log "bench smoke (CPU, reduced steps)"
-  env JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 BENCH_ITERS=2 timeout 900 python bench.py
+  # fresh compile cache: XLA:CPU AOT entries are machine-feature-pinned,
+  # and a cache written on another host can SIGILL here
+  bench_cache="$(mktemp -d)"
+  env JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 BENCH_ITERS=2 \
+      BENCH_COMPILE_CACHE="$bench_cache" timeout 900 python bench.py
+  rm -rf "$bench_cache"
 }
 
 case "$stage" in
