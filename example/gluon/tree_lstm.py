@@ -1,0 +1,191 @@
+"""Child-sum Tree-LSTM over expression trees (parity:
+`example/gluon/tree_lstm/` — recursive composition over tree structure;
+the reference walks trees with recursive python per sample).
+
+TPU-native notes: recursion is restructured as LEVEL-SYNCHRONOUS batched
+updates — all nodes at depth d across the whole batch update in one
+step, reading their children's states with a batched gather (padded
+"null child" slot holds zeros). The level loop is a static unroll over
+max depth, so the entire batch of irregular trees is one fixed-shape
+compiled program: no per-sample python recursion, no ragged shapes.
+
+Task (zero-egress, structure-sensitive): leaves hold digits 0..4,
+internal nodes hold + or *; the label is the expression value mod 5.
+Getting this right REQUIRES composing along the tree — bag-of-tokens
+cannot solve it.
+
+  JAX_PLATFORMS=cpu python example/gluon/tree_lstm.py --epochs 30
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Block, Trainer, loss as gloss, nn
+
+parser = argparse.ArgumentParser(
+    description="tree-lstm evaluates expression trees mod 5",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--epochs", type=int, default=30)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--n-train", type=int, default=2048)
+parser.add_argument("--n-leaves", type=int, default=4)
+parser.add_argument("--embed", type=int, default=24)
+parser.add_argument("--hidden", type=int, default=48)
+parser.add_argument("--lr", type=float, default=0.005)
+parser.add_argument("--seed", type=int, default=0)
+
+MOD = 5
+TOK_PLUS, TOK_MUL = MOD, MOD + 1      # token ids after the digit tokens
+
+
+def random_tree(n_leaves, rng):
+    """Random binary expression tree; returns (tokens, left, right, depth,
+    value). Node 0 is the root; -1 child = leaf side; arrays are
+    level-order with N = 2*n_leaves - 1 nodes."""
+    n = 2 * n_leaves - 1
+    tokens = np.zeros(n, np.int64)
+    left = -np.ones(n, np.int64)
+    right = -np.ones(n, np.int64)
+    depth = np.zeros(n, np.int64)
+    vals = np.zeros(n, np.int64)
+
+    # grow: start with root as a pending leaf; repeatedly split a random
+    # pending leaf until n_leaves leaves exist
+    next_id = 1
+    pending = [0]
+    internal = []
+    while len(pending) + len(internal) < n:
+        i = pending.pop(rng.randint(len(pending)))
+        left[i], right[i] = next_id, next_id + 1
+        depth[next_id] = depth[next_id + 1] = depth[i] + 1
+        pending += [next_id, next_id + 1]
+        internal.append(i)
+        next_id += 2
+
+    for i in pending:                       # leaves: digits
+        tokens[i] = rng.randint(0, MOD)
+        vals[i] = tokens[i]
+    for i in sorted(internal, key=lambda j: -depth[j]):   # bottom-up eval
+        op = rng.randint(0, 2)
+        tokens[i] = TOK_PLUS if op == 0 else TOK_MUL
+        a, b = vals[left[i]], vals[right[i]]
+        vals[i] = (a + b) % MOD if op == 0 else (a * b) % MOD
+    return tokens, left, right, depth, vals[0]
+
+
+class TreeLSTM(Block):
+    """Child-sum Tree-LSTM (Tai et al.), level-synchronous batched form."""
+
+    def __init__(self, vocab, embed, hidden, n_cls, **kwargs):
+        super().__init__(**kwargs)
+        self.hidden = hidden
+        self.emb = nn.Embedding(vocab, embed)
+        self.wx = nn.Dense(4 * hidden, in_units=embed, flatten=False)
+        self.uh = nn.Dense(3 * hidden, use_bias=False, in_units=hidden,
+                           flatten=False)      # i, o, u from h_sum
+        self.uf = nn.Dense(hidden, use_bias=False, in_units=hidden,
+                           flatten=False)      # per-child forget
+        self.out = nn.Dense(n_cls, in_units=hidden)
+
+    def forward(self, tokens, left, right, level_masks):
+        b, n = tokens.shape
+        h = self.hidden
+        x = self.wx(self.emb(tokens))                  # (B, N, 4H)
+        # state buffers with a trailing null slot (index N) fixed at zero
+        hs = nd.zeros((b, n + 1, h))
+        cs = nd.zeros((b, n + 1, h))
+        # children index -1 -> null slot N
+        l_idx = nd.where(left < 0, nd.full(left.shape, n), left)
+        r_idx = nd.where(right < 0, nd.full(right.shape, n), right)
+        batch_off = nd.arange(0, b).reshape((b, 1)) * (n + 1)
+        l_flat = (l_idx + batch_off).reshape((-1,))
+        r_flat = (r_idx + batch_off).reshape((-1,))
+
+        for mask in level_masks:                       # deepest level first
+            flat_h = hs.reshape((-1, h))
+            flat_c = cs.reshape((-1, h))
+            hl = nd.take(flat_h, l_flat).reshape((b, n, h))
+            hr = nd.take(flat_h, r_flat).reshape((b, n, h))
+            cl = nd.take(flat_c, l_flat).reshape((b, n, h))
+            cr = nd.take(flat_c, r_flat).reshape((b, n, h))
+            hsum = hl + hr
+            gates = x + nd.concat(self.uh(hsum),
+                                  nd.zeros((b, n, h)), dim=2)
+            i = nd.sigmoid(gates[:, :, :h])
+            o = nd.sigmoid(gates[:, :, h:2 * h])
+            u = nd.tanh(gates[:, :, 2 * h:3 * h])
+            fx = gates[:, :, 3 * h:]
+            fl = nd.sigmoid(fx + self.uf(hl))
+            fr = nd.sigmoid(fx + self.uf(hr))
+            c_new = i * u + fl * cl + fr * cr
+            h_new = o * nd.tanh(c_new)
+            m = mask.expand_dims(2)                    # (B, N, 1)
+            hs = nd.concat(nd.where(nd.broadcast_to(m, (b, n, h)) > 0,
+                                    h_new, hs[:, :n]),
+                           nd.zeros((b, 1, h)), dim=1)
+            cs = nd.concat(nd.where(nd.broadcast_to(m, (b, n, h)) > 0,
+                                    c_new, cs[:, :n]),
+                           nd.zeros((b, 1, h)), dim=1)
+        return self.out(hs[:, 0])                      # root state
+
+
+def make_dataset(n, n_leaves, rng):
+    toks, ls, rs, ds, ys = [], [], [], [], []
+    for _ in range(n):
+        t, l, r, d, y = random_tree(n_leaves, rng)
+        toks.append(t); ls.append(l); rs.append(r); ds.append(d); ys.append(y)
+    return (np.stack(toks), np.stack(ls), np.stack(rs), np.stack(ds),
+            np.array(ys, np.int64))
+
+
+def main(args):
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    toks, ls, rs, ds, ys = make_dataset(args.n_train, args.n_leaves, rng)
+    max_d = int(ds.max())
+    # per-level masks, deepest first, shared shape across the batch
+    masks = [nd.array((ds == d).astype(np.float32))
+             for d in range(max_d, -1, -1)]
+    t_all = nd.array(toks.astype(np.float32))
+    l_all = nd.array(ls.astype(np.float32))
+    r_all = nd.array(rs.astype(np.float32))
+    y_all = nd.array(ys.astype(np.float32))
+
+    net = TreeLSTM(MOD + 2, args.embed, args.hidden, MOD)
+    net.initialize(mx.init.Xavier())
+    sce = gloss.SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+
+    n_val = args.n_train // 4
+    nb = (args.n_train - n_val) // args.batch_size
+    acc = 0.0
+    for epoch in range(args.epochs):
+        for b in range(nb):
+            sl = slice(n_val + b * args.batch_size,
+                       n_val + (b + 1) * args.batch_size)
+            lm = [m[sl] for m in masks]
+            with autograd.record():
+                logits = net(t_all[sl], l_all[sl], r_all[sl], lm)
+                loss = sce(logits, y_all[sl])
+            loss.backward()
+            trainer.step(args.batch_size)
+        if epoch % 5 == 0 or epoch == args.epochs - 1:
+            val_logits = net(t_all[:n_val], l_all[:n_val], r_all[:n_val],
+                             [m[:n_val] for m in masks])
+            acc = float((val_logits.argmax(axis=1) == y_all[:n_val])
+                        .mean().asscalar())
+            print(f"epoch {epoch} val_acc {acc:.4f}")
+    print(f"final_val_accuracy: {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -401,3 +401,35 @@ def test_bayes_by_backprop_example():
     ratio = float(lines[-1].split(":")[1])
     assert rmse < 0.3, out[-500:]
     assert ratio > 1.3, ratio
+
+
+@pytest.mark.slow
+def test_super_resolution_example():
+    """ESPCN (reference example/gluon/super_resolution): sub-pixel conv
+    must beat nearest-neighbour upscaling by >2 dB PSNR."""
+    out = _run("gluon/super_resolution.py", "--epochs", "8", timeout=600)
+    lines = out.strip().splitlines()
+    psnr_nn = float(lines[-2].split(":")[1])
+    psnr_sr = float(lines[-1].split(":")[1])
+    assert psnr_sr > psnr_nn + 2.0, (psnr_nn, psnr_sr)
+
+
+@pytest.mark.slow
+def test_tree_lstm_example():
+    """Tree-LSTM (reference example/gluon/tree_lstm): level-synchronous
+    batched recursion must evaluate expression trees (mod-5 value) —
+    a task bag-of-tokens cannot solve."""
+    out = _run("gluon/tree_lstm.py", "--epochs", "25", timeout=1500)
+    acc = float(out.strip().splitlines()[-1].split(":")[1])
+    assert acc > 0.8, out[-500:]
+
+
+@pytest.mark.slow
+def test_house_prices_example():
+    """k-fold CV regression (reference example/gluon/house_prices): the
+    MLP's CV rmse must beat the closed-form linear fit."""
+    out = _run("gluon/house_prices.py", "--epochs", "30", timeout=900)
+    lines = out.strip().splitlines()
+    lin = float(lines[-2].split(":")[1])
+    mlp = float(lines[-1].split(":")[1])
+    assert mlp < lin * 0.8, (lin, mlp)
