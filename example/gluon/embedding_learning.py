@@ -1,0 +1,146 @@
+"""Margin-based metric learning with distance-weighted sampling (parity:
+`example/gluon/embedding_learning/` — learn an embedding where same-class
+pairs are close and different-class pairs are separated by a margin;
+negatives are sampled by distance, not uniformly, and evaluation is
+Recall@1 over nearest neighbours).
+
+TPU-native notes: the batch's pairwise-distance matrix is computed on
+device as one gemm (||a-b||^2 = |a|^2 + |b|^2 - 2ab on the MXU) and
+copied to host ONCE per step for distance-weighted negative sampling
+(label-making); the margin loss itself stays in the compiled graph.
+Recall@1 evaluation is plain host numpy.
+
+  JAX_PLATFORMS=cpu python example/gluon/embedding_learning.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Block, Trainer, nn
+
+parser = argparse.ArgumentParser(
+    description="margin-based embedding learning on synthetic classes",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--epochs", type=int, default=12)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--n-train", type=int, default=1024)
+parser.add_argument("--n-classes", type=int, default=8)
+parser.add_argument("--embed-dim", type=int, default=16)
+parser.add_argument("--margin", type=float, default=0.2)
+parser.add_argument("--beta", type=float, default=1.2,
+                    help="class-agnostic boundary (the reference's beta)")
+parser.add_argument("--lr", type=float, default=0.002)
+parser.add_argument("--seed", type=int, default=0)
+
+
+class EmbedNet(Block):
+    def __init__(self, dim, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.Sequential()
+        self.body.add(nn.Dense(64, activation="relu"),
+                      nn.Dense(dim))
+
+    def forward(self, x):
+        e = self.body(x)
+        return e / (e.norm(axis=1, keepdims=True) + 1e-8)   # unit sphere
+
+
+def make_data(n, n_classes, rng):
+    """Classes are noisy rays in 32-d: class k = direction_k * r + noise.
+    Raw features are NOT linearly separable by distance (mixed radii), so
+    the net must learn the projection."""
+    dirs = rng.normal(0, 1, (n_classes, 32))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    y = rng.randint(0, n_classes, n)
+    r = rng.uniform(0.3, 3.0, n)[:, None]
+    x = dirs[y] * r + rng.normal(0, 0.35, (n, 32))
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def recall_at_1(emb, y):
+    d = ((emb[:, None, :] - emb[None, :, :]) ** 2).sum(axis=2)
+    np.fill_diagonal(d, np.inf)
+    nn_idx = d.argmin(axis=1)
+    return float((y[nn_idx] == y).mean())
+
+
+def sample_neg(d_row, y, yi, rng):
+    """Distance-weighted negative sampling (the reference's point:
+    uniform sampling wastes gradients on far-away easy negatives).
+    Weight ~ 1/d so near-boundary negatives dominate."""
+    cand = np.where(y != yi)[0]
+    w = 1.0 / (d_row[cand] + 1e-3)
+    return int(rng.choice(cand, p=w / w.sum()))
+
+
+def main(args):
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    xs, ys = make_data(args.n_train, args.n_classes, rng)
+    n_val = args.n_train // 4
+    x_tr, y_tr = xs[n_val:], ys[n_val:]
+    x_va, y_va = xs[:n_val], ys[:n_val]
+
+    net = EmbedNet(args.embed_dim)
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+
+    # pre-training recall (the bar the learned embedding must clear)
+    base_recall = recall_at_1(x_va, y_va)
+
+    nb = len(x_tr) // args.batch_size
+    for epoch in range(args.epochs):
+        tot = 0.0
+        order = rng.permutation(len(x_tr))
+        for b in range(nb):
+            idx = order[b * args.batch_size:(b + 1) * args.batch_size]
+            xb = nd.array(x_tr[idx])
+            yb = y_tr[idx]
+            # embed once to measure distances for sampling (host side)
+            with autograd.record():
+                e = net(xb)
+                # pairwise distances ON DEVICE, matmul-shaped:
+                # ||a-b||^2 = |a|^2 + |b|^2 - 2ab (one gemm on the MXU)
+                sq = (e.detach() ** 2).sum(axis=1, keepdims=True)
+                d_nd = sq + sq.T - 2.0 * nd.dot(e.detach(), e.detach().T)
+                d = np.clip(d_nd.asnumpy(), 0, None)  # host copy for sampling
+                anchors, pos, neg = [], [], []
+                for a in range(len(idx)):
+                    same = np.where((yb == yb[a]) &
+                                    (np.arange(len(idx)) != a))[0]
+                    if not len(same):
+                        continue
+                    anchors.append(a)
+                    pos.append(int(rng.choice(same)))
+                    neg.append(sample_neg(d[a], yb, yb[a], rng))
+                ai = nd.array(np.array(anchors, np.float32))
+                pi = nd.array(np.array(pos, np.float32))
+                ni = nd.array(np.array(neg, np.float32))
+                ea, ep, en = nd.take(e, ai), nd.take(e, pi), nd.take(e, ni)
+                d_ap = ((ea - ep) ** 2).sum(axis=1).sqrt()
+                d_an = ((ea - en) ** 2).sum(axis=1).sqrt()
+                # margin loss (Wu et al.): hinge both sides of beta
+                loss = (nd.relu(d_ap - args.beta + args.margin)
+                        + nd.relu(args.beta - d_an + args.margin)).mean()
+            loss.backward()
+            trainer.step(1)          # loss is already a mean
+            tot += float(loss.asscalar())
+        print(f"epoch {epoch} margin_loss {tot / nb:.4f}")
+
+    emb_va = net(nd.array(x_va)).asnumpy()
+    learned_recall = recall_at_1(emb_va, y_va)
+    print(f"recall_at_1_raw: {base_recall:.4f}")
+    print(f"recall_at_1_learned: {learned_recall:.4f}")
+    return learned_recall, base_recall
+
+
+if __name__ == "__main__":
+    main(parser.parse_args())

@@ -433,3 +433,16 @@ def test_house_prices_example():
     lin = float(lines[-2].split(":")[1])
     mlp = float(lines[-1].split(":")[1])
     assert mlp < lin * 0.8, (lin, mlp)
+
+
+@pytest.mark.slow
+def test_embedding_learning_example():
+    """Margin-based metric learning (reference
+    example/gluon/embedding_learning): the learned embedding's Recall@1
+    must clearly beat raw-feature nearest-neighbour."""
+    out = _run("gluon/embedding_learning.py", timeout=900)
+    lines = out.strip().splitlines()
+    raw = float(lines[-2].split(":")[1])
+    learned = float(lines[-1].split(":")[1])
+    assert learned > raw + 0.05, (raw, learned)
+    assert learned > 0.85, learned
