@@ -172,14 +172,36 @@ class Block:
 
     def collect_params(self, select=None):
         """Return a ParameterDict with this block's and all children's
-        Parameters, optionally filtered by regex ``select``."""
+        Parameters, optionally filtered by regex ``select``.
+
+        Direct Parameter attributes (``self.w = Parameter(...)``) are
+        included under ``"<block_name>.<attr>"`` keys and fully support
+        imperative training, ``initialize`` and ``save_parameters`` /
+        ``load_parameters`` (which key by attribute path). They are NOT
+        visible to the 1.x symbolic surfaces — ``HybridBlock.export`` and
+        prefix-keyed ``ParameterDict.save/load`` — which match the
+        ParameterDict-created prefixed names; use ``self.params.get``
+        for parameters that must round-trip through symbol JSON."""
         self._check_container_with_block()
         ret = ParameterDict(self._params.prefix)
+        # direct Parameter ATTRIBUTES (2.x style: `self.w = Parameter(...)`)
+        # live in _reg_params only; without this they would be saved by
+        # save_parameters (which walks _reg_params) yet invisible to
+        # initialize()/Trainer — silently untrained parameters. Keyed by
+        # the block's unique instance name (user-chosen Parameter names
+        # like "weight" repeat across sibling layers).
+        lib_params = set(map(id, self.params.values()))
+        direct = {f"{self.name}.{attr}": p
+                  for attr, p in self._reg_params.items()
+                  if id(p) not in lib_params}
         if not select:
             ret.update(self.params)
+            ret.update(direct)
         else:
             pattern = re.compile(select)
             ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+            ret.update({name: value for name, value in direct.items()
                         if pattern.match(name)})
         for cld in self._children.values():
             ret.update(cld.collect_params(select=select))

@@ -128,8 +128,13 @@ class Parameter:
             ctx = [current_context()]
         if isinstance(ctx, Context):
             ctx = [ctx]
-        if init is None:
-            init = default_init if self.init is None else self.init
+        if init is None and self.init is not None:
+            init = self.init
+        # NOTE: init stays None when the param merely inherits the GLOBAL
+        # default_init — _finish_deferred_init then routes through the
+        # name-suffix dispatch (weight->init_weight, bias->zeros, ...).
+        # Collapsing default_init into init here would ride the InitDesc
+        # `__init__` attr and force e.g. Xavier onto a 1-d "bias" param.
         if not _shape_complete(self._shape):
             if self.allow_deferred_init:
                 self._deferred_init = (init, ctx, default_init, None)

@@ -39,7 +39,11 @@ class Trainer:
                 raise ValueError(
                     "First argument must be a list or dict of Parameters, "
                     f"got list of {type(param)}.")
-            self._param2idx[param.name] = i
+            # keyed by identity: Parameter NAMES may repeat across sibling
+            # blocks (2.x-style direct attributes, e.g. two "weight"s) and a
+            # name-keyed table would silently collapse two params onto one
+            # kvstore slot in multi-context/dist runs
+            self._param2idx[id(param)] = i
             self._params.append(param)
             param._set_trainer(self)
         self._compression_params = compression_params
@@ -92,8 +96,8 @@ class Trainer:
     def _init_kvstore(self):
         """Create kvstore and set update-on-kvstore (parity trainer.py:169)."""
         config = self._kvstore_params
-        arg_arrays = {param.name: param.data(self._contexts[0])
-                      for param in self._params}
+        arg_arrays = {f"{i}_{param.name}": param.data(self._contexts[0])
+                      for i, param in enumerate(self._params)}
         kvstore, update_on_kvstore = _create_kvstore(
             config["kvstore"], len(self._contexts), arg_arrays)
         self._distributed = "dist" in kvstore.type if kvstore else False
@@ -130,7 +134,7 @@ class Trainer:
                     params_to_init.append(param)
                 else:
                     param_arrays = param._check_and_get(param._data, list)
-                    idx = self._param2idx[param.name]
+                    idx = self._param2idx[id(param)]
                     self._kvstore.init(idx, param_arrays[0])
                     if param._stype == "default" and self._update_on_kvstore:
                         self._kvstore.pull(idx, param_arrays, priority=-idx)
@@ -298,7 +302,7 @@ class Trainer:
         from ..ndarray import NDArray
         from ..ndarray.sparse import RowSparseNDArray
 
-        idx = self._param2idx[parameter.name]
+        idx = self._param2idx[id(parameter)]
         w = parameter._check_and_get(parameter._data, None)
         # a row_sparse out makes the store hand back only (indices, rows)
         tmp = RowSparseNDArray(

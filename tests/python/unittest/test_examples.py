@@ -446,3 +446,19 @@ def test_embedding_learning_example():
     learned = float(lines[-1].split(":")[1])
     assert learned > raw + 0.05, (raw, learned)
     assert learned > 0.85, learned
+
+
+@pytest.mark.slow
+def test_sn_gan_example():
+    """Spectral-norm GAN (reference example/gluon/sn_gan): the power-
+    iteration constraint must hold exactly (norms ~1 — the Lipschitz
+    certificate) and the hinge-trained generator must move mass from the
+    origin toward the radius-2 ring."""
+    out = _run("gluon/sn_gan.py", "--epochs", "5", timeout=900)
+    lines = out.strip().splitlines()
+    norms = [float(v) for v in lines[-3].split(":")[1].split()]
+    mean_r = float(lines[-2].split(":")[1])
+    std_r = float(lines[-1].split(":")[1])
+    assert all(0.95 < n < 1.05 for n in norms), norms
+    assert 1.0 < mean_r < 3.2, mean_r          # untrained gen sits near 0
+    assert std_r < 1.2, std_r

@@ -304,3 +304,64 @@ def test_explicit_initialize_overrides_param_init():
     p2 = Parameter("custom_transitions", shape=(64,), init=mx.init.One())
     p2.initialize()  # param-specific init despite the unknown suffix
     np.testing.assert_allclose(p2.data().asnumpy(), 1.0)
+
+
+def test_direct_parameter_attribute_collected():
+    """A Parameter assigned directly as a Block attribute (2.x style) must
+    be visible to collect_params()/initialize()/Trainer — previously it
+    was saved by save_parameters (which walks _reg_params) yet silently
+    invisible to training. Sibling blocks reusing the same user-chosen
+    Parameter name must not collide."""
+    class Custom(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.weight = gluon.Parameter("weight", shape=(3, 4))
+
+        def forward(self, x):
+            return mx.nd.dot(x, self.weight.data())
+
+    class Top(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.a, self.b = Custom(), Custom()
+            self.dense = gluon.nn.Dense(2)
+
+        def forward(self, x):
+            return self.dense(self.a(x) + self.b(x))
+
+    net = Top()
+    params = net.collect_params()
+    direct = [k for k in params if k.endswith(".weight")]
+    assert len(direct) == 2, sorted(params.keys())     # both siblings, no collision
+    net.initialize(mx.init.Xavier())
+    out = net(mx.nd.ones((2, 3)))
+    assert out.shape == (2, 2)
+    # and they train: grads reach the direct parameters through Trainer
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    before = net.a.weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = (net(mx.nd.ones((2, 3))) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    after = net.a.weight.data().asnumpy()
+    assert np.abs(after - before).max() > 0
+
+
+def test_custom_named_parameter_init_dispatch():
+    """Reference parity (initializer suffix dispatch): a raw Parameter
+    whose name matches no weight/bias/... pattern must raise a CLEAR
+    error under a global initializer (the reference's 'Unknown
+    initialization pattern'), while a per-param init= applies regardless
+    of the name, and suffix-matched names route correctly (bias -> zeros
+    even under a global Xavier, which cannot init 1-d arrays)."""
+    p = gluon.Parameter("transitions", shape=(3, 3))
+    with pytest.raises(Exception, match="[Uu]nknown|pattern"):
+        p.initialize(default_init=mx.init.Xavier())
+
+    q = gluon.Parameter("transitions", shape=(3, 3), init=mx.init.Constant(2.0))
+    q.initialize(default_init=mx.init.Xavier())
+    assert float(q.data().asnumpy().mean()) == 2.0
+
+    b = gluon.Parameter("bias", shape=(4,))
+    b.initialize(default_init=mx.init.Xavier())     # suffix -> zeros, no crash
+    assert float(np.abs(b.data().asnumpy()).max()) == 0.0
