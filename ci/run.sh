@@ -39,7 +39,14 @@ build_native() {
 
 unit() {
   log "unit suite (includes the 4-process dist kvstore run and CI-guarded examples)"
-  python -m pytest tests/python/unittest -q -x
+  python -m pytest tests/python/unittest -q -x \
+      --ignore=tests/python/unittest/test_resilience.py
+  # resilience gate, run standalone (not twice) so a fault-injection
+  # failure is attributed loudly. CI runs the whole suite including the
+  # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
+  # command (-m 'not slow') keeps only the fast fault-injection cases
+  log "fault-injection resilience suite (kill-and-resume, torn writes, EIO)"
+  python -m pytest tests/python/unittest/test_resilience.py -q
 }
 
 train() {

@@ -44,6 +44,7 @@ from . import random
 from .random import seed
 
 from . import engine
+from . import resilience
 from . import runtime
 
 from . import initializer

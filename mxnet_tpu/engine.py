@@ -103,9 +103,16 @@ def push(fn, *args, const_vars=(), mutable_vars=(), **kwargs):
     return None
 
 
-def push_io(path, fn, *args, **kwargs):
-    """Push a file write ordered against other writes to `path`."""
-    return push(fn, *args, mutable_vars=(path_var(path),), **kwargs)
+def push_io(path, fn, *args, retries=None, **kwargs):
+    """Push a file write ordered against other writes to `path`. The
+    payload fn rides the resilience retry budget (jittered exponential
+    backoff) so a transient EIO on an engine worker does not lose the
+    write — `fn` must be idempotent (our writers are: temp file + atomic
+    rename). `retries=0` opts out."""
+    from . import resilience
+
+    wrapped = resilience.wrap_retry(fn, desc=path, retries=retries)
+    return push(wrapped, *args, mutable_vars=(path_var(path),), **kwargs)
 
 
 def wait_all():
@@ -126,12 +133,34 @@ def wait_all():
 
 @atexit.register
 def _flush_at_exit():
-    """Pending async checkpoint writes must land before the process dies."""
+    """Pending async checkpoint writes must land before the process dies.
+    Failures here are the WORST place to be silent — a final checkpoint
+    that never hit disk — so they are logged to stderr, never swallowed
+    (the reference engine aborts the process on an op error)."""
     from . import lib
 
     eng = lib._engine  # do not CREATE an engine at exit
     if eng is not None:
         try:
             eng.wait_all()
+        except Exception as e:  # interpreter is dying; log, don't raise
+            _log_exit_error(e)
+    for e in _async_error:
+        _log_exit_error(e)
+    _async_error.clear()
+
+
+def _log_exit_error(e):
+    try:
+        from .log import get_logger
+
+        get_logger("mxnet_tpu.engine").error(
+            "async IO failure pending at interpreter exit "
+            "(a final checkpoint may be lost): %r", e)
+    except Exception:  # logging machinery already torn down
+        import sys
+
+        try:
+            sys.stderr.write(f"mxnet_tpu.engine: async IO failure at exit: {e!r}\n")
         except Exception:
             pass

@@ -135,9 +135,19 @@ def _read_shm_batch(msg):
         _, leaves, spec = msg
         return _unflatten_batch(leaves, spec)
     _, name, metas, spec = msg
-    seg = lib.shared_memory(name, create=False)
-    if seg is None:
-        raise OSError(f"DataLoader: cannot attach shm segment {name}")
+
+    from ...resilience import inject, retry_call
+
+    def _attach():
+        inject("shm", name)
+        seg = lib.shared_memory(name, create=False)
+        if seg is None:
+            raise OSError(f"DataLoader: cannot attach shm segment {name}")
+        return seg
+
+    # attach is idempotent; a transient attach failure (worker still
+    # publishing, /dev/shm pressure) gets the resilience retry budget
+    seg = retry_call(_attach, desc=f"shm attach {name}")
     try:
         mv = memoryview(seg.asarray())
         leaves = []

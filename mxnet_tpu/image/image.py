@@ -57,7 +57,9 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
 
 
 def imread(filename, flag=1, to_rgb=True):
-    with open(filename, "rb") as f:
+    from ..resilience import open_checked
+
+    with open_checked(filename, "rb") as f:
         return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
 
 

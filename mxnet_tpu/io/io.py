@@ -275,7 +275,7 @@ class PrefetchingIter(DataIter):
     otherwise."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2):
+                 prefetch_depth=2, use_engine=None):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
@@ -285,11 +285,18 @@ class PrefetchingIter(DataIter):
         self._depth = prefetch_depth
         self._queue = None
         self._thread = None
+        self._name = (f"PrefetchingIter#{id(self):x}"
+                      f"({','.join(type(i).__name__ for i in iters)})")
         from .. import lib
 
-        self._engine = lib.native_engine()
+        # use_engine: None = native engine when built, False = force the
+        # python-thread fallback, True = require the native engine
+        self._engine = lib.native_engine() if use_engine in (None, True) else None
+        if use_engine and self._engine is None:
+            raise MXNetError("native engine requested but librt_tpu.so is not built")
         self._var = self._engine.new_var() if self._engine is not None else None
         self._epoch = 0
+        self._handoff = None
         self._start()
 
     @property
@@ -350,17 +357,37 @@ class PrefetchingIter(DataIter):
                 self._push_fetch()
             return
 
-        def worker():
-            while not self._stop.is_set():
+        # q/stop are bound per epoch: a thread wedged across a reset keeps
+        # talking to ITS queue and ITS (already set) stop event, never the
+        # replacement epoch's. `handoff` is a predecessor that outlived its
+        # join timeout: the new worker waits it out (and only then resets
+        # the sources) so two threads never touch the source iters at once.
+        def worker(q=self._queue, stop=self._stop, prev=self._handoff):
+            from .. import resilience
+
+            if prev is not None:
+                prev.join()
+                for it in self.iters:
+                    it.reset()
+            while not stop.is_set():
+                try:
+                    resilience.inject("prefetch", self._name)
+                except resilience.ThreadKilled:
+                    return  # simulated silent thread death
                 item = self._fetch_one()
-                self._queue.put(item)
+                q.put(item)
                 if item is None or isinstance(item, Exception):
                     return
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._handoff = None
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name=self._name)
         self._thread.start()
 
     def reset(self):
+        from ..base import getenv
+        from ..log import get_logger
+
         self._stop.set()
         self._epoch += 1  # stale engine pushes become no-ops
         if self._engine is not None:
@@ -372,16 +399,31 @@ class PrefetchingIter(DataIter):
                 self._queue.get_nowait()
         except _queue.Empty:
             pass
+        stale = None
         if self._thread is not None:
-            self._thread.join(timeout=5)
-        for it in self.iters:
-            it.reset()
+            timeout = float(getenv("MXNET_PREFETCH_JOIN_TIMEOUT"))
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # a wedged fetch (hung filesystem, deadlocked source iter)
+                # cannot be killed from python — abandon the daemon thread
+                # but never silently: the epoch it blocks is lost work
+                get_logger("mxnet_tpu.io").warning(
+                    "%s: prefetch thread still alive %.1fs after reset(); "
+                    "new epoch is deferred until it exits (source iterator "
+                    "may be wedged)", self._name, timeout)
+                stale = self._thread
+        self._handoff = stale
+        if stale is None:
+            for it in self.iters:
+                it.reset()
+        # else: the replacement worker joins the stale thread and resets
+        # the sources itself — two threads must never share the iters
         self._start()
 
     def next(self):
         if self._engine is not None and self._done:
             raise StopIteration
-        item = self._queue.get()
+        item = self._get_item()
         if item is None:
             if self._engine is not None:
                 self._done = True
@@ -391,6 +433,27 @@ class PrefetchingIter(DataIter):
         if self._engine is not None and not self._done:
             self._push_fetch()  # keep the pipeline `depth` deep
         return item
+
+    def _get_item(self):
+        """Blocking queue read that cannot hang forever on a dead producer:
+        the python-thread path polls worker liveness, so a prefetch thread
+        that dies without delivering (kill injection, interpreter bug)
+        surfaces as MXNetError instead of a wedged training loop."""
+        if self._thread is None:
+            return self._queue.get()
+        while True:
+            try:
+                return self._queue.get(timeout=0.1)
+            except _queue.Empty:
+                if self._thread.is_alive():
+                    continue
+                try:
+                    # the final put may have raced the liveness check
+                    return self._queue.get_nowait()
+                except _queue.Empty:
+                    raise MXNetError(
+                        f"{self._name}: prefetch thread died without "
+                        "delivering a batch") from None
 
     def iter_next(self):
         try:

@@ -73,12 +73,18 @@ class KVStoreBase:
         self._updater = updater
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
-        assert self._updater is not None, "Cannot save states for distributed training"
+        # a real error, not an assert: under `python -O` a bare assert
+        # vanishes and this would write corrupt (None) state instead
+        if self._updater is None:
+            raise MXNetError("cannot save optimizer states: no updater set "
+                             "(call set_optimizer first)")
         with open(fname, "wb") as fout:
             fout.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
-        assert self._updater is not None, "Cannot load states for distributed training"
+        if self._updater is None:
+            raise MXNetError("cannot load optimizer states: no updater set "
+                             "(call set_optimizer first)")
         with open(fname, "rb") as fin:
             self._updater.set_states(fin.read())
 

@@ -9,11 +9,15 @@ trainer.)
 from __future__ import annotations
 
 import os
+import re
 
 from . import ndarray as nd
 from . import kvstore as kvs
+from .base import MXNetError, getenv
+from .log import get_logger
 
-__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+__all__ = ["save_checkpoint", "load_checkpoint", "find_latest_checkpoint",
+           "list_checkpoint_epochs", "BatchEndParam"]
 
 from collections import namedtuple
 
@@ -78,27 +82,132 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
             updater(i, w, g)
 
 
+def _param_path(prefix, epoch):
+    return "%s-%04d.params" % (prefix, epoch)
+
+
+def list_checkpoint_epochs(prefix):
+    """Sorted epoch numbers with an existing `prefix-####.params` file."""
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    pat = re.compile(re.escape(base) + r"-(\d{4,})\.params$")  # %04d grows past epoch 9999
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return []
+    return sorted(int(m.group(1)) for m in map(pat.match, entries) if m)
+
+
+def find_latest_checkpoint(prefix):
+    """Newest saved epoch for `prefix`, or None — the resume entry point
+    after a preemption (`load_checkpoint(prefix)` uses it implicitly)."""
+    epochs = list_checkpoint_epochs(prefix)
+    return epochs[-1] if epochs else None
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    remove_amp_cast=True):
+                    remove_amp_cast=True, keep=None):
     """Checkpoint: `prefix-symbol.json` + `prefix-####.params`
-    (parity model.py:394)."""
+    (parity model.py:394).
+
+    ``keep`` (or `MXNET_CHECKPOINT_KEEP`) bounds retention: after a
+    successful save only the newest ``keep`` epoch files survive — long
+    runs stop eating the disk that their own resumability depends on.
+    The eviction is ONE engine task ordered after the current epoch's
+    write (const var on the new path, mutable vars on every evicted path)
+    that first verifies the new file end-to-end (CRC scan), so a save
+    that failed or landed torn can never have destroyed the checkpoint a
+    resume would fall back to."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json", remove_amp_cast=remove_amp_cast)
     save_dict = {f"arg:{k}": v.as_in_context(_cpu()) for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v.as_in_context(_cpu()) for k, v in aux_params.items()})
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    cur_path = _param_path(prefix, epoch)
+    nd.save(cur_path, save_dict)
+    keep = getenv("MXNET_CHECKPOINT_KEEP") if keep is None else int(keep)
+    if keep > 0:
+        from . import engine
+
+        survivors = set(list_checkpoint_epochs(prefix)[-keep:]) | {epoch}
+        victims = [_param_path(prefix, old)
+                   for old in list_checkpoint_epochs(prefix)
+                   if old not in survivors]
+        if victims and engine.async_io_enabled():
+            engine.push(_evict_old_epochs, victims, cur_path,
+                        const_vars=(engine.path_var(cur_path),),
+                        mutable_vars=tuple(engine.path_var(p) for p in victims))
+        elif victims:
+            _evict_old_epochs(victims, cur_path)
 
 
-def load_checkpoint(prefix, epoch):
+def _evict_old_epochs(old_paths, new_path):
+    """Remove evicted epoch files, but only after the replacing epoch
+    verifies end-to-end (structural + CRC scan — an async write that
+    failed leaves an empty placeholder, a torn one fails its footers) —
+    never trade the last good checkpoint for an unloadable one."""
+    from .ndarray.utils import checkpoint_intact
+
+    if not checkpoint_intact(new_path):
+        return
+    for p in old_paths:
+        try:
+            os.remove(p)
+        except FileNotFoundError:
+            pass
+
+
+def load_checkpoint(prefix, epoch=None, fallback=None, return_epoch=False):
     """Load a checkpoint (parity model.py:424). Returns (symbol, arg_params,
-    aux_params)."""
+    aux_params) — plus the epoch actually loaded when ``return_epoch``.
+
+    Resilience extensions: ``epoch=None`` loads the newest saved epoch
+    (:func:`find_latest_checkpoint`); when ``fallback`` is true (the
+    default in latest mode) a corrupt or torn epoch file — CRC mismatch,
+    truncation, vanished file — is logged and the next older epoch is
+    tried, so one bad save cannot strand a resumable run. Resume loops
+    should pass ``return_epoch=True`` and set ``begin_epoch`` from the
+    result: after a fallback the loaded epoch is OLDER than the newest
+    file on disk."""
+    from . import engine
     from . import symbol as sym
     symbol = None
     json_path = f"{prefix}-symbol.json"
     if os.path.exists(json_path):
         symbol = sym.load(json_path)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    if fallback is None:
+        fallback = epoch is None
+    if epoch is None:
+        epoch = find_latest_checkpoint(prefix)
+        if epoch is None:
+            raise MXNetError(f"no checkpoints found for prefix {prefix!r}")
+    if engine.async_io_enabled():
+        # surface pending async IO failures NOW, attributed to the writes
+        # that caused them — inside the loop below they would be misread
+        # as "this candidate is unreadable" and silently eaten by fallback
+        engine.wait_all()
+    candidates = [epoch]
+    if fallback:
+        candidates += [e for e in reversed(list_checkpoint_epochs(prefix))
+                       if e < epoch]
+    errors = []
+    save_dict = None
+    loaded_epoch = None
+    for cand in candidates:
+        try:
+            save_dict = nd.load(_param_path(prefix, cand))
+            loaded_epoch = cand
+            break
+        except (MXNetError, OSError) as e:
+            errors.append(e)
+            if not fallback:
+                raise
+            get_logger("mxnet_tpu.model").warning(
+                "checkpoint %s is unreadable (%s); falling back to an "
+                "older epoch", _param_path(prefix, cand), e)
+    if save_dict is None:
+        raise MXNetError(
+            f"no loadable checkpoint for prefix {prefix!r} at or below "
+            f"epoch {epoch}: {errors}") from (errors[-1] if errors else None)
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():
@@ -109,6 +218,8 @@ def load_checkpoint(prefix, epoch):
             aux_params[name] = v
         else:
             arg_params[k] = v
+    if return_epoch:
+        return symbol, arg_params, aux_params, loaded_epoch
     return symbol, arg_params, aux_params
 
 

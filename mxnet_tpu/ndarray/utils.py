@@ -7,18 +7,29 @@ Format: a single-file container with the reference's outer framing
 (magic + reserved + names) so tooling can recognize it, carrying per-array
 payloads as (dtype-flag, ndim, shape, raw bytes) — dense storage only for
 now; sparse arrays save their compound parts.
+
+Integrity (resilience layer): the reserved word carries a format version.
+Version 1 appends a (crc32, length) footer after every array payload;
+`load` verifies each footer and raises
+:class:`~mxnet_tpu.resilience.CorruptCheckpointError` on a mismatch or a
+short read, so `model.load_checkpoint` can fall back to the last good
+epoch instead of silently training from garbage. Version-0 files (the
+reference layout, no footers) still load, unverified.
 """
 from __future__ import annotations
 
 import struct
+import zlib
 
 import numpy as _np
 
 from ..base import _DTYPE_NP_TO_MX, _DTYPE_MX_TO_NP, np_dtype, MXNetError
+from ..resilience import CorruptCheckpointError, inject, retry_call
 
 _MAGIC = 0x112
+_VERSION = 1  # reserved word: 0 = reference layout, 1 = + per-array CRC footers
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "checkpoint_intact"]
 
 
 def _write_array(f, arr):
@@ -31,20 +42,84 @@ def _write_array(f, arr):
     f.write(struct.pack("<I", npv.ndim))
     for s in npv.shape:
         f.write(struct.pack("<q", s))
-    f.write(npv.tobytes())
+    raw = npv.tobytes()
+    f.write(raw)
+    f.write(struct.pack("<Iq", zlib.crc32(raw) & 0xFFFFFFFF, len(raw)))
 
 
-def _read_array(f):
-    from .ndarray import array as _nd_array
+def _read_exact(f, n, fname):
+    buf = f.read(n)
+    if len(buf) != n:
+        raise CorruptCheckpointError(
+            f"{fname}: truncated array file (wanted {n} bytes, got {len(buf)})")
+    return buf
 
-    (flag,) = struct.unpack("<i", f.read(4))
-    (ndim,) = struct.unpack("<I", f.read(4))
-    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+
+def _scan_array(f, fname, has_footer, verify, want_data):
+    """One array record: parse header, consume payload + footer. Returns
+    the numpy value when ``want_data``, else streams the payload in 1 MiB
+    chunks (CRC only — no materialization). EVERY malformed-header path
+    raises CorruptCheckpointError so fallback loaders can catch it."""
+    (flag,) = struct.unpack("<i", _read_exact(f, 4, fname))
+    (ndim,) = struct.unpack("<I", _read_exact(f, 4, fname))
+    shape = tuple(struct.unpack("<q", _read_exact(f, 8, fname))[0]
+                  for _ in range(ndim))
+    if flag not in _DTYPE_MX_TO_NP:
+        raise CorruptCheckpointError(f"{fname}: bad dtype flag {flag}")
+    if any(s < 0 for s in shape):
+        raise CorruptCheckpointError(f"{fname}: negative shape {shape}")
     dt = _np.dtype(_DTYPE_MX_TO_NP[flag])
-    n = int(_np.prod(shape)) if shape else 1
-    buf = f.read(n * dt.itemsize)
-    npv = _np.frombuffer(buf, dtype=dt).reshape(shape)
-    return _nd_array(npv, dtype=dt)
+    total = (int(_np.prod(shape)) if shape else 1) * dt.itemsize
+    if want_data:
+        buf = _read_exact(f, total, fname)
+        crc = zlib.crc32(buf) if verify else 0
+    else:
+        buf, crc, remaining = None, 0, total
+        while remaining:
+            chunk = f.read(min(remaining, 1 << 20))
+            if not chunk:
+                raise CorruptCheckpointError(
+                    f"{fname}: truncated array payload")
+            crc = zlib.crc32(chunk, crc)
+            remaining -= len(chunk)
+    if has_footer:  # footer bytes are part of the v1 layout even unverified
+        want, length = struct.unpack("<Iq", _read_exact(f, 12, fname))
+        if verify and (length != total or (crc & 0xFFFFFFFF) != want):
+            raise CorruptCheckpointError(
+                f"{fname}: CRC mismatch on array payload — checkpoint is corrupt")
+    if not want_data:
+        return None
+    try:
+        return _np.frombuffer(buf, dtype=dt).reshape(shape)
+    except ValueError as e:
+        raise CorruptCheckpointError(f"{fname}: bad array header: {e}") from e
+
+
+def _parse_container(fname, want_data, verify):
+    """The ONE parser of the on-disk container — `load` materializes from
+    it, `checkpoint_intact` merely CRC-walks it — so the two can never
+    diverge on what counts as a valid file."""
+    with open(fname, "rb") as f:
+        (magic,) = struct.unpack("<Q", _read_exact(f, 8, fname))
+        if magic != _MAGIC:
+            raise MXNetError(f"Invalid NDArray file format: {fname}")
+        (version,) = struct.unpack("<Q", _read_exact(f, 8, fname))
+        has_footer = version >= 1
+        verify = has_footer and verify
+        (n,) = struct.unpack("<Q", _read_exact(f, 8, fname))
+        arrays = [_scan_array(f, fname, has_footer, verify, want_data)
+                  for _ in range(n)]
+        (nn,) = struct.unpack("<Q", _read_exact(f, 8, fname))
+        names = []
+        for _ in range(nn):
+            (ln,) = struct.unpack("<Q", _read_exact(f, 8, fname))
+            raw = _read_exact(f, ln, fname)
+            try:
+                names.append(raw.decode())
+            except UnicodeDecodeError as e:
+                raise CorruptCheckpointError(
+                    f"{fname}: undecodable array name") from e
+    return arrays, names
 
 
 def save(fname, data):
@@ -56,7 +131,8 @@ def save(fname, data):
     Engine::PushAsync with the output NDArray vars,
     `src/engine/threaded_engine.cc`), so training does not stall on disk.
     `load` and `engine.wait_all()` are the sync points; writes to the same
-    path stay ordered by the path var."""
+    path stay ordered by the path var. Transient write failures are
+    absorbed by the resilience retry budget on either path."""
     from .ndarray import NDArray
 
     if isinstance(data, NDArray):
@@ -85,24 +161,44 @@ def save(fname, data):
         open(fname, "ab").close()
         engine.push_io(fname, _write_file, fname, names, snaps)
     else:
-        _write_file(fname, names, snaps)
+        retry_call(_write_file, fname, names, snaps, desc=fname)
 
 
 def _write_file(fname, names, arrays):
-    """Write to a temp file then atomically rename: an out-of-band reader
-    racing the async engine sees the empty placeholder or the complete
-    file, never torn content."""
+    """Write to a temp file, fsync, then atomically rename: an out-of-band
+    reader racing the async engine sees the empty placeholder or the
+    complete file, never torn content — and the fsync-before-rename means
+    a host crash right after the rename cannot leave a renamed file whose
+    data pages never hit disk (the torn-after-crash case CRC verification
+    exists to catch, closed at the source). The `write` fault point covers
+    both the transient-EIO and torn-write (truncate=K) injection cases."""
     import os
 
+    rule = inject("write", fname)
     tmp = fname + ".tmp~"
     _write_payload(tmp, names, arrays)
+    if rule is not None and rule.truncate is not None:
+        with open(tmp, "rb+") as f:
+            f.truncate(rule.truncate)
+            f.flush()
+            os.fsync(f.fileno())
     os.replace(tmp, fname)
+    try:  # make the rename itself durable
+        dfd = os.open(os.path.dirname(os.path.abspath(fname)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platform without directory fsync
 
 
 def _write_payload(fname, names, arrays):
+    import os
+
     with open(fname, "wb") as f:
         f.write(struct.pack("<Q", _MAGIC))
-        f.write(struct.pack("<Q", 0))  # reserved
+        f.write(struct.pack("<Q", _VERSION))
         f.write(struct.pack("<Q", len(arrays)))
         for a in arrays:
             _write_array(f, a)
@@ -111,28 +207,37 @@ def _write_payload(fname, names, arrays):
             b = nm.encode()
             f.write(struct.pack("<Q", len(b)))
             f.write(b)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def checkpoint_intact(fname):
+    """True iff ``fname`` parses end-to-end as a saved array file, with
+    every v1 CRC footer verified (always — `MXNET_CHECKPOINT_VERIFY` only
+    relaxes `load`): a streaming scan cheap enough for checkpoint
+    retention to run before evicting the fallback epochs. Does NOT wait
+    on the engine; callers sequence themselves against in-flight writes."""
+    try:
+        _parse_container(fname, want_data=False, verify=True)
+    except (MXNetError, OSError, struct.error):
+        return False
+    return True
 
 
 def load(fname):
     """Load arrays saved by :func:`save` (parity `mx.nd.load`): waits for
     any pending async writes first (the read side of the engine's
-    write-var ordering)."""
+    write-var ordering), then verifies per-array CRC footers (version-1
+    files; `MXNET_CHECKPOINT_VERIFY=0` skips the check)."""
+    from ..base import getenv
+    from .ndarray import array as _nd_array
     from .. import engine
 
     if engine.async_io_enabled():
         engine.wait_all()
-    with open(fname, "rb") as f:
-        (magic,) = struct.unpack("<Q", f.read(8))
-        if magic != _MAGIC:
-            raise MXNetError(f"Invalid NDArray file format: {fname}")
-        f.read(8)
-        (n,) = struct.unpack("<Q", f.read(8))
-        arrays = [_read_array(f) for _ in range(n)]
-        (nn,) = struct.unpack("<Q", f.read(8))
-        names = []
-        for _ in range(nn):
-            (ln,) = struct.unpack("<Q", f.read(8))
-            names.append(f.read(ln).decode())
+    raw, names = _parse_container(fname, want_data=True,
+                                  verify=bool(getenv("MXNET_CHECKPOINT_VERIFY")))
+    arrays = [_nd_array(npv, dtype=npv.dtype) for npv in raw]
     if not names:
         return arrays
     return dict(zip(names, arrays))

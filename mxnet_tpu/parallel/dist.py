@@ -76,9 +76,37 @@ def init_process_group(coordinator=None, num_processes=None, process_id=None):
     if process_id is None:
         process_id = int(
             os.environ.get("MXNET_PROCESS_ID", os.environ.get("DMLC_WORKER_ID", "0")))
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    kwargs = dict(coordinator_address=coordinator,
+                  num_processes=num_processes, process_id=process_id)
+    # bounded rendezvous: without a timeout a worker whose coordinator died
+    # (or whose fleet never fully launched) hangs forever with no hint
+    from ..base import getenv
+
+    timeout_s = int(getenv("MXNET_INIT_TIMEOUT_S"))
+    if timeout_s:
+        # feature-detect instead of try/except TypeError: a TypeError from
+        # INSIDE initialize must not silently drop the user's timeout
+        import inspect
+
+        try:
+            params = inspect.signature(jax.distributed.initialize).parameters
+        except (TypeError, ValueError):
+            params = {}
+        if "initialization_timeout" in params:
+            kwargs["initialization_timeout"] = timeout_s
+    try:
+        jax.distributed.initialize(**kwargs)
+    except Exception as e:
+        from ..log import get_logger
+
+        get_logger("mxnet_tpu.dist").error(
+            "process group rendezvous failed: coordinator=%s rank=%d/%d "
+            "(%r). Check that the coordinator host:port is reachable, that "
+            "ALL %d workers launched, and that every rank in [0, %d) is "
+            "claimed exactly once (MXNET_PROCESS_ID / DMLC_WORKER_ID).",
+            coordinator, process_id, num_processes, e,
+            num_processes, num_processes)
+        raise
     _initialized = True
 
 
@@ -489,17 +517,28 @@ class KVStoreDistTPUSync(KVStoreBase):
         self._gc.set_params(compression_params)
 
     def barrier(self):
+        """Fleet sync point, with straggler diagnostics: a barrier that
+        takes longer than `MXNET_BARRIER_WARN_S` logs which rank noticed
+        and how long it stalled — the first symptom of a dead or wedged
+        worker in a multi-host run is everyone else silently parked here."""
+        import time as _time
+
+        from ..base import getenv
+        from ..log import get_logger
+
+        warn_s = float(getenv("MXNET_BARRIER_WARN_S"))
+        t0 = _time.monotonic()
         coll.barrier(self.mesh)
+        elapsed = _time.monotonic() - t0
+        if elapsed > warn_s:
+            get_logger("mxnet_tpu.dist").warning(
+                "barrier on rank %d/%d took %.1fs (threshold %.0fs) — a "
+                "straggler or dead worker is holding the fleet",
+                self.rank, self.num_workers, elapsed, warn_s)
 
-    def save_optimizer_states(self, fname, dump_optimizer=False):
-        assert self._updater is not None
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
-
-    def load_optimizer_states(self, fname):
-        assert self._updater is not None
-        with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+    # save/load_optimizer_states inherit KVStoreBase's MXNetError-guarded
+    # implementations (every rank runs the same updater on the replicated
+    # aggregate, so local state IS the global state)
 
 
 def _fill_rows(target, val, ridx):

@@ -37,11 +37,13 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        from .resilience import open_checked
+
         if self.flag == "w":
-            self.record = open(self.uri, "wb")
+            self.record = open_checked(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.record = open(self.uri, "rb")
+            self.record = open_checked(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
@@ -93,9 +95,15 @@ class MXRecordIO:
         return self.record.tell()
 
     def read(self):
-        """Read a record as bytes, or None at EOF."""
+        """Read a record as bytes, or None at EOF. Carries the `read`
+        fault point; not auto-retried (a sequential read that partially
+        consumed the stream is not idempotent — `read_idx` is the retried
+        entry point)."""
+        from .resilience import inject
+
         assert not self.writable
         self._check_pid(allow_reset=True)
+        inject("read", self.uri)
         header = self.record.read(8)
         if len(header) < 8:
             return None
@@ -230,8 +238,15 @@ class MXIndexedRecordIO(MXRecordIO):
         self.record.seek(pos)
 
     def read_idx(self, idx):
-        self.seek(idx)
-        return self.read()
+        from .resilience import retry_call
+
+        def attempt():
+            self.seek(idx)
+            return self.read()
+
+        # seek+read restarts from the index offset, so a transient EIO
+        # mid-record is safely replayed (flaky network filesystems)
+        return retry_call(attempt, desc=f"read_idx({idx}) of {self.uri}")
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

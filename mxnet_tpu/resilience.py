@@ -1,0 +1,273 @@
+"""Resilience layer: transient-fault retry, checkpoint-integrity errors,
+and a deterministic fault-injection harness.
+
+The reference stack survives preemption through `save_checkpoint` /
+`load_checkpoint` and an engine that aborts loudly on op failure
+(`threaded_engine.cc` ExecuteOprBlock error path). This module is the
+TPU-era rendering of that contract for the host-side IO plane, where the
+real faults live (flaky NFS/GCS mounts, torn writes on preemption, wedged
+prefetch threads):
+
+* :func:`retry_call` / :func:`wrap_retry` — jittered exponential backoff
+  with a bounded retry budget for idempotent IO (checkpoint payload
+  writes, recordio/image opens, indexed reads, shm attach).
+* :class:`CorruptCheckpointError` — raised by `nd.load` when a CRC32/length
+  footer does not match; `model.load_checkpoint` catches it to fall back
+  to the last good epoch.
+* :func:`inject` — fault points compiled from ``MXNET_FAULT_SPEC`` so tests
+  can prove recovery deterministically: fail the nth open of `*.params`
+  with EIO, truncate a checkpoint write at K bytes, kill a prefetch
+  thread. Zero overhead when the spec is empty (one cached-string check).
+
+``MXNET_FAULT_SPEC`` grammar — rules separated by ``;``, ``key=value``
+fields separated by ``,``::
+
+    point=open,path=*.params,nth=2,error=EIO
+    point=write,path=*-0002.params,truncate=64
+    point=prefetch,error=KILL
+    point=write,path=*.params,times=3,error=EIO
+
+Fields: ``point`` (open|read|write|prefetch|shm — required), ``path``
+(fnmatch pattern, default ``*``), ``nth`` (first matching event to fault,
+1-based, default 1), ``times`` (how many consecutive events to fault,
+``inf`` allowed, default 1), ``error`` (errno name, default EIO; ``KILL``
+raises :class:`ThreadKilled`), ``truncate`` (byte count — the write lands
+but is cut at K bytes, a torn write).
+"""
+from __future__ import annotations
+
+import errno as _errno
+import fnmatch
+import os
+import random
+import threading
+import time
+
+from .base import MXNetError, getenv, register_env
+from .log import get_logger
+
+__all__ = ["CorruptCheckpointError", "ThreadKilled", "FaultRule",
+           "retry_call", "wrap_retry", "open_checked", "inject",
+           "fault_scope", "reset_fault_counters"]
+
+register_env("MXNET_IO_RETRY_BUDGET", 3, "retries after the first failed IO attempt")
+register_env("MXNET_IO_RETRY_BACKOFF", 0.05, "initial retry backoff seconds")
+register_env("MXNET_IO_RETRY_BACKOFF_MAX", 2.0, "retry backoff ceiling seconds")
+register_env("MXNET_CHECKPOINT_VERIFY", True, "verify per-array CRC32 footers on load")
+register_env("MXNET_CHECKPOINT_KEEP", 0, "retain only the newest K epoch .params files (0 = all)")
+register_env("MXNET_FAULT_SPEC", "", "deterministic IO fault-injection spec (tests)")
+register_env("MXNET_PREFETCH_JOIN_TIMEOUT", 5.0, "seconds to wait for a prefetch thread at reset")
+register_env("MXNET_BARRIER_WARN_S", 60.0, "dist barrier slower than this logs a straggler warning")
+register_env("MXNET_INIT_TIMEOUT_S", 0, "bound on jax.distributed rendezvous (0 = jax default)")
+
+
+class CorruptCheckpointError(MXNetError):
+    """A saved array file failed integrity verification (bad CRC, short
+    read, or torn payload)."""
+
+
+class ThreadKilled(Exception):
+    """Injected 'thread dies silently' fault (``error=KILL``)."""
+
+
+def _logger():
+    return get_logger("mxnet_tpu.resilience")
+
+
+# ---------------------------------------------------------------------------
+# Retry with jittered exponential backoff
+# ---------------------------------------------------------------------------
+
+# deterministic outcomes a retry can never change: replaying an open of a
+# missing path (or a permission wall) just burns the backoff budget and
+# floods the log with bogus "transient" warnings
+_NO_RETRY_ERRNOS = frozenset(
+    getattr(_errno, name) for name in
+    ("ENOENT", "EISDIR", "ENOTDIR", "EACCES", "EPERM", "EROFS", "ENAMETOOLONG")
+    if hasattr(_errno, name))
+
+
+def retry_call(fn, *args, desc=None, retries=None, backoff=None,
+               backoff_max=None, retry_on=(OSError,), **kwargs):
+    """Call ``fn(*args, **kwargs)``; on a ``retry_on`` exception retry up to
+    ``retries`` more times, sleeping ``backoff * 2**attempt`` (jittered to
+    50–100%, capped at ``backoff_max``) between attempts. Deterministic
+    OSErrors (missing file, permissions) raise immediately. Only use for
+    idempotent operations — a replayed write/open must be harmless."""
+    retries = getenv("MXNET_IO_RETRY_BUDGET") if retries is None else retries
+    backoff = getenv("MXNET_IO_RETRY_BACKOFF") if backoff is None else backoff
+    backoff_max = (getenv("MXNET_IO_RETRY_BACKOFF_MAX")
+                   if backoff_max is None else backoff_max)
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if isinstance(e, OSError) and e.errno in _NO_RETRY_ERRNOS:
+                raise
+            if attempt >= retries:
+                raise
+            delay = min(backoff * (2 ** attempt), backoff_max)
+            delay *= 0.5 + 0.5 * random.random()
+            attempt += 1
+            _logger().warning(
+                "transient IO failure on %s (attempt %d/%d, retrying in %.3fs): %s",
+                desc or getattr(fn, "__name__", "?"), attempt, retries, delay, e)
+            time.sleep(delay)
+
+
+def wrap_retry(fn, desc=None, retries=None):
+    """``fn`` wrapped in :func:`retry_call` (for handing to `engine.push`)."""
+    def run(*args, **kwargs):
+        return retry_call(fn, *args, desc=desc, retries=retries, **kwargs)
+    run.__name__ = getattr(fn, "__name__", "wrapped")
+    return run
+
+
+def open_checked(path, mode="rb"):
+    """`open` with the ``open`` fault point and transient-fault retry —
+    the entry point for recordio/image file opens."""
+    def attempt():
+        inject("open", path)
+        return open(path, mode)
+    return retry_call(attempt, desc=f"open {path}")
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+class FaultRule:
+    """One compiled ``MXNET_FAULT_SPEC`` rule + its event counter."""
+
+    __slots__ = ("point", "path", "nth", "times", "error", "truncate", "count")
+
+    def __init__(self, point, path="*", nth=1, times=1, error="EIO",
+                 truncate=None):
+        if point not in ("open", "read", "write", "prefetch", "shm"):
+            raise MXNetError(f"MXNET_FAULT_SPEC: unknown fault point {point!r}")
+        if error != "KILL" and not hasattr(_errno, error):
+            raise MXNetError(f"MXNET_FAULT_SPEC: unknown errno name {error!r}")
+        self.point = point
+        self.path = path
+        self.nth = int(nth)
+        self.times = float("inf") if times in ("inf", float("inf")) else int(times)
+        self.error = error
+        self.truncate = None if truncate is None else int(truncate)
+        self.count = 0
+
+    def matches(self, path):
+        return (fnmatch.fnmatch(path, self.path) or
+                fnmatch.fnmatch(os.path.basename(path), self.path))
+
+    def fire(self, path):
+        """Raise (or return self for truncate rules) when this event falls
+        in the [nth, nth+times) window of matching events."""
+        self.count += 1
+        if not (self.nth <= self.count < self.nth + self.times):
+            return None
+        if self.truncate is not None:
+            _logger().warning("fault injection: truncating write of %s at %d bytes",
+                              path, self.truncate)
+            return self
+        if self.error == "KILL":
+            raise ThreadKilled(f"fault injection: killed at {self.point} of {path}")
+        code = getattr(_errno, self.error)
+        raise OSError(code, f"fault injection: {self.error} at {self.point} of {path}")
+
+    def __repr__(self):
+        return (f"FaultRule(point={self.point}, path={self.path!r}, "
+                f"nth={self.nth}, times={self.times}, error={self.error}, "
+                f"truncate={self.truncate})")
+
+
+def _parse_spec(spec):
+    rules = []
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = {}
+        for kv in chunk.split(","):
+            key, eq, val = kv.strip().partition("=")
+            if not eq:
+                raise MXNetError(f"MXNET_FAULT_SPEC: expected key=value, got {kv!r}")
+            if key not in ("point", "path", "nth", "times", "error", "truncate"):
+                raise MXNetError(f"MXNET_FAULT_SPEC: unknown field {key!r}")
+            fields[key] = val
+        if "point" not in fields:
+            raise MXNetError(f"MXNET_FAULT_SPEC: rule missing point=: {chunk!r}")
+        try:
+            rules.append(FaultRule(**fields))
+        except ValueError as e:  # non-integer nth/times/truncate
+            raise MXNetError(f"MXNET_FAULT_SPEC: bad rule {chunk!r}: {e}") from e
+    return rules
+
+
+_fault_lock = threading.Lock()
+_fault_spec = None   # env string the compiled rules came from
+_fault_rules = []
+
+
+def _rules():
+    """Compiled rules for the CURRENT env value; counters survive as long
+    as the spec string is unchanged (re-compiled — and reset — on change)."""
+    global _fault_spec, _fault_rules
+    spec = os.environ.get("MXNET_FAULT_SPEC", "")
+    if spec == _fault_spec:
+        return _fault_rules
+    with _fault_lock:
+        if spec != _fault_spec:
+            _fault_rules = _parse_spec(spec)
+            _fault_spec = spec
+    return _fault_rules
+
+
+def reset_fault_counters():
+    """Restart every rule's event counter (tests reuse one spec)."""
+    with _fault_lock:
+        for r in _fault_rules:
+            r.count = 0
+
+
+def inject(point, path=""):
+    """Fault point hook: no-op unless an active rule matches. Raises the
+    rule's OSError / :class:`ThreadKilled`, or returns the rule for
+    ``truncate`` rules so the writer can tear its own payload."""
+    rules = _rules()
+    if not rules:
+        return None
+    with _fault_lock:
+        for rule in rules:
+            if rule.point == point and rule.matches(path):
+                fired = rule.fire(path)
+                if fired is not None:
+                    return fired
+    return None
+
+
+class fault_scope:
+    """Context manager installing a fault spec (and fresh counters) for a
+    test body, restoring the previous spec on exit."""
+
+    def __init__(self, spec):
+        self._spec = spec
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = os.environ.get("MXNET_FAULT_SPEC")
+        os.environ["MXNET_FAULT_SPEC"] = self._spec
+        try:
+            _rules()  # compile now so a bad spec fails at scope entry
+        except Exception:
+            self.__exit__()  # a rejected spec must not stay in the env
+            raise
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            os.environ.pop("MXNET_FAULT_SPEC", None)
+        else:
+            os.environ["MXNET_FAULT_SPEC"] = self._prev
+        _rules()
+        return False

@@ -75,6 +75,19 @@ def test_prefetching_iter():
     assert it.next().data[0].shape == (5, 2)
 
 
+def test_prefetching_iter_thread_fallback():
+    # the python-thread path must behave identically to the engine path
+    # (use_engine=False forces it even when librt_tpu.so is built)
+    data = np.arange(20).reshape(10, 2).astype("float32")
+    base = NDArrayIter(data, np.zeros(10), batch_size=5)
+    it = PrefetchingIter(base, use_engine=False)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    it.reset()
+    assert len(list(it)) == 2
+
+
 def test_csv_iter():
     with tempfile.TemporaryDirectory() as d:
         data_path = os.path.join(d, "data.csv")

@@ -1,0 +1,525 @@
+"""Fault-tolerance suite: retry/backoff, checkpoint CRC integrity,
+fallback-to-last-good-epoch, retention, and the MXNET_FAULT_SPEC
+deterministic fault-injection harness (torn writes, transient EIO,
+killed prefetch threads, kill-and-resume training)."""
+import logging
+import os
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io.io import DataIter, NDArrayIter, PrefetchingIter
+from mxnet_tpu.resilience import (CorruptCheckpointError, ThreadKilled,
+                                  fault_scope, inject, retry_call)
+
+
+@pytest.fixture(autouse=True)
+def _fast_retry(monkeypatch):
+    # keep backoff sleeps out of the test wall-clock
+    monkeypatch.setenv("MXNET_IO_RETRY_BACKOFF", "0.001")
+    monkeypatch.setenv("MXNET_IO_RETRY_BACKOFF_MAX", "0.002")
+
+
+@pytest.fixture
+def sync_io(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_ASYNC_IO", "0")
+
+
+# -- retry primitive ---------------------------------------------------------
+
+def test_retry_absorbs_transient():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(5, "transient")
+        return 42
+
+    assert retry_call(flaky, desc="flaky") == 42
+    assert len(calls) == 3
+
+
+def test_retry_budget_exhausted():
+    calls = []
+
+    def always_fail():
+        calls.append(1)
+        raise OSError(5, "permanent")
+
+    with pytest.raises(OSError):
+        retry_call(always_fail, retries=2)
+    assert len(calls) == 3  # first attempt + 2 retries
+
+
+def test_retry_only_catches_retry_on():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("not an IO error")
+
+    with pytest.raises(ValueError):
+        retry_call(boom)
+    assert len(calls) == 1
+
+
+def test_retry_skips_deterministic_oserrors():
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError(2, "no such file")
+
+    with pytest.raises(FileNotFoundError):
+        retry_call(missing)
+    assert len(calls) == 1  # ENOENT cannot become true by waiting
+
+
+# -- fault spec parsing + injection ------------------------------------------
+
+def test_fault_spec_parsing():
+    with fault_scope("point=open,path=*.params,nth=2,times=inf,error=ENOSPC"):
+        rules = resilience._rules()
+        assert len(rules) == 1
+        r = rules[0]
+        assert (r.point, r.path, r.nth, r.error) == ("open", "*.params", 2, "ENOSPC")
+        assert r.times == float("inf")
+
+
+@pytest.mark.parametrize("bad", ["path=*.params",            # missing point
+                                 "point=nowhere",            # unknown point
+                                 "point=open,error=EBOGUS",  # unknown errno
+                                 "point=open,oops",          # not key=value
+                                 "point=open,nht=2",         # typo'd field
+                                 "point=open,nth=abc"])      # non-integer
+def test_fault_spec_rejects_garbage(bad):
+    with pytest.raises(MXNetError):
+        with fault_scope(bad):
+            pass
+
+
+def test_inject_nth_window():
+    with fault_scope("point=open,path=*.rec,nth=2,error=EIO"):
+        assert inject("open", "a.rec") is None        # event 1: clean
+        with pytest.raises(OSError) as ei:
+            inject("open", "b.rec")                   # event 2: fires
+        assert ei.value.errno == 5
+        assert inject("open", "c.rec") is None        # event 3: window over
+        assert inject("open", "d.params") is None     # never matched
+
+
+def test_inject_kill_and_truncate_rules():
+    with fault_scope("point=prefetch,error=KILL;point=write,truncate=64"):
+        with pytest.raises(ThreadKilled):
+            inject("prefetch", "iter")
+        rule = inject("write", "x.params")
+        assert rule is not None and rule.truncate == 64
+
+
+# -- checkpoint CRC integrity ------------------------------------------------
+
+def _save_dict(path, scale=1.0):
+    data = {"w": mx.nd.array(np.arange(16, dtype="float32") * scale),
+            "b": mx.nd.array(np.ones((4, 3), dtype="float32") * scale)}
+    mx.nd.save(path, data)
+    return data
+
+
+def test_save_load_roundtrip_with_crc(tmp_path, sync_io):
+    path = str(tmp_path / "model.params")
+    data = _save_dict(path)
+    out = mx.nd.load(path)
+    for k in data:
+        np.testing.assert_array_equal(out[k].asnumpy(), data[k].asnumpy())
+    with open(path, "rb") as f:
+        magic, version = struct.unpack("<QQ", f.read(16))
+    assert magic == 0x112 and version == 1
+
+
+def test_bitflip_detected_by_crc(tmp_path, sync_io):
+    path = str(tmp_path / "model.params")
+    _save_dict(path)
+    with open(path, "rb+") as f:
+        f.seek(50)  # inside the first array's raw payload
+        byte = f.read(1)
+        f.seek(50)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(CorruptCheckpointError):
+        mx.nd.load(path)
+
+
+def test_truncation_detected(tmp_path, sync_io):
+    path = str(tmp_path / "model.params")
+    _save_dict(path)
+    size = os.path.getsize(path)
+    with open(path, "rb+") as f:
+        f.truncate(size // 2)
+    with pytest.raises(CorruptCheckpointError):
+        mx.nd.load(path)
+
+
+def test_corrupt_shape_header_detected(tmp_path, sync_io):
+    # a negative dim must surface as CorruptCheckpointError (catchable by
+    # the fallback loop), never a bare ValueError from numpy.reshape
+    path = str(tmp_path / "model.params")
+    _save_dict(path)
+    with open(path, "rb+") as f:
+        f.seek(32)  # first array's shape[0] (header 24B + flag 4B + ndim 4B)
+        f.write(struct.pack("<q", -1))
+    with pytest.raises(CorruptCheckpointError):
+        mx.nd.load(path)
+
+
+def test_legacy_v0_file_still_loads(tmp_path, sync_io):
+    # reference layout: version word 0, no per-array footers
+    path = str(tmp_path / "legacy.params")
+    arr = np.arange(6, dtype="float32").reshape(2, 3)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQQ", 0x112, 0, 1))
+        f.write(struct.pack("<iI", 0, arr.ndim))
+        for s in arr.shape:
+            f.write(struct.pack("<q", s))
+        f.write(arr.tobytes())
+        f.write(struct.pack("<Q", 1))
+        f.write(struct.pack("<Q", 1) + b"w")
+    out = mx.nd.load(path)
+    np.testing.assert_array_equal(out["w"].asnumpy(), arr)
+
+
+# -- checkpoint write faults: torn writes, transient EIO ---------------------
+
+def test_torn_write_falls_back_to_last_good_epoch(tmp_path, sync_io):
+    prefix = str(tmp_path / "model")
+    good = {"w": mx.nd.array(np.full(8, 7.0, dtype="float32"))}
+    mx.model.save_checkpoint(prefix, 1, None, good, {})
+    with fault_scope("point=write,path=*-0002.params,truncate=48"):
+        bad = {"w": mx.nd.array(np.zeros(8, dtype="float32"))}
+        mx.model.save_checkpoint(prefix, 2, None, bad, {})
+    # epoch 2 landed torn; CRC verification rejects it and the latest-good
+    # path answers with epoch 1
+    with pytest.raises(CorruptCheckpointError):
+        mx.nd.load(f"{prefix}-0002.params")
+    _, args, _, loaded = mx.model.load_checkpoint(prefix, return_epoch=True)
+    np.testing.assert_array_equal(args["w"].asnumpy(), np.full(8, 7.0, "float32"))
+    assert loaded == 1  # resume logic must see the REAL epoch, not the torn one
+
+
+def test_explicit_epoch_does_not_fall_back(tmp_path, sync_io):
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 1, None,
+                             {"w": mx.nd.array(np.ones(4))}, {})
+    with fault_scope("point=write,path=*-0002.params,truncate=48"):
+        mx.model.save_checkpoint(prefix, 2, None,
+                                 {"w": mx.nd.array(np.ones(4))}, {})
+    with pytest.raises(MXNetError):
+        mx.model.load_checkpoint(prefix, 2)
+
+
+def test_transient_eio_on_write_absorbed_by_retry(tmp_path, sync_io):
+    prefix = str(tmp_path / "model")
+    want = np.arange(8, dtype="float32")
+    # two injected EIOs, budget of three retries: the save must succeed
+    with fault_scope("point=write,path=*.params,times=2,error=EIO"):
+        mx.model.save_checkpoint(prefix, 1, None,
+                                 {"w": mx.nd.array(want)}, {})
+    _, args, _ = mx.model.load_checkpoint(prefix, 1)
+    np.testing.assert_array_equal(args["w"].asnumpy(), want)
+
+
+def test_async_write_failure_surfaces_at_wait_all(tmp_path, monkeypatch):
+    from mxnet_tpu import engine, lib
+
+    if lib.native_engine() is None:
+        pytest.skip("native engine not built")
+    monkeypatch.setenv("MXNET_ENGINE_ASYNC_IO", "1")
+    path = str(tmp_path / "doomed_async.params")
+    with fault_scope("point=write,path=*doomed_async.params,times=inf,error=EIO"):
+        mx.nd.save(path, {"w": mx.nd.array(np.ones(4))})
+        with pytest.raises(OSError):
+            engine.wait_all()
+    assert not engine._async_error  # consumed, not re-raised forever
+
+
+# -- retention + latest ------------------------------------------------------
+
+def test_checkpoint_retention_keeps_newest(tmp_path, sync_io):
+    prefix = str(tmp_path / "model")
+    for epoch in range(1, 5):
+        mx.model.save_checkpoint(prefix, epoch, None,
+                                 {"w": mx.nd.array(np.ones(2))}, {}, keep=2)
+    assert mx.model.list_checkpoint_epochs(prefix) == [3, 4]
+    assert mx.model.find_latest_checkpoint(prefix) == 4
+
+
+def test_retention_env_knob(tmp_path, sync_io, monkeypatch):
+    monkeypatch.setenv("MXNET_CHECKPOINT_KEEP", "1")
+    prefix = str(tmp_path / "model")
+    for epoch in (1, 2):
+        mx.model.save_checkpoint(prefix, epoch, None,
+                                 {"w": mx.nd.array(np.ones(2))}, {})
+    assert mx.model.list_checkpoint_epochs(prefix) == [2]
+
+
+def test_load_checkpoint_without_any_file(tmp_path):
+    with pytest.raises(MXNetError):
+        mx.model.load_checkpoint(str(tmp_path / "nothing"))
+
+
+def test_epochs_past_9999_are_listed(tmp_path, sync_io):
+    prefix = str(tmp_path / "model")
+    for epoch in (9999, 10000):  # %04d grows to 5 digits here
+        mx.model.save_checkpoint(prefix, epoch, None,
+                                 {"w": mx.nd.array(np.ones(2))}, {})
+    assert mx.model.list_checkpoint_epochs(prefix) == [9999, 10000]
+    assert mx.model.find_latest_checkpoint(prefix) == 10000
+
+
+def test_eviction_spares_fallback_unless_new_save_verifies(tmp_path, sync_io):
+    from mxnet_tpu.model import _evict_old_epochs
+
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 1, None,
+                             {"w": mx.nd.array(np.ones(4))}, {})
+    old = tmp_path / "m-0001.params"
+    new = tmp_path / "m-0002.params"
+    new.write_bytes(b"")  # async placeholder whose write failed for good
+    _evict_old_epochs([str(old)], str(new))
+    assert old.exists()  # the only loadable checkpoint survived
+    new.write_bytes(old.read_bytes()[:48])  # torn-but-renamed newest
+    _evict_old_epochs([str(old)], str(new))
+    assert old.exists()  # a torn replacement must not evict the fallback
+    new.write_bytes(old.read_bytes())  # finally a verifiable newest
+    _evict_old_epochs([str(old)], str(new))
+    assert not old.exists()
+
+
+def test_retention_with_torn_newest_keeps_fallback(tmp_path, sync_io):
+    # keep=1 + a torn newest save: the stranded-resume scenario — epoch 1
+    # must survive and load_checkpoint must fall back to it
+    prefix = str(tmp_path / "m")
+    want = np.full(4, 3.0, "float32")
+    mx.model.save_checkpoint(prefix, 1, None, {"w": mx.nd.array(want)}, {},
+                             keep=1)
+    with fault_scope("point=write,path=*-0002.params,truncate=48"):
+        mx.model.save_checkpoint(prefix, 2, None,
+                                 {"w": mx.nd.array(np.zeros(4))}, {}, keep=1)
+    _, args, _ = mx.model.load_checkpoint(prefix)
+    np.testing.assert_array_equal(args["w"].asnumpy(), want)
+
+
+# -- recordio retry ----------------------------------------------------------
+
+def _write_rec(tmp_path, n=4):
+    uri = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = mx.recordio.MXIndexedRecordIO(idx, uri, "w")
+    for i in range(n):
+        w.write_idx(i, bytes([i]) * 8)
+    w.close()
+    return idx, uri
+
+
+def test_recordio_open_retries_transient_eio(tmp_path):
+    idx, uri = _write_rec(tmp_path)
+    with fault_scope("point=open,path=*.rec,times=2,error=EIO"):
+        r = mx.recordio.MXRecordIO(uri, "r")  # two EIOs absorbed
+        assert r.read() == b"\x00" * 8
+        r.close()
+
+
+def test_recordio_read_idx_retries(tmp_path):
+    idx, uri = _write_rec(tmp_path)
+    r = mx.recordio.MXIndexedRecordIO(idx, uri, "r")
+    with fault_scope("point=read,path=*.rec,nth=1,error=EIO"):
+        assert r.read_idx(2) == b"\x02" * 8  # seek+read replayed after EIO
+    r.close()
+
+
+# -- prefetch thread fault paths ---------------------------------------------
+
+class _RaisingIter(DataIter):
+    """Yields one good batch, then raises mid-epoch."""
+
+    def __init__(self, inner):
+        super().__init__(inner.batch_size)
+        self._inner = inner
+        self._n = 0
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._n = 0
+        self._inner.reset()
+
+    def next(self):
+        self._n += 1
+        if self._n > 1:
+            raise RuntimeError("source iterator exploded mid-epoch")
+        return self._inner.next()
+
+
+def _base_iter():
+    data = np.arange(20).reshape(10, 2).astype("float32")
+    return NDArrayIter(data, np.zeros(10), batch_size=5)
+
+
+@pytest.mark.parametrize("use_engine", [False, True])
+def test_prefetch_exception_propagates_to_consumer(use_engine):
+    from mxnet_tpu import lib
+
+    if use_engine and lib.native_engine() is None:
+        pytest.skip("native engine not built")
+    it = PrefetchingIter(_RaisingIter(_base_iter()), use_engine=use_engine)
+    assert it.next().data[0].shape == (5, 2)
+    with pytest.raises(RuntimeError, match="exploded mid-epoch"):
+        it.next()  # surfaced on next(), not hung, not dropped
+
+
+def test_prefetch_killed_thread_detected():
+    with fault_scope("point=prefetch,error=KILL"):
+        it = PrefetchingIter(_base_iter(), use_engine=False)
+        with pytest.raises(MXNetError, match="died"):
+            it.next()
+
+
+class _WedgedIter(DataIter):
+    """next() blocks until the release event fires — a hung filesystem."""
+
+    def __init__(self, release):
+        super().__init__(2)
+        self._release = release
+
+    def reset(self):
+        pass
+
+    def next(self):
+        self._release.wait()
+        raise StopIteration
+
+
+def test_prefetch_wedged_thread_warns_on_reset(monkeypatch, caplog):
+    monkeypatch.setenv("MXNET_PREFETCH_JOIN_TIMEOUT", "0.2")
+    release = threading.Event()
+    it = PrefetchingIter(_WedgedIter(release), use_engine=False)
+    try:
+        with caplog.at_level(logging.WARNING, logger="mxnet_tpu.io"):
+            it.reset()
+        assert any("prefetch thread still alive" in r.getMessage()
+                   for r in caplog.records)
+    finally:
+        release.set()  # unwedge abandoned daemon threads
+
+
+# -- engine exit flush is never silent ---------------------------------------
+
+def test_flush_at_exit_logs_failures(monkeypatch, caplog):
+    from mxnet_tpu import engine, lib
+
+    class _Boom:
+        def wait_all(self):
+            raise OSError(5, "disk on fire")
+
+    monkeypatch.setattr(lib, "_engine", _Boom())
+    engine._async_error.append(RuntimeError("late checkpoint failure"))
+    with caplog.at_level(logging.ERROR, logger="mxnet_tpu.engine"):
+        engine._flush_at_exit()
+    text = " ".join(r.getMessage() for r in caplog.records)
+    assert "disk on fire" in text
+    assert "late checkpoint failure" in text
+    assert not engine._async_error
+
+
+# -- kvstore optimizer-state guards survive python -O ------------------------
+
+def test_kvstore_state_io_raises_mxnet_error():
+    kv = mx.kv.create("local")
+    with pytest.raises(MXNetError):
+        kv.save_optimizer_states("/tmp/never-written.states")
+    with pytest.raises(MXNetError):
+        kv.load_optimizer_states("/tmp/never-written.states")
+
+
+# -- kill-and-resume training ------------------------------------------------
+
+def _mlp_sym(nh=8, classes=2):
+    from mxnet_tpu import symbol as sym
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=120, dim=8, classes=2, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype("float32")
+    y = (X @ rng.randn(dim, classes)).argmax(1).astype("float32")
+    return X, y
+
+
+def _fit(X, y, begin_epoch=0, num_epoch=4, mod=None, nh=8, classes=2, lr=0.1):
+    np.random.seed(11)
+    mx.random.seed(11)
+    it = mx.io.NDArrayIter(X, y, batch_size=30)  # no shuffle: deterministic
+    if mod is None:
+        mod = mx.mod.Module(_mlp_sym(nh=nh, classes=classes), context=mx.cpu())
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": lr},
+            begin_epoch=begin_epoch, num_epoch=num_epoch,
+            initializer=mx.init.Xavier())
+    return mod
+
+
+def test_kill_and_resume_matches_uninterrupted(tmp_path, sync_io):
+    X, y = _toy_data()
+    straight = _fit(X, y, num_epoch=4)
+
+    prefix = str(tmp_path / "resume")
+    first = _fit(X, y, num_epoch=2)
+    first.save_checkpoint(prefix, 2)
+    del first  # the "kill": nothing survives but the checkpoint
+
+    resumed = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    resumed = _fit(X, y, begin_epoch=2, num_epoch=4, mod=resumed)
+
+    args_a, _ = straight.get_params()
+    args_b, _ = resumed.get_params()
+    assert set(args_a) == set(args_b)
+    for k in args_a:
+        np.testing.assert_allclose(args_a[k].asnumpy(), args_b[k].asnumpy(),
+                                   rtol=0, atol=0, err_msg=k)
+
+
+@pytest.mark.slow
+def test_kill_and_resume_convergence(tmp_path, sync_io):
+    """Resume mid-run and still converge to the uninterrupted accuracy."""
+    X, y = _toy_data(n=600, dim=20, classes=4)
+    kw = dict(nh=64, classes=4, lr=0.5)
+    straight = _fit(X, y, num_epoch=10, **kw)
+
+    prefix = str(tmp_path / "conv")
+    first = _fit(X, y, num_epoch=5, **kw)
+    first.save_checkpoint(prefix, 5)
+    del first
+
+    resumed = mx.mod.Module.load(prefix, 5, context=mx.cpu())
+    resumed = _fit(X, y, begin_epoch=5, num_epoch=10, mod=resumed, **kw)
+
+    val = mx.io.NDArrayIter(X, y, batch_size=30)
+    acc_straight = straight.score(val, "acc")[0][1]
+    val.reset()
+    acc_resumed = resumed.score(val, "acc")[0][1]
+    assert acc_resumed > 0.9
+    np.testing.assert_allclose(acc_resumed, acc_straight, atol=1e-6)
