@@ -77,6 +77,27 @@ except Exception:  # noqa: BLE001 — cache is an optimisation, never fatal
 BASELINE_IMG_S = 298.51  # V100 fp32 b=32 training (BASELINE.md)
 
 
+def _write_telemetry_snapshot():
+    """Sidecar for the BENCH json: a telemetry snapshot of the measured
+    run (engine pushes, kvstore bytes/latency, prefetch starvation), so a
+    perf round gets the breakdown for free. `BENCH_TELEMETRY_OUT` sets the
+    path ('0' disables); default lands next to this script. Render it with
+    `tools/telemetry_report.py`."""
+    out = os.environ.get("BENCH_TELEMETRY_OUT")
+    if out == "0":
+        return None
+    out = out or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_TELEMETRY.json")
+    try:
+        from mxnet_tpu import telemetry
+
+        if telemetry._registry:
+            return telemetry.dump(out)
+    except Exception:  # noqa: BLE001 — telemetry must never sink the bench
+        pass
+    return None
+
+
 def _emit(payload):
     # A CPU fallback/error line still carries the most recent REAL on-chip
     # capture (tools/tpu_watcher.sh saves one whenever the flaky relay
@@ -435,6 +456,19 @@ def main():
             _emit(result)
             return 0
         on_tpu = backend not in ("cpu",)
+        # metrics breakdown of the measured run (sidecar json). The run is
+        # measured WITH telemetry on (a handful of flag checks + clock
+        # reads per step — noise against a training step), and the result
+        # says so: BENCH_TELEMETRY_OUT=0 restores the uninstrumented
+        # configuration for a strict baseline comparison.
+        if os.environ.get("BENCH_TELEMETRY_OUT") != "0":
+            try:
+                from mxnet_tpu import telemetry
+
+                telemetry.enable()
+                result["telemetry_enabled"] = True
+            except Exception:  # noqa: BLE001
+                pass
         fetch_cost = _fetch_cost()
         result["fetch_cost_ms"] = round(fetch_cost * 1e3, 3)
         raw_fetch, raw_disp, batch, size, iters, flops = _measure_raw(
@@ -487,6 +521,9 @@ def main():
             result["mfu_error"] = traceback.format_exc(limit=3).strip().splitlines()[-1]
     except Exception:  # noqa: BLE001 — a bench crash must still emit JSON
         result["error"] = traceback.format_exc(limit=5).strip().splitlines()[-1]
+    snap_path = _write_telemetry_snapshot()
+    if snap_path:
+        result["telemetry_snapshot"] = snap_path
     _emit(result)
     return 0
 

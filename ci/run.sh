@@ -40,13 +40,20 @@ build_native() {
 unit() {
   log "unit suite (includes the 4-process dist kvstore run and CI-guarded examples)"
   python -m pytest tests/python/unittest -q -x \
-      --ignore=tests/python/unittest/test_resilience.py
+      --ignore=tests/python/unittest/test_resilience.py \
+      --ignore=tests/python/unittest/test_telemetry.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
   # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
   # command (-m 'not slow') keeps only the fast fault-injection cases
   log "fault-injection resilience suite (kill-and-resume, torn writes, EIO)"
   python -m pytest tests/python/unittest/test_resilience.py -q
+  # telemetry gate, standalone for the same loud-attribution reason: these
+  # tests flip the process-global registry on/off and assert on metric
+  # values, so an instrumentation regression fails HERE, not as a
+  # mysterious count mismatch inside an unrelated suite
+  log "telemetry suite (registry, instrumentation under fault injection, trace merge)"
+  python -m pytest tests/python/unittest/test_telemetry.py -q
 }
 
 train() {

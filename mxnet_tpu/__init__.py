@@ -45,6 +45,7 @@ from .random import seed
 
 from . import engine
 from . import resilience
+from . import telemetry
 from . import runtime
 
 from . import initializer

@@ -5,13 +5,24 @@ Parity: `python/mxnet/callback.py` — module_checkpoint (:27), do_checkpoint
 """
 from __future__ import annotations
 
-import logging
 import math
 import sys
 import time
 
+from . import log as _log
+
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric", "Speedometer",
            "ProgressBar", "LogValidationMetricsCallback"]
+
+
+def _logger():
+    """Training-progress logger: the same `log.get_logger` stream the
+    telemetry summaries use, so one logging config governs both. Level
+    NOTSET = inherit the root's effective level — exactly the visibility
+    the old root-logger `logging.info` calls had (silent until the user
+    raises the level with `logging.basicConfig(level=INFO)`, silenced
+    again by `level=ERROR`)."""
+    return _log.get_logger("mxnet_tpu.callback", level=_log.NOTSET)
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
@@ -41,7 +52,7 @@ def log_train_metric(period, auto_reset=False):
         if param.nbatch % period == 0 and param.eval_metric is not None:
             name_value = param.eval_metric.get_name_value()
             for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f", param.epoch, param.nbatch, name, value)
+                _logger().info("Iter[%d] Batch[%d] Train-%s=%f", param.epoch, param.nbatch, name, value)
             if auto_reset:
                 param.eval_metric.reset()
 
@@ -71,16 +82,29 @@ class Speedometer:
                     speed = self.frequent * self.batch_size / (time.time() - self.tic)
                 except ZeroDivisionError:
                     speed = float("inf")
+                # per-step latency quantiles from the telemetry breakdown
+                # (BatchEndParam.step_stats, set by fit when MXNET_TELEMETRY=1);
+                # the quantile sort runs HERE, once per log tick, not per batch
+                stats = getattr(param, "step_stats", None)
+                lat = ""
+                lat_args = ()
+                if stats and stats.get("hist") is not None:
+                    p50_us, p99_us = stats["hist"].quantiles(50, 99)
+                    if p50_us is not None:
+                        lat = "\tstep-p50: %.1f ms\tstep-p99: %.1f ms"
+                        lat_args = (p50_us / 1e3, p99_us / 1e3)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
                         param.eval_metric.reset()
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
                     msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed, *sum(name_value, ()))
+                    _logger().info(msg + lat, param.epoch, count, speed,
+                                   *(sum(name_value, ()) + lat_args))
                 else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
+                    _logger().info(
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec" + lat,
+                        param.epoch, count, speed, *lat_args)
                 self.tic = time.time()
         else:
             self.init = True
@@ -108,5 +132,5 @@ class LogValidationMetricsCallback:
         if not param.eval_metric:
             return
         for name, value in param.eval_metric.get_name_value():
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
-                         value)
+            _logger().info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                           value)

@@ -19,7 +19,9 @@ from __future__ import annotations
 import atexit
 import contextlib
 import threading
+import time
 
+from . import telemetry
 from .base import getenv
 
 __all__ = ["bulk", "engine_type", "push", "push_io", "wait_all", "path_var"]
@@ -85,7 +87,27 @@ def _guarded(fn):
         try:
             fn(*a, **kw)
         except Exception as e:  # KeyboardInterrupt/SystemExit propagate
+            if telemetry._enabled:
+                telemetry.counter("engine.async_errors").inc()
             _async_error.append(e)
+
+    return run
+
+
+def _instrumented(fn):
+    """Telemetry wrap for one pushed task: queue-depth gauge up at push /
+    down at run, push→run latency histogram. The latency is how long work
+    sat behind other engine tasks — the first number to look at when
+    checkpoint writes stall an epoch."""
+    t_push = time.perf_counter()
+    g = telemetry.gauge("engine.queue_depth")
+    h = telemetry.histogram("engine.push_run_latency_us")
+    g.inc()
+
+    def run(*a, **kw):
+        h.record((time.perf_counter() - t_push) * 1e6)
+        g.dec()
+        return fn(*a, **kw)
 
     return run
 
@@ -95,6 +117,9 @@ def push(fn, *args, const_vars=(), mutable_vars=(), **kwargs):
     inline execution when the native library is unavailable)."""
     from . import lib
 
+    if telemetry._enabled:
+        telemetry.counter("engine.pushes").inc()
+        fn = _instrumented(fn)
     eng = lib.native_engine()
     if eng is not None:
         return eng.push(_guarded(fn), args, kwargs,
@@ -111,6 +136,8 @@ def push_io(path, fn, *args, retries=None, **kwargs):
     rename). `retries=0` opts out."""
     from . import resilience
 
+    if telemetry._enabled:
+        telemetry.counter("engine.io_pushes").inc()
     wrapped = resilience.wrap_retry(fn, desc=path, retries=retries)
     return push(wrapped, *args, mutable_vars=(path_var(path),), **kwargs)
 

@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import collections
 import threading
+import time as _time
 import queue as _queue
 
 import numpy as _np
 
+from .. import telemetry
 from ..base import MXNetError
 
 
@@ -297,6 +299,7 @@ class PrefetchingIter(DataIter):
         self._var = self._engine.new_var() if self._engine is not None else None
         self._epoch = 0
         self._handoff = None
+        self._t_consumed = None  # end of the previous next() (telemetry)
         self._start()
 
     @property
@@ -390,6 +393,9 @@ class PrefetchingIter(DataIter):
 
         self._stop.set()
         self._epoch += 1  # stale engine pushes become no-ops
+        # the inter-epoch gap (validation, checkpointing, user code) is not
+        # step compute — counting it would understate the starvation ratio
+        self._t_consumed = None
         if self._engine is not None:
             from .. import engine
 
@@ -423,7 +429,23 @@ class PrefetchingIter(DataIter):
     def next(self):
         if self._engine is not None and self._done:
             raise StopIteration
-        item = self._get_item()
+        if telemetry._enabled:
+            # data-wait vs. compute split: wait is the time blocked on the
+            # queue here; compute is the gap since the previous batch was
+            # handed out (the consumer's fwd/bwd/update work). Their ratio
+            # wait/(wait+compute) is the starvation ratio — the pipeline is
+            # data-bound when it climbs toward 1 (docs/faq/perf.md).
+            t0 = _time.perf_counter()
+            if self._t_consumed is not None:
+                telemetry.counter("io.prefetch_compute_us_total").inc(
+                    (t0 - self._t_consumed) * 1e6)
+            item = self._get_item()
+            wait_us = (_time.perf_counter() - t0) * 1e6
+            telemetry.histogram("io.prefetch_wait_us").record(wait_us)
+            telemetry.counter("io.prefetch_wait_us_total").inc(wait_us)
+            self._t_consumed = _time.perf_counter()
+        else:
+            item = self._get_item()
         if item is None:
             if self._engine is not None:
                 self._done = True

@@ -15,13 +15,25 @@ step, pull an all-gather; there are no server processes (SURVEY.md §5).
 from __future__ import annotations
 
 import pickle
+import time as _time
 
+from . import telemetry
 from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import optimizer as opt
 
 __all__ = ["KVStore", "KVStoreLocal", "create"]
+
+
+def _nd_nbytes(v):
+    """Logical byte size of one pushed/pulled value (telemetry)."""
+    import numpy as _np
+
+    try:
+        return int(v.size) * _np.dtype(v.dtype).itemsize
+    except Exception:  # noqa: BLE001 — telemetry must never break the push
+        return 0
 
 
 def _ctx_group_sum(arrays):
@@ -137,7 +149,12 @@ class KVStoreLocal(KVStoreBase):
         """Reduce values across devices into the store; if an optimizer is
         registered (update_on_kvstore), apply the update immediately
         (parity kvstore.py:160; reference PushImpl `kvstore_local.h:121`)."""
+        tele = telemetry._enabled
+        t0 = _time.perf_counter() if tele else 0.0
         for k, vals in self._normalize(key, value):
+            if tele:
+                telemetry.counter("kvstore.push_bytes").inc(
+                    sum(_nd_nbytes(v) for v in vals))
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized (call init first)")
             merged = _ctx_group_sum(vals)
@@ -152,16 +169,27 @@ class KVStoreLocal(KVStoreBase):
                 self._updater(idx, merged, weight)
             else:
                 self._store[k] = merged.as_in_context(self._store[k].context)
+        if tele:
+            telemetry.histogram("kvstore.push_us").record(
+                (_time.perf_counter() - t0) * 1e6)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast store values into out arrays (parity kvstore.py:240)."""
         assert out is not None
+        tele = telemetry._enabled
+        t0 = _time.perf_counter() if tele else 0.0
         for k, outs in self._normalize(key, out):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized (call init first)")
             src = self._store[k]
+            if tele:
+                telemetry.counter("kvstore.pull_bytes").inc(
+                    sum(_nd_nbytes(o) for o in outs))
             for o in outs:
                 o[:] = src.as_in_context(o.context)
+        if tele:
+            telemetry.histogram("kvstore.pull_us").record(
+                (_time.perf_counter() - t0) * 1e6)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull (allreduce semantics)."""

@@ -41,6 +41,15 @@ class _Formatter(logging.Formatter):
         return super().format(record)
 
 
+def _defer_to_root(record):
+    """Handler filter: once the user configures the root logger
+    (`logging.basicConfig`, pytest's capture, a FileHandler), records reach
+    it via propagation — our default stream handler must then go quiet or
+    every line prints twice. One configurable stream, with out-of-the-box
+    visibility when nothing is configured."""
+    return not logging.getLogger().handlers
+
+
 def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     """Get a customized logger (reference log.py:56): file handler when
     `filename` is given, else a stream handler with colored levels."""
@@ -55,6 +64,7 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
             hdlr = logging.StreamHandler(sys.stderr)
             hdlr.setFormatter(_Formatter(
                 colored=getattr(sys.stderr, "isatty", lambda: False)()))
+            hdlr.addFilter(_defer_to_root)
         logger.addHandler(hdlr)
         # level set ONLY at first init (reference log.py) — later
         # get_logger calls must not clobber a configured level

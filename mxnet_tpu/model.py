@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import os
 import re
+import time as _time
 
 from . import ndarray as nd
 from . import kvstore as kvs
+from . import telemetry
 from .base import MXNetError, getenv
 from .log import get_logger
 
@@ -21,8 +23,14 @@ __all__ = ["save_checkpoint", "load_checkpoint", "find_latest_checkpoint",
 
 from collections import namedtuple
 
+# step_stats (defaulted — positional construction stays valid) carries the
+# per-step telemetry breakdown dict {data/fwdbwd/update/sync/total ms +
+# the step-latency histogram for on-demand p50/p99} from BaseModule.fit to
+# batch-end callbacks (Speedometer)
 BatchEndParam = namedtuple("BatchEndParams",
-                           ["epoch", "nbatch", "eval_metric", "locals"])
+                           ["epoch", "nbatch", "eval_metric", "locals",
+                            "step_stats"],
+                           defaults=[None])
 
 
 def _create_kvstore(kvstore, num_device, arg_params):
@@ -118,12 +126,19 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     that first verifies the new file end-to-end (CRC scan), so a save
     that failed or landed torn can never have destroyed the checkpoint a
     resume would fall back to."""
+    tele = telemetry._enabled
+    t0 = _time.perf_counter() if tele else 0.0
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json", remove_amp_cast=remove_amp_cast)
     save_dict = {f"arg:{k}": v.as_in_context(_cpu()) for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v.as_in_context(_cpu()) for k, v in aux_params.items()})
     cur_path = _param_path(prefix, epoch)
     nd.save(cur_path, save_dict)
+    if tele:
+        # caller-visible cost (device fetch + dispatch); the async disk
+        # write itself lands in checkpoint.write_us on the engine worker
+        telemetry.histogram("checkpoint.save_us").record(
+            (_time.perf_counter() - t0) * 1e6)
     keep = getenv("MXNET_CHECKPOINT_KEEP") if keep is None else int(keep)
     if keep > 0:
         from . import engine
@@ -201,6 +216,8 @@ def load_checkpoint(prefix, epoch=None, fallback=None, return_epoch=False):
             errors.append(e)
             if not fallback:
                 raise
+            if telemetry._enabled:
+                telemetry.counter("checkpoint.crc_fallback").inc()
             get_logger("mxnet_tpu.model").warning(
                 "checkpoint %s is unreadable (%s); falling back to an "
                 "older epoch", _param_path(prefix, cand), e)

@@ -13,6 +13,7 @@ import time
 
 import numpy as _np
 
+from .. import telemetry
 from ..base import MXNetError
 from .. import metric as _metric
 from .. import ndarray as nd
@@ -210,25 +211,59 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
+                # telemetry: per-step breakdown — where a training step's
+                # wall time actually goes (data wait / fwd-bwd dispatch /
+                # optimizer update / metric sync). The metric update fetches
+                # values, so it doubles as the device sync segment.
+                tele = telemetry._enabled
+                t0 = time.perf_counter() if tele else 0.0
                 self.forward_backward(data_batch)
+                t_fb = time.perf_counter() if tele else 0.0
                 self.update()
+                t_up = time.perf_counter() if tele else 0.0
                 if isinstance(data_batch, list):
                     self.update_metric(eval_metric,
                                        [db.label for db in data_batch],
                                        pre_sliced=True)
                 else:
                     self.update_metric(eval_metric, data_batch.label)
+                t_sync = time.perf_counter() if tele else 0.0
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch,
                                  sparse_row_id_fn=sparse_row_id_fn)
                 except StopIteration:
                     end_of_batch = True
+                step_stats = None
+                if tele:
+                    t_data = time.perf_counter()
+                    total_h = telemetry.histogram("step.total_us")
+                    for name, us in (("step.fwdbwd_us", (t_fb - t0) * 1e6),
+                                     ("step.update_us", (t_up - t_fb) * 1e6),
+                                     ("step.sync_us", (t_sync - t_up) * 1e6),
+                                     ("step.data_us", (t_data - t_sync) * 1e6)):
+                        telemetry.histogram(name).record(us)
+                    total_us = (t_data - t0) * 1e6
+                    total_h.record(total_us)
+                    if batch_end_callback is not None:
+                        # quantiles sort the reservoir, so they are NOT
+                        # computed here each batch — the histogram rides
+                        # along and consumers (Speedometer) pull
+                        # hist.quantiles(50, 99) only on their log ticks
+                        step_stats = {
+                            "fwdbwd_ms": (t_fb - t0) * 1e3,
+                            "update_ms": (t_up - t_fb) * 1e3,
+                            "sync_ms": (t_sync - t_up) * 1e3,
+                            "data_ms": (t_data - t_sync) * 1e3,
+                            "total_ms": total_us / 1e3,
+                            "hist": total_h,
+                        }
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
                     for cb in _as_list(batch_end_callback):
-                        cb(_BatchEndParam(epoch, nbatch, eval_metric, locals()))
+                        cb(_BatchEndParam(epoch, nbatch, eval_metric,
+                                          locals(), step_stats=step_stats))
                 nbatch += 1
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -278,11 +313,13 @@ class BaseModule:
 
 
 class _BatchEndParam:
-    def __init__(self, epoch, nbatch, eval_metric, locals_):
+    def __init__(self, epoch, nbatch, eval_metric, locals_, step_stats=None):
         self.epoch = epoch
         self.nbatch = nbatch
         self.eval_metric = eval_metric
         self.locals = locals_
+        # per-step telemetry breakdown (None when MXNET_TELEMETRY is off)
+        self.step_stats = step_stats
 
 
 def _as_list(obj):

@@ -6,9 +6,9 @@ call :meth:`Monitor.tic_tac` around node evaluation when installed.
 """
 from __future__ import annotations
 
-import logging
 import re
 
+from . import log as _log
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
@@ -62,6 +62,10 @@ class Monitor:
         return res
 
     def toc_print(self):
+        # routed through log.get_logger (not the root logger) so monitor
+        # stats share the training/telemetry stream and its config; NOTSET
+        # inherits the root level — the old root `logging.info` visibility
+        logger = _log.get_logger("mxnet_tpu.monitor", level=_log.NOTSET)
         res = self.toc()
         for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+            logger.info("Batch: %7d %30s %s", n, k, v)

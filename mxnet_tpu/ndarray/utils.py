@@ -19,12 +19,15 @@ reference layout, no footers) still load, unverified.
 from __future__ import annotations
 
 import struct
+import time as _time
 import zlib
 
 import numpy as _np
 
+from .. import telemetry
 from ..base import _DTYPE_NP_TO_MX, _DTYPE_MX_TO_NP, np_dtype, MXNetError
-from ..resilience import CorruptCheckpointError, inject, retry_call
+from ..resilience import (CorruptCheckpointError, durable_replace, inject,
+                          retry_call)
 
 _MAGIC = 0x112
 _VERSION = 1  # reserved word: 0 = reference layout, 1 = + per-array CRC footers
@@ -147,6 +150,10 @@ def save(fname, data):
     # save() time even if the caller mutates the arrays right after
     snaps = [a.asnumpy() if hasattr(a, "asnumpy") else _np.asarray(a)
              for a in arrays]
+    if telemetry._enabled:
+        telemetry.counter("checkpoint.saves").inc()
+        telemetry.counter("checkpoint.save_bytes").inc(
+            sum(s.nbytes for s in snaps))
 
     from .. import engine
 
@@ -174,6 +181,8 @@ def _write_file(fname, names, arrays):
     both the transient-EIO and torn-write (truncate=K) injection cases."""
     import os
 
+    tele = telemetry._enabled  # cached: enable() racing this write must
+    t0 = _time.perf_counter() if tele else 0.0  # not record a bogus sample
     rule = inject("write", fname)
     tmp = fname + ".tmp~"
     _write_payload(tmp, names, arrays)
@@ -182,15 +191,13 @@ def _write_file(fname, names, arrays):
             f.truncate(rule.truncate)
             f.flush()
             os.fsync(f.fileno())
-    os.replace(tmp, fname)
-    try:  # make the rename itself durable
-        dfd = os.open(os.path.dirname(os.path.abspath(fname)), os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:
-        pass  # platform without directory fsync
+    durable_replace(tmp, fname)  # rename made durable (dir fsync)
+    if tele:
+        # true wall time of serialize+fsync+rename — runs on the engine
+        # worker in async mode, so this (not save()'s dispatch time) is the
+        # real disk cost of a checkpoint
+        telemetry.histogram("checkpoint.write_us").record(
+            (_time.perf_counter() - t0) * 1e6)
 
 
 def _write_payload(fname, names, arrays):
@@ -235,8 +242,22 @@ def load(fname):
 
     if engine.async_io_enabled():
         engine.wait_all()
-    raw, names = _parse_container(fname, want_data=True,
-                                  verify=bool(getenv("MXNET_CHECKPOINT_VERIFY")))
+    tele = telemetry._enabled
+    t0 = _time.perf_counter() if tele else 0.0
+    try:
+        raw, names = _parse_container(
+            fname, want_data=True,
+            verify=bool(getenv("MXNET_CHECKPOINT_VERIFY")))
+    except CorruptCheckpointError:
+        if tele:
+            telemetry.counter("checkpoint.corrupt").inc()
+        raise
+    if tele:
+        telemetry.counter("checkpoint.loads").inc()
+        telemetry.counter("checkpoint.load_bytes").inc(
+            sum(npv.nbytes for npv in raw))
+        telemetry.histogram("checkpoint.load_us").record(
+            (_time.perf_counter() - t0) * 1e6)
     arrays = [_nd_array(npv, dtype=npv.dtype) for npv in raw]
     if not names:
         return arrays

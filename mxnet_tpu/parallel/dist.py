@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time as _time
 
 import numpy as np
 
@@ -41,6 +42,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import default_mesh
+from .. import telemetry
 from ..kvstore import KVStoreBase
 from . import collectives as coll
 
@@ -196,21 +198,41 @@ def _make_global_stack(buf, fill=0):
         (n_dev,) + tuple(buf.shape), sharding, shards)
 
 
+def _collective_telemetry(name, buf, t0):
+    """Record one collective: bytes on the wire (this process's
+    contribution) and host-side dispatch latency. jax dispatch is async, so
+    the latency histogram is the host cost of issuing the collective — the
+    device-side time shows up in the XLA trace (`profiler.start`)."""
+    telemetry.counter(f"dist.{name}_calls").inc()
+    telemetry.counter(f"dist.{name}_bytes").inc(
+        int(buf.size) * buf.dtype.itemsize)
+    telemetry.histogram(f"dist.{name}_us").record(
+        (_time.perf_counter() - t0) * 1e6)
+
+
 def _allreduce_sum(buf):
     """Sum ``buf`` over all worker processes; replicated result (one
     AllReduce on the wire)."""
     if jax.process_count() == 1 and jax.local_device_count() == len(jax.devices()):
         return buf
+    tele = telemetry._enabled  # cached across the call (mid-call enable)
+    t0 = _time.perf_counter() if tele else 0.0
     stack = _make_global_stack(buf)
     out = _sum_over_devices_fn()(stack)
+    if tele:
+        _collective_telemetry("allreduce", buf, t0)
     return out.addressable_data(0)
 
 
 def _allgather(buf, fill=0):
     """All-gather ``buf`` from every device → replicated (n_dev, *shape).
     Rows from non-primary local devices hold the neutral ``fill``."""
+    tele = telemetry._enabled  # cached across the call (mid-call enable)
+    t0 = _time.perf_counter() if tele else 0.0
     stack = _make_global_stack(buf, fill=fill)
     out = _gather_fn()(stack)
+    if tele:
+        _collective_telemetry("allgather", buf, t0)
     return out.addressable_data(0)
 
 
@@ -280,10 +302,17 @@ class KVStoreDistTPUSync(KVStoreBase):
     def push(self, key, value, priority=0, ignore_sparse=True):
         """Aggregate grads over all workers into the pending buffer."""
         from ..base import MXNetError
+        from ..kvstore import _nd_nbytes
         from ..ndarray import NDArray
         from ..ndarray.sparse import RowSparseNDArray
 
+        tele = telemetry._enabled
+        t0 = _time.perf_counter() if tele else 0.0
         keys, vals = self._key_list(key, value)
+        if tele:
+            telemetry.counter("kvstore.push_bytes").inc(sum(
+                sum(_nd_nbytes(x) for x in v) if isinstance(v, (list, tuple))
+                else _nd_nbytes(v) for v in vals))
         dense_keys, dense_arrs = [], []
         for k, v in zip(keys, vals):
             if k not in self._store:
@@ -297,12 +326,14 @@ class KVStoreDistTPUSync(KVStoreBase):
                 arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
             dense_keys.append(k)
             dense_arrs.append(arr)
-        if not dense_keys:
-            return
-        if self._gc.active:
-            self._push_dense_compressed(dense_keys, dense_arrs)
-        else:
-            self._push_dense(dense_keys, dense_arrs)
+        if dense_keys:
+            if self._gc.active:
+                self._push_dense_compressed(dense_keys, dense_arrs)
+            else:
+                self._push_dense(dense_keys, dense_arrs)
+        if tele:
+            telemetry.histogram("kvstore.push_us").record(
+                (_time.perf_counter() - t0) * 1e6)
 
     def _push_dense(self, keys, arrs):
         """Bucketed allreduce: flatten+concat per dtype, one collective per
@@ -417,8 +448,11 @@ class KVStoreDistTPUSync(KVStoreBase):
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         from ..base import MXNetError
+        from ..kvstore import _nd_nbytes
         from ..ndarray import NDArray
 
+        tele = telemetry._enabled
+        t0 = _time.perf_counter() if tele else 0.0
         keys, outs = self._key_list(key, out)
         for k, o in zip(keys, outs):
             if k not in self._store:
@@ -426,8 +460,14 @@ class KVStoreDistTPUSync(KVStoreBase):
             self._apply_pending(k)
             val = self._store[k]
             targets = o if isinstance(o, (list, tuple)) else [o]
+            if tele:
+                telemetry.counter("kvstore.pull_bytes").inc(
+                    sum(_nd_nbytes(t) for t in targets))
             for t in targets:
                 t._data = jnp.asarray(val, t.dtype)
+        if tele:
+            telemetry.histogram("kvstore.pull_us").record(
+                (_time.perf_counter() - t0) * 1e6)
 
     def _apply_pending(self, k):
         from ..ndarray import NDArray
@@ -521,8 +561,6 @@ class KVStoreDistTPUSync(KVStoreBase):
         takes longer than `MXNET_BARRIER_WARN_S` logs which rank noticed
         and how long it stalled — the first symptom of a dead or wedged
         worker in a multi-host run is everyone else silently parked here."""
-        import time as _time
-
         from ..base import getenv
         from ..log import get_logger
 
@@ -530,6 +568,10 @@ class KVStoreDistTPUSync(KVStoreBase):
         t0 = _time.monotonic()
         coll.barrier(self.mesh)
         elapsed = _time.monotonic() - t0
+        if telemetry._enabled:
+            # straggler wait: time THIS rank sat parked at the sync point —
+            # p99 across steps is the fleet's straggler profile
+            telemetry.histogram("dist.barrier_wait_us").record(elapsed * 1e6)
         if elapsed > warn_s:
             get_logger("mxnet_tpu.dist").warning(
                 "barrier on rank %d/%d took %.1fs (threshold %.0fs) — a "

@@ -18,10 +18,12 @@ import time
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "Task", "Frame", "Event", "Counter", "Marker", "scope",
-           "record_op", "aggregate_stats", "dumps_aggregate"]
+           "record_op", "aggregate_stats", "dumps_aggregate", "dropped_events"]
 
 _config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
 _events = []
+_dropped = 0  # events discarded once _events hit max_events
+_MAX_EVENTS_DEFAULT = 1 << 20
 _lock = threading.Lock()
 _running = False
 _jax_trace_dir = None
@@ -29,7 +31,8 @@ _jax_trace_dir = None
 
 def set_config(**kwargs):
     """Parity `profiler.py:33`. Recognized: filename, profile_(all|symbolic|
-    imperative|memory|api), aggregate_stats, continuous_dump."""
+    imperative|memory|api), aggregate_stats, continuous_dump, max_events
+    (event-buffer cap; overflow counts into `dropped_events()`)."""
     _config.update(kwargs)
 
 
@@ -70,6 +73,7 @@ def resume(profile_process="worker"):
 
 
 def _emit(name, ph, cat="host", ts=None, args=None, dur=None):
+    global _dropped
     if not _running:
         return
     ev = {"name": name, "ph": ph, "cat": cat, "pid": os.getpid(),
@@ -79,6 +83,11 @@ def _emit(name, ph, cat="host", ts=None, args=None, dur=None):
     if dur is not None:
         ev["dur"] = dur
     with _lock:
+        # bounded buffer: a profiler left running for a long job must not
+        # eat the heap — overflow is counted, never silent
+        if len(_events) >= _config.get("max_events", _MAX_EVENTS_DEFAULT):
+            _dropped += 1
+            return
         _events.append(ev)
 
 
@@ -86,21 +95,34 @@ def is_running():
     return _running
 
 
-def record_op(name, dur_us, cat="operator"):
-    """Record one operator execution of `dur_us` microseconds — the role of
-    the engine's ProfileOperator wrap (`threaded_engine.h:353-362`): called
-    by the nd dispatch layer when profiling is on."""
+def dropped_events():
+    """Events discarded since the last reset because the buffer was full."""
+    return _dropped
+
+
+def record_op(name, dur_us, cat="dispatch"):
+    """Record one op invocation of `dur_us` microseconds — the role of the
+    engine's ProfileOperator wrap (`threaded_engine.h:353-362`), called by
+    the nd dispatch layer when profiling is on. Default category is
+    "dispatch": jax dispatch is async, so the duration is HOST dispatch
+    cost, not device execution. The dispatch layer passes cat="operator"
+    only when it actually blocked on the result (`profile_all` /
+    `profile_sync`), making the label tell the truth about what was
+    measured."""
     _emit(name, "X", cat, ts=time.time() * 1e6 - dur_us, dur=dur_us)
 
 
-def aggregate_stats():
+def aggregate_stats(events=None):
     """Per-name aggregate over recorded duration events: {category:
     {name: (count, total_ms, min_ms, max_ms)}} — the
-    `aggregate_stats.cc` AggregateStats role."""
+    `aggregate_stats.cc` AggregateStats role. ``events`` aggregates a
+    caller-captured snapshot (dumps() uses it to capture+reset atomically)
+    instead of the live buffer."""
     stats = {}
-    with _lock:
-        evs = list(_events)
-    for ev in evs:
+    if events is None:
+        with _lock:
+            events = list(_events)
+    for ev in events:
         if ev.get("ph") != "X" or "dur" not in ev:
             continue
         cat = ev.get("cat", "host")
@@ -111,7 +133,7 @@ def aggregate_stats():
     return stats
 
 
-def dumps_aggregate(sort_by="total", ascending=False):
+def dumps_aggregate(sort_by="total", ascending=False, events=None):
     """Render the aggregate per-op summary table — the terminal-readable
     output of the reference's `MXAggregateProfileStatsPrint`
     (`aggregate_stats.cc`). sort_by: total|avg|min|max|count."""
@@ -121,7 +143,7 @@ def dumps_aggregate(sort_by="total", ascending=False):
     lines = ["", "Profile Statistics:"]
     hdr = (f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
            f"{'Min Time (ms)':>16}{'Max Time (ms)':>16}{'Avg Time (ms)':>16}")
-    for cat, names in sorted(aggregate_stats().items()):
+    for cat, names in sorted(aggregate_stats(events).items()):
         lines.append("")
         lines.append(cat)
         lines.append("=" * len(cat))
@@ -141,29 +163,79 @@ def dumps_aggregate(sort_by="total", ascending=False):
     return "\n".join(lines) + "\n"
 
 
+def _reset_events():
+    global _dropped
+    _events.clear()
+    _dropped = 0
+
+
+def _capture(reset=False):
+    """Snapshot (events, dropped); ``reset`` clears the buffer in the SAME
+    critical section, so an event emitted concurrently is either in this
+    capture or in the next one — never silently dropped between two lock
+    takes."""
+    with _lock:
+        events = list(_events)
+        dropped = _dropped
+        if reset:
+            _reset_events()
+    return events, dropped
+
+
+def _render_trace(events, dropped):
+    """Chrome-trace JSON with the telemetry registry's counter events
+    merged in (same timeline as the host scopes and the XLA trace) and the
+    dropped-event count in otherData."""
+    try:  # telemetry merge is additive — never break a dump
+        from . import telemetry
+
+        if telemetry._enabled and (events or _running):
+            events = events + telemetry.trace_counter_events()
+    except Exception:  # noqa: BLE001
+        pass
+    doc = {"traceEvents": events}
+    if dropped:
+        doc["otherData"] = {"dropped_events": dropped}
+    return json.dumps(doc, indent=2)
+
+
+def _trace_json(reset=False):
+    return _render_trace(*_capture(reset))
+
+
 def dumps(reset=False, sort_by="total", ascending=False):
     """Reference `profiler.py:151` dumps: the aggregate per-op table when
     `aggregate_stats=True` was configured, else the chrome-trace JSON."""
     if _config.get("aggregate_stats"):
-        out = dumps_aggregate(sort_by, ascending)
-        if reset:
-            with _lock:
-                _events.clear()
-        return out
-    with _lock:
-        out = json.dumps({"traceEvents": list(_events)}, indent=2)
-        if reset:
-            _events.clear()
-    return out
+        with _lock:
+            evs = list(_events)
+            if reset:
+                _reset_events()
+        return dumps_aggregate(sort_by, ascending, events=evs)
+    return _trace_json(reset=reset)
 
 
 def dump(finished=True, profile_process="worker"):
-    # always the chrome-trace JSON (the aggregate table is a dumps() view)
+    """Write the chrome-trace JSON to the configured filename (the
+    aggregate table is a dumps() view). ``finished=True`` (the default, the
+    reference's contract) resets the event buffer after writing, so
+    repeated dumps never duplicate events; ``finished=False`` is a
+    continuous mid-run dump that keeps accumulating. A failed write puts
+    the captured events back — a bad filename must not destroy the trace
+    (retry with a corrected set_config)."""
+    global _dropped
     fname = _config.get("filename", "profile.json")
-    with _lock:
-        out = json.dumps({"traceEvents": list(_events)}, indent=2)
-    with open(fname, "w") as f:
-        f.write(out)
+    events, dropped = _capture(reset=finished)
+    try:
+        out = _render_trace(events, dropped)
+        with open(fname, "w") as f:
+            f.write(out)
+    except BaseException:
+        if finished:  # restore: the dump failed, the trace is NOT consumed
+            with _lock:
+                _events[:0] = events
+                _dropped += dropped
+        raise
 
 
 class _Scoped:

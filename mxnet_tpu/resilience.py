@@ -48,7 +48,23 @@ from .log import get_logger
 
 __all__ = ["CorruptCheckpointError", "ThreadKilled", "FaultRule",
            "retry_call", "wrap_retry", "open_checked", "inject",
-           "fault_scope", "reset_fault_counters"]
+           "fault_scope", "reset_fault_counters", "durable_replace"]
+
+
+def durable_replace(tmp, dst):
+    """Atomically publish a fully-written (and fsync'd) temp file: rename,
+    then fsync the containing directory so a host crash right after cannot
+    lose the rename itself. The shared tail of every atomic writer here
+    (checkpoint payloads, telemetry snapshots)."""
+    os.replace(tmp, dst)
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(dst)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platform without directory fsync
 
 register_env("MXNET_IO_RETRY_BUDGET", 3, "retries after the first failed IO attempt")
 register_env("MXNET_IO_RETRY_BACKOFF", 0.05, "initial retry backoff seconds")
@@ -103,10 +119,16 @@ def retry_call(fn, *args, desc=None, retries=None, backoff=None,
         try:
             return fn(*args, **kwargs)
         except retry_on as e:
+            from . import telemetry
+
             if isinstance(e, OSError) and e.errno in _NO_RETRY_ERRNOS:
                 raise
             if attempt >= retries:
+                if telemetry._enabled:
+                    telemetry.counter("io.retry_exhausted").inc()
                 raise
+            if telemetry._enabled:
+                telemetry.counter("io.retries").inc()
             delay = min(backoff * (2 ** attempt), backoff_max)
             delay *= 0.5 + 0.5 * random.random()
             attempt += 1

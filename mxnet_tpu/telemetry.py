@@ -1,0 +1,465 @@
+"""Runtime telemetry: a process-wide metrics registry + export paths.
+
+PR 1 (resilience) made failures survivable; this layer makes the runtime
+*measurable*. The reference ships per-op timing through the profiler
+(`src/profiler/`) but has no cross-layer metrics plane — a slow multi-host
+run is diagnosed by eyeballing logs. Here every hot path the framework owns
+reports into one registry:
+
+* ``engine.*``   — push→run latency, queue depth, async errors
+  (:mod:`mxnet_tpu.engine`);
+* ``io.*``       — prefetch wait vs. compute time and the derived
+  starvation ratio (:class:`mxnet_tpu.io.PrefetchingIter`), plus the
+  transient-IO retry counters fed from :func:`mxnet_tpu.resilience.retry_call`;
+* ``kvstore.*`` / ``dist.*`` — push/pull bytes + latency, collective bytes,
+  barrier straggler wait (:mod:`mxnet_tpu.kvstore`,
+  :mod:`mxnet_tpu.parallel.dist`);
+* ``checkpoint.*`` — save/load duration, bytes, CRC-fallback events
+  (:mod:`mxnet_tpu.model`, :mod:`mxnet_tpu.ndarray.utils`);
+* ``step.*``     — per-training-step breakdown (data / forward-backward /
+  update / sync) recorded by ``BaseModule.fit`` and surfaced through
+  ``BatchEndParam.step_stats`` so ``Speedometer`` logs p50/p99 step latency
+  alongside samples/sec.
+
+Metric kinds: :class:`Counter` (monotonic), :class:`Gauge` (set/inc/dec),
+:class:`Histogram` (exact count/sum/min/max + a bounded reservoir for
+p50/p95/p99 — memory is O(reservoir), never O(samples)).
+
+Export, three ways:
+
+1. :func:`dumps` — JSON snapshot; ``MXNET_TELEMETRY_DUMP=<path>`` writes it
+   at interpreter exit via the same temp-file + fsync + atomic-rename path
+   checkpoints use, so a crash mid-dump can never leave a torn snapshot.
+2. :func:`trace_counter_events` — chrome-trace ``"C"`` (counter) events
+   merged into ``profiler.dump()`` output, so metrics line up with the XLA
+   trace timeline in chrome://tracing / perfetto.
+3. periodic log summaries through :func:`mxnet_tpu.log.get_logger`
+   (``MXNET_TELEMETRY_LOG_INTERVAL_S``).
+
+Overhead discipline: everything is gated on the module-level ``_enabled``
+flag (``MXNET_TELEMETRY=1`` or :func:`enable`). Instrumented call sites
+check the flag BEFORE taking any timestamp, so a disabled registry costs
+one attribute read per call — nothing else.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import random
+import threading
+import time
+
+from .base import getenv, register_env
+from .log import get_logger
+
+__all__ = ["Counter", "Gauge", "Histogram",
+           "counter", "gauge", "histogram", "get",
+           "enabled", "enable", "disable", "reset",
+           "snapshot", "dumps", "dump", "dumps_table",
+           "trace_counter_events", "start_log_thread", "stop_log_thread"]
+
+register_env("MXNET_TELEMETRY", False, "enable the runtime metrics registry")
+register_env("MXNET_TELEMETRY_DUMP", "",
+             "write a telemetry.dumps() JSON snapshot to this path at exit")
+register_env("MXNET_TELEMETRY_LOG_INTERVAL_S", 0.0,
+             "log a telemetry summary every N seconds (0 = off)")
+register_env("MXNET_TELEMETRY_RESERVOIR", 1024,
+             "histogram reservoir size (quantile accuracy vs. memory)")
+
+# THE gate. Call sites read `telemetry._enabled` (one attribute fetch)
+# before doing any telemetry work, including taking timestamps.
+_enabled = bool(getenv("MXNET_TELEMETRY"))
+
+_registry = {}            # name -> metric
+_registry_lock = threading.Lock()
+
+
+def _logger():
+    from . import log as _log
+
+    return get_logger("mxnet_tpu.telemetry", level=_log.INFO)
+
+
+# ---------------------------------------------------------------------------
+# Metric kinds
+# ---------------------------------------------------------------------------
+
+
+class Counter:
+    """Monotonic counter (events, bytes, retries)."""
+
+    kind = "counter"
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value (queue depth, ratios)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+
+def _percentile(samples, q):
+    """q-th percentile (0-100) of an already-sorted sample list; None when
+    empty. THE quantile formula — every export path uses this one."""
+    if not samples:
+        return None
+    last = len(samples) - 1
+    return samples[max(0, min(int(round(q / 100.0 * last)), last))]
+
+
+class Histogram:
+    """Latency/size distribution: exact count/sum/min/max plus a bounded
+    reservoir (Vitter's algorithm R) for p50/p95/p99 — a week-long run
+    records billions of steps in O(reservoir) memory."""
+
+    kind = "histogram"
+    __slots__ = ("name", "_lock", "_count", "_sum", "_min", "_max",
+                 "_reservoir", "_cap")
+
+    def __init__(self, name, reservoir=None):
+        self.name = name
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._cap = int(reservoir if reservoir is not None
+                        else getenv("MXNET_TELEMETRY_RESERVOIR"))
+        self._reservoir = []
+
+    def record(self, v):
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            if len(self._reservoir) < self._cap:
+                self._reservoir.append(v)
+            else:
+                j = random.randrange(self._count)
+                if j < self._cap:
+                    self._reservoir[j] = v
+
+    @property
+    def count(self):
+        return self._count
+
+    def percentile(self, q):
+        """Approximate q-th percentile (0-100) from the reservoir."""
+        return self.quantiles(q)[0]
+
+    def quantiles(self, *qs):
+        """Several percentiles from ONE sorted reservoir copy (the hot-loop
+        spelling: p50+p99 per step must not sort twice). None entries when
+        the reservoir is empty (no samples yet, or reservoir size 0)."""
+        with self._lock:
+            samples = sorted(self._reservoir)
+        return tuple(_percentile(samples, q) for q in qs)
+
+    def snapshot(self):
+        with self._lock:
+            count, total = self._count, self._sum
+            samples = sorted(self._reservoir)
+        if not count:
+            return {"count": 0, "sum": 0.0, "min": None, "max": None,
+                    "avg": None, "p50": None, "p95": None, "p99": None}
+        return {"count": count, "sum": total,
+                "min": self._min, "max": self._max, "avg": total / count,
+                "p50": _percentile(samples, 50),
+                "p95": _percentile(samples, 95),
+                "p99": _percentile(samples, 99)}
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def _get_or_create(name, cls):
+    m = _registry.get(name)
+    if m is not None:
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"telemetry metric {name!r} already registered as {m.kind}")
+        return m
+    with _registry_lock:
+        m = _registry.get(name)
+        if m is None:
+            m = _registry[name] = cls(name)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"telemetry metric {name!r} already registered as {m.kind}")
+    return m
+
+
+def counter(name):
+    """Get-or-create the :class:`Counter` named ``name``."""
+    return _get_or_create(name, Counter)
+
+
+def gauge(name):
+    """Get-or-create the :class:`Gauge` named ``name``."""
+    return _get_or_create(name, Gauge)
+
+
+def histogram(name):
+    """Get-or-create the :class:`Histogram` named ``name``."""
+    return _get_or_create(name, Histogram)
+
+
+def get(name):
+    """The metric named ``name``, or None."""
+    return _registry.get(name)
+
+
+def enabled():
+    return _enabled
+
+
+def enable(on=True):
+    """Turn the registry on (also: ``MXNET_TELEMETRY=1`` at import)."""
+    global _enabled
+    _enabled = bool(on)
+    if _enabled:
+        start_log_thread()
+
+
+def disable():
+    enable(False)
+
+
+def reset():
+    """Drop every metric (tests; a fresh registry, enabled state kept)."""
+    with _registry_lock:
+        _registry.clear()
+
+
+# ---------------------------------------------------------------------------
+# Snapshot / export
+# ---------------------------------------------------------------------------
+
+
+def snapshot():
+    """One coherent dict of every metric: {counters, gauges, histograms,
+    derived}. ``derived`` carries cross-metric ratios, e.g. the prefetch
+    starvation ratio wait/(wait+compute) — >0.5 means the step loop spends
+    more time waiting on data than computing (docs/faq/perf.md)."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    out = {"ts": time.time(), "pid": os.getpid(),
+           "counters": {}, "gauges": {}, "histograms": {}, "derived": {}}
+    for m in metrics:
+        out[m.kind + "s"][m.name] = m.snapshot()
+    wait = out["counters"].get("io.prefetch_wait_us_total", 0.0)
+    compute = out["counters"].get("io.prefetch_compute_us_total", 0.0)
+    if wait + compute > 0:
+        out["derived"]["io.starvation_ratio"] = wait / (wait + compute)
+    return out
+
+
+def dumps(indent=2):
+    """JSON snapshot of the registry."""
+    return json.dumps(snapshot(), indent=indent)
+
+
+def dump(path=None):
+    """Write :func:`dumps` to ``path`` (default ``MXNET_TELEMETRY_DUMP``)
+    through the checkpoint writers' temp-file + fsync + atomic-rename
+    sequence — a reader (or a crash) never sees a torn snapshot."""
+    from .resilience import durable_replace
+
+    path = path or getenv("MXNET_TELEMETRY_DUMP")
+    if not path:
+        raise ValueError("no dump path: pass one or set MXNET_TELEMETRY_DUMP")
+    payload = dumps()
+    tmp = path + ".tmp~"
+    with open(tmp, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    durable_replace(tmp, path)
+    return path
+
+
+def trace_counter_events(ts=None):
+    """The registry as chrome-trace ``"C"`` (counter) events, for merging
+    into ``profiler.dump()`` output: counters/gauges one series each,
+    histograms a {p50, p99, count} series — metrics land on the same
+    timeline as the host scopes and the XLA trace."""
+    ts = time.time() * 1e6 if ts is None else ts
+    pid = os.getpid()
+    snap = snapshot()
+    events = []
+
+    def emit(name, args):
+        events.append({"name": f"telemetry/{name}", "ph": "C",
+                       "cat": "telemetry", "pid": pid, "tid": 0,
+                       "ts": ts, "args": args})
+
+    for name, v in snap["counters"].items():
+        emit(name, {"value": v})
+    for name, v in snap["gauges"].items():
+        emit(name, {"value": v})
+    for name, v in snap["derived"].items():
+        emit(name, {"value": v})
+    for name, h in snap["histograms"].items():
+        if h["count"]:
+            emit(name, {"p50": h["p50"], "p99": h["p99"],
+                        "count": h["count"]})
+    return events
+
+
+def dumps_table(snap=None, sort_by="total"):
+    """Render a snapshot (live registry when ``snap`` is None) in the
+    ``profiler.dumps_aggregate`` table format, histograms extended with
+    quantile columns — one visual language for both planes
+    (`tools/telemetry_report.py` renders dumped files through this)."""
+    snap = snapshot() if snap is None else snap
+    lines = ["", "Telemetry Statistics:"]
+
+    def section(title, hdr, rows):
+        if not rows:
+            return
+        lines.append("")
+        lines.append(title)
+        lines.append("=" * len(title))
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+        lines.extend(rows)
+
+    def val(v):
+        return f"{v:>16.1f}" if isinstance(v, float) else f"{v:>16}"
+
+    fmt_cg = f"{'Name':<40}{'Value':>16}"
+    section("counters", fmt_cg,
+            [f"{n[:39]:<40}{val(v)}" for n, v in sorted(snap["counters"].items())])
+    section("gauges", fmt_cg,
+            [f"{n[:39]:<40}{val(v)}" for n, v in sorted(snap["gauges"].items())])
+    section("derived", fmt_cg,
+            [f"{n[:39]:<40}{v:>16.4f}" for n, v in sorted(snap["derived"].items())])
+
+    hdr = (f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
+           f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}"
+           f"{'p50 (ms)':>12}{'p95 (ms)':>12}{'p99 (ms)':>12}")
+    rows = []
+    key_idx = {"count": "count", "total": "sum", "avg": "avg",
+               "min": "min", "max": "max"}
+    if sort_by not in key_idx:
+        raise ValueError(f"sort_by must be one of {sorted(key_idx)}")
+    hists = sorted(snap["histograms"].items(),
+                   key=lambda kv: kv[1].get(key_idx[sort_by]) or 0,
+                   reverse=True)
+    for name, h in hists:
+        if not h["count"]:
+            continue
+
+        def ms(v):
+            return f"{v / 1e3:>12.4f}" if v is not None else f"{'-':>12}"
+
+        rows.append(f"{name[:39]:<40}{h['count']:>12}{h['sum'] / 1e3:>14.4f}"
+                    f"{ms(h['min'])}{ms(h['max'])}{ms(h['avg'])}"
+                    f"{ms(h['p50'])}{ms(h['p95'])}{ms(h['p99'])}")
+    section("histograms (us-valued, shown in ms)", hdr, rows)
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Periodic log summaries
+# ---------------------------------------------------------------------------
+
+_log_thread = None
+_log_stop = threading.Event()
+
+
+def start_log_thread(interval=None):
+    """Start the summary logger (idempotent). Interval from the arg or
+    ``MXNET_TELEMETRY_LOG_INTERVAL_S``; 0/negative means off."""
+    global _log_thread
+    interval = (float(getenv("MXNET_TELEMETRY_LOG_INTERVAL_S"))
+                if interval is None else float(interval))
+    if interval <= 0 or (_log_thread is not None and _log_thread.is_alive()):
+        return None
+    _log_stop.clear()
+
+    def loop():
+        while not _log_stop.wait(interval):
+            if _enabled and _registry:
+                _logger().info("telemetry summary:%s", dumps_table())
+
+    _log_thread = threading.Thread(target=loop, daemon=True,
+                                   name="mxnet_tpu.telemetry.log")
+    _log_thread.start()
+    return _log_thread
+
+
+def stop_log_thread():
+    global _log_thread
+    _log_stop.set()
+    if _log_thread is not None:
+        _log_thread.join(timeout=1.0)
+        _log_thread = None
+
+
+@atexit.register
+def _dump_at_exit():
+    """``MXNET_TELEMETRY_DUMP`` exit dump — best-effort: a failed telemetry
+    write must never turn a clean exit into a crash, but it is logged."""
+    path = getenv("MXNET_TELEMETRY_DUMP")
+    if not path or not _registry:
+        return
+    try:
+        dump(path)
+    except Exception as e:  # noqa: BLE001 — interpreter is dying
+        try:
+            _logger().error("telemetry exit dump to %s failed: %r", path, e)
+        except Exception:
+            pass
+
+
+if _enabled:
+    start_log_thread()

@@ -68,10 +68,14 @@ def test_async_save_same_path_ordering(tmp_path):
 
 
 @native
-def test_async_save_error_surfaces():
-    """A failed async write raises at the sync point, not silently."""
+def test_async_save_error_surfaces(tmp_path):
+    """A failed async write raises at the sync point, not silently.
+    The missing directory is a tmp_path child — hermetic, unlike an
+    absolute root-level path that anything else on the host could
+    accidentally create."""
     with pytest.raises(OSError):
-        nd.save("/nonexistent_dir_xyz/file.params", {"w": nd.zeros((2,))})
+        nd.save(str(tmp_path / "no_such_dir" / "file.params"),
+                {"w": nd.zeros((2,))})
         engine.wait_all()
 
 

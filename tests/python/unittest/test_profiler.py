@@ -24,10 +24,28 @@ def test_per_op_events_recorded(tmp_path):
     _run_ops()
     profiler.stop()
     trace = json.loads(profiler.dumps(reset=True))
+    # async dispatch timing is labelled "dispatch" (the label must not
+    # claim device execution time it didn't measure)
     names = [e["name"] for e in trace["traceEvents"]
-             if e.get("cat") == "operator"]
+             if e.get("cat") == "dispatch"]
     assert names.count("dot") == 3
     assert "relu" in names
+
+
+def test_profile_all_records_true_op_time(tmp_path):
+    """With profile_all the dispatch layer blocks on the result, so events
+    carry cat="operator" — true completion time."""
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        aggregate_stats=False, profile_all=True)
+    try:
+        profiler.start()
+        _run_ops()
+        profiler.stop()
+        trace = json.loads(profiler.dumps(reset=True))
+        cats = {e["cat"] for e in trace["traceEvents"] if e["name"] == "dot"}
+        assert cats == {"operator"}
+    finally:  # a failure must not leak profile_all into later tests
+        profiler.set_config(profile_all=False)
 
 
 def test_aggregate_table(tmp_path):
@@ -39,7 +57,7 @@ def test_aggregate_table(tmp_path):
         pass
     profiler.stop()
     stats = profiler.aggregate_stats()
-    assert stats["operator"]["dot"][0] == 3  # count
+    assert stats["dispatch"]["dot"][0] == 3  # count
     table = profiler.dumps(reset=False)
     assert "Profile Statistics" in table
     assert "dot" in table and "Total Count" in table
@@ -61,3 +79,86 @@ def test_profiler_off_records_nothing():
     _run_ops()
     trace = json.loads(profiler.dumps())
     assert trace["traceEvents"] == []
+
+
+def test_dump_resets_and_does_not_duplicate(tmp_path):
+    """dump(finished=True) honors reset semantics: a second dump must not
+    re-emit the first dump's events."""
+    fname = tmp_path / "prof.json"
+    profiler.set_config(filename=str(fname), aggregate_stats=False)
+    profiler.start()
+    _run_ops()
+    profiler.stop()
+    profiler.dump()
+    first = json.loads(fname.read_text())["traceEvents"]
+    assert [e for e in first if e["name"] == "dot"]
+    profiler.dump()
+    second = json.loads(fname.read_text())["traceEvents"]
+    assert not [e for e in second if e["name"] == "dot"]
+    # and the in-memory buffer is clear too
+    assert json.loads(profiler.dumps())["traceEvents"] == []
+
+
+def test_dump_continuous_keeps_events(tmp_path):
+    """dump(finished=False) is a mid-run dump: events keep accumulating."""
+    fname = tmp_path / "prof.json"
+    profiler.set_config(filename=str(fname), aggregate_stats=False)
+    profiler.start()
+    _run_ops()
+    profiler.dump(finished=False)
+    _run_ops()
+    profiler.stop()
+    profiler.dump(finished=True)
+    final = json.loads(fname.read_text())["traceEvents"]
+    assert len([e for e in final if e["name"] == "dot"]) == 6
+    profiler.dumps(reset=True)
+
+
+def test_event_cap_counts_dropped(tmp_path):
+    """The event buffer is bounded; overflow increments dropped_events and
+    surfaces in the dump's otherData instead of growing without bound."""
+    fname = tmp_path / "prof.json"
+    profiler.dumps(reset=True)
+    profiler.set_config(filename=str(fname), aggregate_stats=False,
+                        max_events=5)
+    try:
+        profiler.start()
+        _run_ops()  # 4 events
+        _run_ops()  # 4 more: 3 dropped
+        profiler.stop()
+        assert profiler.dropped_events() == 3
+        doc = json.loads(profiler.dumps())
+        assert len(doc["traceEvents"]) == 5
+        assert doc["otherData"]["dropped_events"] == 3
+        profiler.dump()  # finished=True resets events AND the dropped counter
+        assert profiler.dropped_events() == 0
+    finally:  # a failure must not leak the tiny cap into later tests
+        profiler.set_config(max_events=profiler._MAX_EVENTS_DEFAULT)
+
+
+def test_dump_write_failure_preserves_events(tmp_path):
+    """A dump to an unwritable path must not consume the trace — the old
+    (pre-reset) dump was retryable and the new one must stay retryable."""
+    import pytest
+
+    profiler.dumps(reset=True)
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        aggregate_stats=False)
+    try:
+        profiler.start()
+        _run_ops()
+        profiler.stop()
+        # point the dump at an unwritable path AFTER the run (start()
+        # would have created the trace dir's parents)
+        profiler.set_config(filename=str(tmp_path / "missing" / "p.json"))
+        with pytest.raises(OSError):
+            profiler.dump()
+        # events survived the failed write; a corrected dump drains them
+        profiler.set_config(filename=str(tmp_path / "p.json"))
+        profiler.dump()
+        doc = json.loads((tmp_path / "p.json").read_text())
+        assert [e for e in doc["traceEvents"] if e["name"] == "dot"]
+        assert json.loads(profiler.dumps())["traceEvents"] == []
+    finally:
+        profiler.set_config(filename=str(tmp_path / "p.json"))
+        profiler.dumps(reset=True)

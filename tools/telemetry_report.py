@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Render a dumped telemetry snapshot as a human-readable table.
+
+Usage::
+
+    python tools/telemetry_report.py telemetry.json [--sort-by total|count|avg|min|max]
+
+The input is a ``mxnet_tpu.telemetry.dumps()`` JSON snapshot — written by
+``MXNET_TELEMETRY_DUMP=<path>`` at exit, ``telemetry.dump(path)``, or
+``bench.py`` (``BENCH_TELEMETRY.json`` next to its BENCH output). The
+rendering is ``telemetry.dumps_table`` — the same visual format as
+``profiler.dumps_aggregate``, so perf rounds read one table language for
+both planes.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snapshot", help="path to a telemetry JSON snapshot")
+    ap.add_argument("--sort-by", default="total",
+                    choices=("total", "count", "avg", "min", "max"),
+                    help="histogram sort key (default: total time)")
+    args = ap.parse_args(argv)
+
+    with open(args.snapshot) as f:
+        snap = json.load(f)
+    for key in ("counters", "gauges", "histograms"):
+        if key not in snap:
+            sys.stderr.write(
+                f"{args.snapshot}: not a telemetry snapshot (missing {key!r})\n")
+            return 2
+
+    from mxnet_tpu import telemetry
+
+    sys.stdout.write(telemetry.dumps_table(snap, sort_by=args.sort_by))
+    ts = snap.get("ts")
+    if ts is not None:
+        import datetime
+
+        when = datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+        sys.stdout.write(f"\nsnapshot: pid={snap.get('pid')} "
+                         f"at {when:%Y-%m-%d %H:%M:%S} UTC\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
