@@ -64,10 +64,15 @@ if _FORCE_CPU:
 try:
     import jax as _jax_for_cache
 
-    _cache_dir = os.environ.get("BENCH_COMPILE_CACHE",
-                                os.path.join(os.path.dirname(
-                                    os.path.abspath(__file__)), ".jax_cache"))
+    _cache_dir = (os.environ.get("BENCH_COMPILE_CACHE")
+                  or os.environ.get("MXNET_COMPILE_CACHE_DIR")  # framework knob
+                  or os.path.join(os.path.dirname(
+                      os.path.abspath(__file__)), ".jax_cache"))
     os.makedirs(_cache_dir, exist_ok=True)
+    # pin the framework to the same directory: importing mxnet_tpu later
+    # re-applies MXNET_COMPILE_CACHE_DIR, which would otherwise split the
+    # run's executables across two caches
+    os.environ["MXNET_COMPILE_CACHE_DIR"] = _cache_dir
     _jax_for_cache.config.update("jax_compilation_cache_dir", _cache_dir)
     _jax_for_cache.config.update(
         "jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -299,9 +304,14 @@ def _measure_raw(on_tpu, fetch_cost):
         flops = None
 
     # warmup (compile) — drain the queue with a real fetch so queued warmup
-    # work cannot bleed into the timed window
-    for _ in range(2):
-        params, momenta, loss = train_step(params, momenta, key, xb, yb)
+    # work cannot bleed into the timed window. The first-step wall time is
+    # reported separately (`raw_compile_s`): steady-state img/s must never
+    # absorb the one-off compile.
+    t_c0 = time.perf_counter()
+    params, momenta, loss = train_step(params, momenta, key, xb, yb)
+    jax.device_get(loss)
+    compile_s = time.perf_counter() - t_c0
+    params, momenta, loss = train_step(params, momenta, key, xb, yb)
     jax.device_get(loss)
 
     iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
@@ -323,13 +333,16 @@ def _measure_raw(on_tpu, fetch_cost):
     jax.block_until_ready(loss)
     img_s_disp = batch * iters / (time.perf_counter() - t0)
     jax.device_get(loss)  # drain before the next measurement starts
-    return img_s_fetch, img_s_disp, batch, size, iters, flops
+    return img_s_fetch, img_s_disp, batch, size, iters, flops, compile_s
 
 
-def _measure_framework(on_tpu, fetch_cost, dtype="float32"):
+def _measure_framework(on_tpu, fetch_cost, dtype="float32", fused=True):
     """The public-API path: hybridized gluon net + autograd + Trainer.step
     fed by NDArrayIter — what `example/gluon/image_classification.py` runs.
-    Returns (img_s_fetch, img_s_dispatch)."""
+    ``fused=False`` pins MXNET_FUSED_STEP=0 for the measurement, so the
+    emitted fused-vs-eager pair attributes `framework_vs_raw` movement to
+    the fused update path specifically.
+    Returns (img_s_fetch, img_s_dispatch, compile_s)."""
     import jax
     import numpy as np
 
@@ -354,6 +367,8 @@ def _measure_framework(on_tpu, fetch_cost, dtype="float32"):
     train_iter = NDArrayIter(data, label, batch_size=batch, shuffle=False)
 
     sce = gloss.SoftmaxCrossEntropyLoss()
+    sce.hybridize()  # the loss compiles like the net: one CachedOp, not
+    # a handful of eager dispatches + tape nodes per step
     trainer = Trainer(net.collect_params(), "sgd",
                       {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4,
                        "multi_precision": dtype != "float32"})
@@ -384,30 +399,129 @@ def _measure_framework(on_tpu, fetch_cost, dtype="float32"):
     def drain():
         jax.device_get(first_param.data()._data)
 
-    last, _ = one_epoch()  # warmup epoch (compiles fwd/bwd + update groups)
-    drain()
+    prev_fused = os.environ.get("MXNET_FUSED_STEP")
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    try:
+        # warmup epoch (compiles fwd/bwd + update groups); its wall time is
+        # the compile cost, reported separately from steady-state img/s
+        t_c0 = time.perf_counter()
+        last, _ = one_epoch()
+        drain()
+        compile_s = time.perf_counter() - t_c0
 
-    iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
-    epochs = max(1, (iters + n_batches - 1) // n_batches)
-    total_imgs = epochs * n_batches * batch
+        iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
+        epochs = max(1, (iters + n_batches - 1) // n_batches)
+        total_imgs = epochs * n_batches * batch
 
-    # --- value-fetch pacing: each step's params feed the next, so fetching
-    # a weight written by the final update forces every queued step
-    def run_all(_n):
-        for _ in range(epochs):
-            one_epoch()
-        return first_param
+        # --- value-fetch pacing: each step's params feed the next, so
+        # fetching a weight written by the final update forces every step
+        def run_all(_n):
+            for _ in range(epochs):
+                one_epoch()
+            return first_param
 
-    img_s_fetch, _ = _fetch_timed(
-        run_all, lambda p: p.data()._data, 1, total_imgs, fetch_cost)
+        img_s_fetch, _ = _fetch_timed(
+            run_all, lambda p: p.data()._data, 1, total_imgs, fetch_cost)
 
-    # --- legacy dispatch pacing
-    t0 = time.perf_counter()
-    run_all(1)
-    jax.block_until_ready(first_param.data()._data)
-    img_s_disp = total_imgs / (time.perf_counter() - t0)
-    drain()
-    return img_s_fetch, img_s_disp
+        # --- legacy dispatch pacing
+        t0 = time.perf_counter()
+        run_all(1)
+        jax.block_until_ready(first_param.data()._data)
+        img_s_disp = total_imgs / (time.perf_counter() - t0)
+        drain()
+    finally:
+        if prev_fused is None:
+            os.environ.pop("MXNET_FUSED_STEP", None)
+        else:
+            os.environ["MXNET_FUSED_STEP"] = prev_fused
+    return img_s_fetch, img_s_disp, compile_s
+
+
+def _measure_module(on_tpu, fetch_cost, fused=True):
+    """The SYMBOLIC public-API path: `Module` on a symbolic ResNet-50
+    (`mxnet_tpu.models.resnet`), same batch/data/optimizer as
+    `_measure_framework`. With ``fused=True`` every step is
+    `Module.fused_step` — forward+backward+optimizer as ONE donated-buffer
+    XLA computation per step (what `Module.fit` runs since the fused-step
+    PR); ``fused=False`` pins MXNET_FUSED_STEP=0 and drives the eager
+    forward_backward()+update() decomposition, so the pair attributes the
+    whole-step-fusion win. Returns (img_s_fetch, img_s_dispatch, compile_s).
+
+    NOTE: the measurement scaffolding (env pin, warm-up compile timing,
+    fetch- then dispatch-paced loops) deliberately mirrors
+    `_measure_framework` line for line — the emitted ratios compare across
+    the two paths, so any change to the timing basis must be applied to
+    BOTH functions or the attribution numbers silently skew."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.models.resnet import resnet50_symbol
+
+    batch, size = raw_shapes(on_tpu)
+    n_batches = 4
+    # image_shape picks the stem; the imagenet stem always, to match the
+    # gluon/raw network even on the small CPU-smoke images
+    sym = resnet50_symbol(num_classes=1000, image_shape=(3, 224, 224))
+    mod = mx.mod.Module(sym)
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-1, 1, (batch * n_batches, 3, size, size)).astype(np.float32)
+    label = rng.randint(0, 1000, (batch * n_batches,)).astype(np.float32)
+    train_iter = NDArrayIter(data, label, batch_size=batch, shuffle=False)
+
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("momentum", 0.9), ("wd", 1e-4)))
+
+    first_name = mod._param_names[0]
+
+    def drain():
+        jax.device_get(mod._exec.arg_dict[first_name]._data)
+
+    def one_epoch():
+        train_iter.reset()
+        for b in train_iter:
+            if not mod.fused_step(b):
+                mod.forward_backward(b)
+                mod.update()
+
+    prev_fused = os.environ.get("MXNET_FUSED_STEP")
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    try:
+        t_c0 = time.perf_counter()
+        one_epoch()
+        drain()
+        compile_s = time.perf_counter() - t_c0
+
+        iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
+        epochs = max(1, (iters + n_batches - 1) // n_batches)
+        total_imgs = epochs * n_batches * batch
+
+        def run_all(_n):
+            for _ in range(epochs):
+                one_epoch()
+            return None
+
+        img_s_fetch, _ = _fetch_timed(
+            run_all, lambda _: mod._exec.arg_dict[first_name]._data,
+            1, total_imgs, fetch_cost)
+
+        t0 = time.perf_counter()
+        run_all(1)
+        jax.block_until_ready(mod._exec.arg_dict[first_name]._data)
+        img_s_disp = total_imgs / (time.perf_counter() - t0)
+        drain()
+    finally:
+        if prev_fused is None:
+            os.environ.pop("MXNET_FUSED_STEP", None)
+        else:
+            os.environ["MXNET_FUSED_STEP"] = prev_fused
+    return img_s_fetch, img_s_disp, compile_s
 
 
 def _measure_peak_flops(on_tpu, fetch_cost):
@@ -471,9 +585,10 @@ def main():
                 pass
         fetch_cost = _fetch_cost()
         result["fetch_cost_ms"] = round(fetch_cost * 1e3, 3)
-        raw_fetch, raw_disp, batch, size, iters, flops = _measure_raw(
-            on_tpu, fetch_cost)
-        fw_fetch, fw_disp = _measure_framework(on_tpu, fetch_cost, "float32")
+        raw_fetch, raw_disp, batch, size, iters, flops, raw_compile_s = \
+            _measure_raw(on_tpu, fetch_cost)
+        fw_fetch, fw_disp, fw_compile_s = _measure_framework(
+            on_tpu, fetch_cost, "float32", fused=True)
         result.update(
             value=round(fw_fetch, 2),
             vs_baseline=round(fw_fetch / BASELINE_IMG_S, 3),
@@ -483,13 +598,61 @@ def main():
             iters=iters,
             raw_fp32=round(raw_fetch, 2),
             raw_fp32_dispatch=round(raw_disp, 2),
+            raw_compile_s=round(raw_compile_s, 2),
             framework_fp32=round(fw_fetch, 2),
             framework_fp32_dispatch=round(fw_disp, 2),
-            framework_vs_raw=round(fw_fetch / raw_fetch, 3),
+            framework_fp32_compile_s=round(fw_compile_s, 2),
+            framework_gluon_vs_raw=round(fw_fetch / raw_fetch, 3),
         )
+        # the SYMBOLIC public path: Module.fused_step — one XLA computation
+        # per train step (the fused-step PR's tentpole). This is the
+        # framework's fastest public path, so framework_vs_raw is defined on
+        # it (basis recorded explicitly; the gluon ratio stays alongside).
         try:
-            bf_fetch, bf_disp = _measure_framework(on_tpu, fetch_cost,
-                                                   "bfloat16")
+            mf_fetch, mf_disp, mf_compile_s = _measure_module(
+                on_tpu, fetch_cost, fused=True)
+            result["framework_module_fused"] = round(mf_fetch, 2)
+            result["framework_module_fused_dispatch"] = round(mf_disp, 2)
+            result["framework_module_compile_s"] = round(mf_compile_s, 2)
+            result["framework_vs_raw"] = round(mf_fetch / raw_fetch, 3)
+            result["framework_vs_raw_basis"] = "module_fused"
+            result["framework_vs_raw_note"] = (
+                "basis changed in the fused-step PR: r01-r05 measured the "
+                "gluon path, continued as framework_gluon_vs_raw")
+        except Exception:  # noqa: BLE001
+            result["module_error"] = traceback.format_exc(limit=3).strip().splitlines()[-1]
+            result["framework_vs_raw"] = round(fw_fetch / raw_fetch, 3)
+            result["framework_vs_raw_basis"] = "gluon (module path failed)"
+        else:
+            # eager comparison in its OWN guard: its failure must not
+            # contradict the already-recorded module_fused basis keys
+            try:
+                me_fetch, me_disp, me_compile_s = _measure_module(
+                    on_tpu, fetch_cost, fused=False)
+                result["framework_module_eager"] = round(me_fetch, 2)
+                result["framework_module_eager_compile_s"] = round(
+                    me_compile_s, 2)
+                # the tentpole attribution: same Module, same data, same
+                # timing basis — only the whole-step fusion differs
+                result["fused_vs_eager"] = round(mf_fetch / me_fetch, 3)
+            except Exception:  # noqa: BLE001
+                result["module_eager_error"] = \
+                    traceback.format_exc(limit=3).strip().splitlines()[-1]
+        try:
+            # gluon eager (MXNET_FUSED_STEP=0) comparison point: the delta
+            # to framework_fp32 is attributable to the fused optimizer
+            # update (Updater._fused_call) alone
+            eg_fetch, eg_disp, eg_compile_s = _measure_framework(
+                on_tpu, fetch_cost, "float32", fused=False)
+            result["framework_fp32_eager"] = round(eg_fetch, 2)
+            result["framework_fp32_eager_dispatch"] = round(eg_disp, 2)
+            result["framework_fp32_eager_compile_s"] = round(eg_compile_s, 2)
+            result["gluon_fused_vs_eager"] = round(fw_fetch / eg_fetch, 3)
+        except Exception:  # noqa: BLE001
+            result["eager_error"] = traceback.format_exc(limit=3).strip().splitlines()[-1]
+        try:
+            bf_fetch, bf_disp, _bf_compile_s = _measure_framework(
+                on_tpu, fetch_cost, "bfloat16")
             result["framework_bf16"] = round(bf_fetch, 2)
             result["framework_bf16_dispatch"] = round(bf_disp, 2)
         except Exception:  # noqa: BLE001

@@ -41,7 +41,8 @@ unit() {
   log "unit suite (includes the 4-process dist kvstore run and CI-guarded examples)"
   python -m pytest tests/python/unittest -q -x \
       --ignore=tests/python/unittest/test_resilience.py \
-      --ignore=tests/python/unittest/test_telemetry.py
+      --ignore=tests/python/unittest/test_telemetry.py \
+      --ignore=tests/python/unittest/test_fused_step.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
   # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
@@ -54,6 +55,11 @@ unit() {
   # mysterious count mismatch inside an unrelated suite
   log "telemetry suite (registry, instrumentation under fault injection, trace merge)"
   python -m pytest tests/python/unittest/test_telemetry.py -q
+  # fused-step gate, standalone: these tests flip MXNET_FUSED_STEP and the
+  # telemetry registry and assert exact compile-cache hit/miss counts, so a
+  # fusion or cache-accounting regression fails HERE with clean attribution
+  log "fused train step suite (fused-vs-eager parity, donation, compile-cache accounting)"
+  python -m pytest tests/python/unittest/test_fused_step.py -q
 }
 
 train() {

@@ -46,6 +46,7 @@ from .random import seed
 from . import engine
 from . import resilience
 from . import telemetry
+from . import compile_cache
 from . import runtime
 
 from . import initializer

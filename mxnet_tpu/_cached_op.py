@@ -25,12 +25,11 @@ OpContext::is_train and per-op PRNG resources.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 
 from . import autograd
 from . import random as _random
+from .compile_cache import CompileCache
 
 __all__ = ["CachedOp"]
 
@@ -47,6 +46,10 @@ class CachedOp:
         self._static_alloc = static_alloc  # accepted for parity; XLA always static-plans
         self._static_shape = static_shape
         self._n_out = None
+        # signature-keyed executable cache (the reference's SetForwardGraph
+        # :295 signature match) — input shape churn is counted, not silent.
+        # Bounded so unbucketed shape churn caps memory, not just visibility
+        self._cache = CompileCache("cached_op", maxsize=64)
 
     # -- tracing ------------------------------------------------------------
 
@@ -70,23 +73,21 @@ class CachedOp:
 
         return run
 
-    @functools.lru_cache(maxsize=None)
-    def _jit_fwd(self, train):
-        return jax.jit(self._traced(train))
+    def _jit_fwd(self, train, sig):
+        return self._cache.get_or_build(
+            ("fwd", train, sig), lambda: jax.jit(self._traced(train)))
 
-    @functools.lru_cache(maxsize=None)
-    def _jit_fwd_vjp(self, train):
-        base = self._traced(train)
+    def _jit_fwd_vjp(self, train, sig):
+        def build():
+            base = self._traced(train)
 
-        def fwd(key, *arrays):
-            outs, vjp = jax.vjp(lambda *a: base(key, *a), *arrays)
-            return outs, vjp
+            def fwd(key, *arrays):
+                outs, vjp = jax.vjp(lambda *a: base(key, *a), *arrays)
+                return outs, vjp
 
-        return jax.jit(fwd)
+            return jax.jit(fwd)
 
-    # lru_cache on methods keeps `self` alive; acceptable — CachedOps live
-    # for the process (same as the reference's cached graphs).
-    _jit_fwd.__isabstractmethod__ = False
+        return self._cache.get_or_build(("fwd_vjp", train, sig), build)
 
     # -- call ---------------------------------------------------------------
 
@@ -108,16 +109,23 @@ class CachedOp:
         key = _random.next_key()
 
         ctx = next((a._ctx for a in nd_inputs if a is not None), default_ctx)
+        # hashable dtype objects, not strings — this runs on every call.
+        # Non-array inputs key by TYPE only: a python scalar is a traced
+        # argument of the shared jit object (weak-typed), so a changing
+        # value re-specializes inside jax, never in this cache — keying on
+        # the value would compile one executable per distinct scalar
+        sig = tuple((a.shape, a.dtype) if hasattr(a, "shape")
+                    else (None, type(a).__name__) for a in arrays)
 
         if recording:
-            outs, vjp = self._jit_fwd_vjp(train)(key, *arrays)
+            outs, vjp = self._jit_fwd_vjp(train, sig)(key, *arrays)
             outs_t = outs if isinstance(outs, tuple) else (outs,)
             out_nds = [NDArray(o, ctx) for o in outs_t]
             autograd._record_node(
                 vjp, nd_inputs, out_nds,
                 [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs_t])
         else:
-            outs = self._jit_fwd(train)(key, *arrays)
+            outs = self._jit_fwd(train, sig)(key, *arrays)
             outs_t = outs if isinstance(outs, tuple) else (outs,)
             out_nds = [NDArray(o, ctx) for o in outs_t]
 

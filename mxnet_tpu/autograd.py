@@ -238,7 +238,9 @@ def _run_backward(heads, head_grads, retain_graph, deposit=True):
                 g = grad_map[id(node.outputs[i])]
                 if isinstance(g, _RowSparseCT):
                     g = g.densify()  # a pullback consumes dense cotangents
-                cts.append(jnp.asarray(g, aval.dtype))
+                if getattr(g, "dtype", None) != aval.dtype:
+                    g = jnp.asarray(g, aval.dtype)  # else: already usable
+                cts.append(g)
             else:
                 cts.append(_zero_ct(aval))
         cts = tuple(cts) if len(node.out_avals) > 1 else cts[0]
@@ -295,10 +297,14 @@ def _deposit(nd_in, grad_map):
     else:
         if isinstance(g, _RowSparseCT):
             g = g.densify()
+        # avoid a per-parameter re-wrap dispatch when the cotangent already
+        # has the right dtype (the common case: ~#params calls per step)
+        if getattr(g, "dtype", None) != nd_in.grad.dtype:
+            g = jnp.asarray(g, nd_in.grad.dtype)
         if nd_in.grad_req == "write":
-            nd_in.grad._data = jnp.asarray(g, nd_in.grad.dtype)
+            nd_in.grad._data = g
         elif nd_in.grad_req == "add":
-            nd_in.grad._data = nd_in.grad._data + jnp.asarray(g, nd_in.grad.dtype)
+            nd_in.grad._data = nd_in.grad._data + g
     nd_in._fresh_grad = True  # cleared by Trainer._update (stale-grad check)
     grad_map[id(nd_in)] = None  # only deposit once
 

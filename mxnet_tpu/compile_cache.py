@@ -1,0 +1,284 @@
+"""CompileCache — the explicit, observable jit-executable cache.
+
+The reference amortizes graph setup through CachedOp's signature-keyed
+graph cache (`src/imperative/cached_op.cc` `SetForwardGraph`:295 — shape/
+dtype of every input is the key). Here the executables are `jax.jit`
+callables, and before this module they were held in anonymous
+`functools.lru_cache`s: a bucketing run or a partial last batch that
+churned shapes recompiled *silently*, which is exactly the failure mode
+BENCH_r05 could not attribute. Every compiled-callable cache in the
+framework (symbol executors, CachedOp, the fused train step, the fused
+optimizer update) now lives in a named :class:`CompileCache`, so the
+registry answers the three questions a perf round asks:
+
+* how many distinct programs exist (``compile.cache_entries`` gauge),
+* how often a step re-used one (``compile.cache_hits`` /
+  ``compile.cache_misses`` counters),
+* how long the misses cost (``compile.seconds`` counter — the first
+  invocation of a cached callable is timed: jax traces + XLA-compiles
+  synchronously on first call, so first-call time ≈ compile time).
+
+Counters are recorded unconditionally (one lock-protected increment per
+step — noise next to a dispatch) so cache accounting works even when the
+wider telemetry plane is off.
+
+Persistent on-disk XLA cache: ``MXNET_COMPILE_CACHE_DIR=<dir>`` points
+jax's compilation cache at ``<dir>`` so a program compiled once (e.g. in a
+warm-up window) is deserialized, not re-built, by every later process —
+the `tools/compile_ladder.py` / bench `.jax_cache` mechanism promoted to a
+first-class framework knob.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import warnings
+import weakref
+
+from . import telemetry
+from .base import getenv, register_env
+
+__all__ = ["CompileCache", "persistent_cache_dir", "stats", "all_caches",
+           "donation_warnings_suppressed", "trace_salt"]
+
+register_env("MXNET_FUSED_STEP", True,
+             "fuse forward+backward+optimizer update into one jitted XLA "
+             "computation per step (0 falls back to the eager per-op path)")
+register_env("MXNET_COMPILE_CACHE_DIR", "",
+             "directory for jax's persistent on-disk XLA compilation cache "
+             "(compile once per program across processes)")
+
+_caches = weakref.WeakSet()
+_caches_lock = threading.Lock()
+
+# Process-unique constant mixed into donated programs' HLO (trace_salt):
+# a donated-buffer executable deserialized from the on-disk cache by a
+# LATER process has broken input-output aliasing on XLA:CPU and corrupts
+# the heap when invoked ('corrupted double-linked list' — reproduced).
+# Salting makes such a program's cache key unique to this process, so no
+# other process can ever deserialize it, independent of jax-version
+# differences in how the persistent cache can be gated.
+import os as _os
+import time as _time
+
+_PROCESS_SALT = float(_os.getpid() * 4096 + (_time.time_ns() % 4096))
+
+
+def trace_salt(x):
+    """Mix the process-unique constant into a traced value without changing
+    it (``x + zeros_like(x) * salt`` — exact for any finite salt). Donated
+    programs call this on one traced argument so their HLO, and thus their
+    persistent-cache key, is unique to this process."""
+    import jax.numpy as jnp
+
+    return x + jnp.zeros_like(x) * _PROCESS_SALT
+
+
+def _persistent_cache_paused():
+    """Context: de-initialize jax's persistent compilation cache so the
+    next compile neither reads nor writes it (config-flag toggles alone do
+    not gate an already-initialized cache in jax 0.4.x). Best-effort — the
+    reset helper is a private jax API; trace_salt is the version-proof
+    backstop."""
+    import contextlib as _ctx
+
+    @_ctx.contextmanager
+    def scope():
+        import jax
+
+        try:
+            from jax._src import compilation_cache as _cc
+        except Exception:  # noqa: BLE001 — private API; salt still protects
+            _cc = None
+        old_dir = jax.config.jax_compilation_cache_dir
+        if _cc is not None and old_dir:
+            jax.config.update("jax_compilation_cache_dir", None)
+            _cc.reset_cache()
+        try:
+            yield
+        finally:
+            if _cc is not None and old_dir:
+                jax.config.update("jax_compilation_cache_dir", old_dir)
+                _cc.reset_cache()
+
+    return scope()
+
+
+@contextlib.contextmanager
+def donation_warnings_suppressed():
+    """jax warns when donated buffers cannot be consumed (the CPU backend
+    ignores donation). The fused paths donate unconditionally — on TPU
+    donation is the point (in-place weight updates), on CPU a harmless
+    no-op — so their call sites wrap invocations in this scope instead of
+    installing a process-global filter that would also silence the signal
+    for a user's own jax code."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def persistent_cache_dir():
+    """Apply ``MXNET_COMPILE_CACHE_DIR`` to jax's persistent compilation
+    cache (idempotent; called at import). Returns the directory or None."""
+    path = getenv("MXNET_COMPILE_CACHE_DIR")
+    if not path:
+        return None
+    try:
+        import os
+
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # small programs compile faster than they deserialize; only big
+        # compiles (the ones that hurt through a flaky relay) are persisted
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        return path
+    except Exception:  # noqa: BLE001 — the on-disk cache is an optimisation
+        return None
+
+
+def _entries_gauge():
+    """Recompute the live-entry gauge over every live cache."""
+    with _caches_lock:
+        total = sum(len(c) for c in _caches)
+    telemetry.gauge("compile.cache_entries").set(total)
+
+
+class CompileCache:
+    """A named map ``key -> compiled callable`` with hit/miss/compile-time
+    accounting. ``key`` is any hashable — by convention the full shape
+    signature (shape+dtype of every input) plus whatever static
+    configuration the builder closes over (train flag, optimizer
+    fingerprint), the CachedOp signature-match model."""
+
+    def __init__(self, name, maxsize=None):
+        self.name = name
+        self.maxsize = maxsize
+        self.hits = 0
+        self.misses = 0
+        self.compile_seconds = 0.0
+        self._entries = {}
+        self._lock = threading.Lock()
+        with _caches_lock:
+            _caches.add(self)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def keys(self):
+        return list(self._entries.keys())
+
+    def get_or_build(self, key, build, persistent=True):
+        """The cached callable for ``key``; on miss, ``build()`` makes one
+        (typically a ``jax.jit`` closure) and its first invocation is timed
+        into ``compile.seconds``.
+
+        ``persistent=False`` keeps this program OUT of jax's on-disk
+        compilation cache: executables with donated (input-aliased) buffers
+        deserialize with broken aliasing on XLA:CPU and corrupt the heap on
+        invocation (reproduced: 'corrupted double-linked list' on the second
+        process reusing MXNET_COMPILE_CACHE_DIR). The fused train-step and
+        fused optimizer-update programs pass False; everything else persists.
+        """
+        fn = self._entries.get(key)
+        if fn is not None:
+            self.hits += 1
+            telemetry.counter("compile.cache_hits").inc()
+            if self.maxsize is not None:
+                # LRU, not FIFO: refresh position so overflow evicts a COLD
+                # entry, never the per-step executable hit every iteration
+                with self._lock:
+                    if key in self._entries:
+                        self._entries[key] = self._entries.pop(key)
+            return fn
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self.hits += 1
+                telemetry.counter("compile.cache_hits").inc()
+                return fn
+            self.misses += 1
+            telemetry.counter("compile.cache_misses").inc()
+            fn = self._wrap_first_call(build(), persistent)
+            if self.maxsize is not None and len(self._entries) >= self.maxsize:
+                # drop the least-recently-used entry — executables are
+                # re-buildable, never precious
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[key] = fn
+        _entries_gauge()
+        return fn
+
+    def _wrap_first_call(self, fn, persistent=True):
+        cache = self
+
+        class _Timed:
+            """First call runs under a timer (trace + XLA compile happen
+            synchronously there), with the jax donation warning suppressed
+            and — for persistent=False programs — the on-disk compilation
+            cache disabled so the executable is neither written nor read
+            (see get_or_build); later calls go straight through."""
+
+            __slots__ = ("_fn", "_first")
+
+            def __init__(self):
+                self._fn = fn
+                self._first = True
+
+            def __call__(self, *args, **kwargs):
+                if self._first:
+                    t0 = time.perf_counter()
+                    with donation_warnings_suppressed():
+                        if persistent:
+                            out = self._fn(*args, **kwargs)
+                        else:
+                            # pause the on-disk cache for this one compile
+                            # (donated executables must never be persisted
+                            # — see get_or_build); compiles are rare and
+                            # the cache is restored immediately
+                            with _persistent_cache_paused():
+                                out = self._fn(*args, **kwargs)
+                    # only now: a FAILED first call must retry with the
+                    # cache pause + accounting intact (another caller can
+                    # hit this shared entry after one caller's trace error)
+                    self._first = False
+                    dt = time.perf_counter() - t0
+                    cache.compile_seconds += dt
+                    telemetry.counter("compile.seconds").inc(dt)
+                    telemetry.histogram("compile.first_call_us").record(dt * 1e6)
+                    return out
+                return self._fn(*args, **kwargs)
+
+        return _Timed()
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+        _entries_gauge()
+
+    def snapshot(self):
+        return {"name": self.name, "entries": len(self._entries),
+                "hits": self.hits, "misses": self.misses,
+                "compile_seconds": self.compile_seconds}
+
+
+def all_caches():
+    """Live :class:`CompileCache` instances."""
+    with _caches_lock:
+        return list(_caches)
+
+
+def stats():
+    """Aggregate {entries, hits, misses, compile_seconds} over live caches
+    plus a per-cache breakdown (`tools/telemetry_report.py` prints this)."""
+    per = [c.snapshot() for c in all_caches()]
+    return {"entries": sum(p["entries"] for p in per),
+            "hits": sum(p["hits"] for p in per),
+            "misses": sum(p["misses"] for p in per),
+            "compile_seconds": sum(p["compile_seconds"] for p in per),
+            "caches": sorted(per, key=lambda p: p["name"])}
+
+
+persistent_cache_dir()

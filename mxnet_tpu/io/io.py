@@ -72,6 +72,44 @@ class DataBatch:
         return f"{type(self).__name__}: data shapes: {dshapes} label shapes: {lshapes}"
 
 
+def pad_arrays(arrays, batch_size):
+    """Pad each array in ``arrays`` along axis 0 up to ``batch_size`` by
+    recycling its rows from the start (wrapping around if the batch is
+    shorter than the pad); returns ``(padded_list, pad)``.
+
+    This is the shape-stability half of the partial-last-batch story: a
+    short final batch padded up to the bound batch size reuses the already
+    compiled executable (one compile-cache entry per bucket), where
+    rebinding/reshaping the executor would recompile every epoch. The
+    consumer (``Module``) slices outputs and metric updates back down by
+    ``pad`` rows, so the padding never leaks into results. Padded rows DO
+    ride through the gradient — the same semantics as `NDArrayIter`'s
+    ``last_batch_handle='pad'`` (reference io.py), which likewise recycles
+    distinct samples into the tail batch and trains on them (recycling,
+    rather than repeating one row, keeps the duplication spread evenly).
+    """
+    import jax.numpy as jnp
+
+    from ..ndarray import NDArray
+
+    out, pad = [], 0
+    for a in arrays:
+        n = a.shape[0]
+        if n >= batch_size:
+            out.append(a)
+            continue
+        if n == 0:
+            raise MXNetError("pad_arrays: cannot pad an empty batch "
+                             "(no rows to recycle)")
+        pad = batch_size - n
+        data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+        reps = -(-pad // n)  # ceil
+        filler = jnp.concatenate([data] * reps, axis=0)[:pad] if reps > 1 \
+            else data[:pad]
+        out.append(NDArray(jnp.concatenate([data, filler], axis=0)))
+    return out, pad
+
+
 class DataIter:
     """Iterator base (reference io.py DataIter)."""
 
@@ -173,6 +211,9 @@ class NDArrayIter(DataIter):
     def reset(self):
         if self.shuffle:
             _np.random.shuffle(self.idx)
+            self._idx_identity = False
+        else:
+            self._idx_identity = True
         if self.last_batch_handle == "roll_over" and \
                 0 < self.cursor < self.num_data:
             self.cursor = self.cursor - self.num_data - self.batch_size
@@ -190,10 +231,17 @@ class NDArrayIter(DataIter):
 
         start = self.cursor
         end = min(start + self.batch_size, self.num_data)
+        identity = getattr(self, "_idx_identity", False)
         out = []
         for k, v in arrays:
             if start >= 0:
-                chunk = v[self.idx[start:end]]
+                if identity:
+                    # unshuffled: a plain slice view — the device transfer
+                    # in nd_array is the only copy (fancy indexing would
+                    # make a host copy first, once per array per batch)
+                    chunk = v[start:end]
+                else:
+                    chunk = v[self.idx[start:end]]
             else:  # roll_over wrapped batch
                 chunk = v[self.idx[start:]] if start < 0 else v[0:0]
                 chunk = _np.concatenate([chunk, v[self.idx[:end]]]) if end > 0 else chunk

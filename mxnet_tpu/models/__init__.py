@@ -8,5 +8,8 @@ exists for.
 """
 from . import transformer
 from .transformer import TransformerLMConfig, TransformerLM
+from . import resnet
+from .resnet import resnet50_symbol
 
-__all__ = ["transformer", "TransformerLMConfig", "TransformerLM"]
+__all__ = ["transformer", "TransformerLMConfig", "TransformerLM",
+           "resnet", "resnet50_symbol"]

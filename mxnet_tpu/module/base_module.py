@@ -97,6 +97,13 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def fused_step(self, data_batch):
+        """Hook: run forward+backward+update as ONE compiled computation.
+        Subclasses that can (Module, when no kvstore/Monitor/custom op needs
+        per-op visibility) return True; the default False tells `fit` to run
+        the eager forward_backward() + update() decomposition."""
+        return False
+
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         self.init_params(initializer=None, arg_params=arg_params,
@@ -217,10 +224,17 @@ class BaseModule:
                 # values, so it doubles as the device sync segment.
                 tele = telemetry._enabled
                 t0 = time.perf_counter() if tele else 0.0
-                self.forward_backward(data_batch)
+                # fused path: fwd+bwd+update as one XLA computation (its
+                # whole cost lands in the fwdbwd segment; update is 0)
+                fused = self.fused_step(data_batch)
+                if not fused:
+                    self.forward_backward(data_batch)
                 t_fb = time.perf_counter() if tele else 0.0
-                self.update()
+                if not fused:
+                    self.update()
                 t_up = time.perf_counter() if tele else 0.0
+                if tele:
+                    telemetry.gauge("step.fused").set(1 if fused else 0)
                 if isinstance(data_batch, list):
                     self.update_metric(eval_metric,
                                        [db.label for db in data_batch],

@@ -170,12 +170,12 @@ class BucketingModule(BaseModule):
 
     # -- compute -------------------------------------------------------------
 
-    def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
+    def _switch_for_batch(self, data_batch):
+        """Switch to the batch's bucket, syncing params from the previous
+        bucket (shared-array semantics)."""
         bucket_key = getattr(data_batch, "bucket_key", None)
         if bucket_key is None:
             bucket_key = self._default_bucket_key
-        # sync current params before switching
         prev = self._curr_module
         self.switch_bucket(bucket_key, data_batch.provide_data,
                            data_batch.provide_label)
@@ -183,7 +183,20 @@ class BucketingModule(BaseModule):
             arg_p, aux_p = prev.get_params()
             self._curr_module.init_params(arg_params=arg_p, aux_params=aux_p,
                                           force_init=True)
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._switch_for_batch(data_batch)
         self._curr_module.forward(data_batch, is_train=is_train)
+
+    def fused_step(self, data_batch):
+        """Fused train step per bucket: each bucket's Module compiles its
+        own fused executable (one compile-cache entry per bucket key — the
+        signature-match model of `cached_op.cc:295`); bucket switching
+        stays a dict lookup."""
+        assert self.binded and self.params_initialized
+        self._switch_for_batch(data_batch)
+        return self._curr_module.fused_step(data_batch)
 
     def backward(self, out_grads=None):
         self._curr_module.backward(out_grads)

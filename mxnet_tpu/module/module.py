@@ -17,7 +17,7 @@ import logging
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
@@ -62,6 +62,13 @@ class Module(BaseModule):
         self._updater = None
         self._preload_opt_states = None
         self._grad_req = "write"
+        # rows of padding applied to the current batch (short last batch
+        # padded up to the bound batch size; outputs/metrics sliced back)
+        self._pad = 0
+        self._pad_bound = 0  # the batch dim the pad filled up to
+        self._last_short_shape = None  # pad-vs-reshape hysteresis
+        self._has_custom_op = None  # memoized graph scan (fused-step gate)
+        self._fused_failed = False  # fused trace failed once — stay eager
 
     # -- properties ----------------------------------------------------------
 
@@ -219,23 +226,65 @@ class Module(BaseModule):
 
     # -- compute -------------------------------------------------------------
 
-    def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        if is_train is None:
-            is_train = self.for_training
+    def _make_feed(self, data_batch):
+        """Build the name→array feed. A short last batch is PADDED up to the
+        bound batch size (recycling rows from the batch start) so the
+        already-compiled executable is reused — one compile-cache entry per
+        bucket instead of a per-epoch recompile; `self._pad` records the
+        rows to slice back off outputs/metrics. Genuine shape changes
+        (bucketing, a larger batch, a persistently smaller batch stream)
+        still rebind via reshape."""
         feed = {}
         for name, arr in zip(self._data_names, data_batch.data):
             feed[name] = arr
         if data_batch.label is not None and self._label_names:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
-        # shape change (last partial batch / bucketing) → rebind cheaply
+        self._pad = 0
         cur = self._exec.arg_dict
-        for name, arr in feed.items():
-            if name in cur and tuple(cur[name].shape) != tuple(arr.shape):
-                self._exec = self._exec.reshape(**{n: tuple(a.shape)
-                                                  for n, a in feed.items()})
-                break
+        mismatched = [n for n, a in feed.items()
+                      if n in cur and tuple(cur[n].shape) != tuple(a.shape)]
+        if not mismatched:
+            self._last_short_shape = None
+            return feed
+        short_shape = tuple(sorted((n, tuple(feed[n].shape))
+                                   for n in mismatched))
+        # 0-row batches reshape; so does inputs_need_grad — input gradients
+        # must come back at the true batch shape, and with cross-row ops
+        # (BatchNorm) padded rows would perturb every row's grad
+        is_short = not self.inputs_need_grad and all(
+            tuple(feed[n].shape[1:]) == tuple(cur[n].shape[1:])
+            and 0 < feed[n].shape[0] < cur[n].shape[0]
+            for n in mismatched)
+        # hysteresis: ONE short batch (the per-epoch tail) pads up to the
+        # bound shape; the SAME short shape arriving twice in a row is a
+        # persistently smaller stream (e.g. predict at a smaller batch
+        # size) — reshape once and run natively instead of paying the
+        # bound-size forward on every batch
+        if is_short and short_shape != getattr(self, "_last_short_shape", None):
+            from ..io.io import pad_arrays
+
+            pads = []
+            for n in mismatched:
+                padded, p = pad_arrays([feed[n]], cur[n].shape[0])
+                feed[n] = padded[0]
+                pads.append(p)
+            self._pad = max(pads)
+            # the CURRENT bound batch dim (the executor may have been
+            # reshaped since bind, so _data_shapes could be stale)
+            self._pad_bound = cur[mismatched[0]].shape[0]
+            self._last_short_shape = short_shape
+        else:
+            self._exec = self._exec.reshape(**{n: tuple(a.shape)
+                                               for n, a in feed.items()})
+            self._last_short_shape = None
+        return feed
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = self._make_feed(data_batch)
         self._exec.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):
@@ -259,15 +308,92 @@ class Module(BaseModule):
                     self._kvstore.pull(name, out=g, priority=-i)
                     self._updater(i, g, w)
         else:
+            # ONE updater call for the whole step: lr/wd lookups batch once
+            # per step, SGD rides the aggregated multi_sgd_* path, and
+            # fused-capable optimizers collapse the loop into a single
+            # jitted program (Updater._fused_call)
+            indices, grads, weights = [], [], []
             for i, name in enumerate(self._param_names):
                 g = self._exec.grad_dict.get(name)
                 if g is None:
                     continue
-                self._updater(i, g, self._exec.arg_dict[name])
+                indices.append(i)
+                grads.append(g)
+                weights.append(self._exec.arg_dict[name])
+            if indices:
+                self._updater(indices, grads, weights)
+
+    # -- fused train step ----------------------------------------------------
+
+    def _fused_step_ready(self):
+        """Whether one jitted fwd+bwd+update computation can replace the
+        eager decomposition for this module. Anything that needs per-op or
+        per-gradient visibility — a kvstore/dist updater, a Monitor, custom
+        (python-callback) ops, input grads, grad_req='add' — falls back to
+        the eager path, which stays the correctness reference."""
+        if self._fused_failed or not getenv("MXNET_FUSED_STEP"):
+            return False
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized and self.for_training):
+            return False
+        if self._kvstore is not None or self._updater is None:
+            return False
+        if not getattr(self._optimizer, "fused_update_supported", False):
+            return False
+        if self._exec._monitor_callback is not None or self.inputs_need_grad:
+            return False
+        if any(self._exec._grad_req.get(n, "null") not in ("write", "null")
+               for n in self._param_names):
+            return False
+        if self._has_custom_op is None:
+            from ..ops import registry as _reg
+            from ..symbol.symbol import _topo_order
+
+            def _needs_eager(node):
+                if node.is_variable:
+                    return False
+                if node.op == "Custom":
+                    return True
+                return bool(getattr(_reg.get_op(node.op), "eager_only", False))
+
+            nodes = _topo_order([n for n, _ in self._symbol._outputs])
+            self._has_custom_op = any(_needs_eager(n) for n in nodes)
+        return not self._has_custom_op
+
+    def fused_step(self, data_batch):
+        """One XLA computation for the whole training step (forward +
+        backward + optimizer update, donated buffers) — `Executor.fused_step`
+        compiled per shape signature. Returns True when taken; False tells
+        the caller (BaseModule.fit) to run forward_backward() + update()."""
+        if not self._fused_step_ready():
+            return False
+        feed = self._make_feed(data_batch)
+        self._exec.set_args(**feed)
+        try:
+            self._exec.fused_step(self._optimizer, self._updater,
+                                  self._param_names)
+        except MXNetError:
+            raise  # donation failure / graph error the eager path shares
+        except Exception as e:
+            # trace/compile failure with buffers intact (Executor.fused_step
+            # already restored the update counts): run this and all later
+            # steps on the eager decomposition
+            self._fused_failed = True
+            self.logger.warning(
+                "fused train step failed to build (%r); falling back to "
+                "the eager forward_backward+update path", e)
+            return False
+        return True
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._exec.outputs
+        outs = self._exec.outputs
+        if self._pad:
+            bound = self._pad_bound
+            keep = bound - self._pad
+            outs = [o[0:keep] if o.ndim and o.shape[0] == bound else o
+                    for o in outs]
+        return outs
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.inputs_need_grad
@@ -276,7 +402,7 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         eval_metric.update_dict(
             dict(zip(self._label_names, labels)),
-            dict(zip(self._output_names, self._exec.outputs)))
+            dict(zip(self._output_names, self.get_outputs())))
 
     # -- checkpoint ----------------------------------------------------------
 

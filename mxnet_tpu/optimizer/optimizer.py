@@ -9,6 +9,18 @@ state used by kvstore servers), get_updater:1712.
 Each optimizer calls the fused update ops (`src/operator/optimizer_op.cc`
 equivalents in `mxnet_tpu/ops/optimizer_ops.py`): one XLA program per
 (op, shape) — weight, grad and state stream through HBM exactly once.
+
+Fused whole-step path: optimizers that define :meth:`Optimizer.fused_update`
+(SGD, NAG, Adam — others fall back to the eager per-op loop automatically)
+expose the update as a *pure function* ``(weights, grads, states, lrs, wds,
+rescale) -> (new_weights, new_states)`` over raw jax arrays. The
+:class:`Updater` jits ONE such program for the entire parameter set
+(donating weight+state buffers so XLA updates them in place), and
+``Module``'s fused train step traces the same function together with
+forward+backward — the whole training step as one XLA computation.
+Hyperparameters that change every step (lr schedules, Adam bias
+correction, rescale_grad) are *traced arguments*, so a changing lr never
+recompiles.
 """
 from __future__ import annotations
 
@@ -20,7 +32,8 @@ import warnings
 
 import numpy
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv
+from ..compile_cache import CompileCache
 from ..ndarray import NDArray, zeros, ones, full
 from .. import ndarray as nd
 
@@ -122,6 +135,42 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    # -- fused (jitted) whole-step update ------------------------------------
+    #
+    # The functional rendering of update_multi_precision over ALL parameters
+    # at once: pure jax math over raw arrays, traceable inside one jitted
+    # train step. Semantics must mirror the eager per-op path exactly (same
+    # fp32 casts, same op order) — the eager loop stays the correctness
+    # reference and tests/python/unittest/test_fused_step.py asserts parity.
+
+    fused_update_supported = False
+
+    def fused_update(self, weights, grads, states, lrs, wds, rescale_grad):
+        """Pure functional update over raw jax arrays.
+
+        ``weights``/``grads`` are lists of arrays; ``states`` the per-weight
+        state trees from :meth:`create_state_multi_precision` with NDArray
+        leaves replaced by arrays; ``lrs``/``wds`` per-weight scalars (traced
+        — any step-dependent correction is already applied by
+        :meth:`_fused_hyperparams`); ``rescale_grad`` a traced scalar.
+        Returns ``(new_weights, new_states)`` with the same structure."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no fused update; the caller must "
+            "check fused_update_supported and fall back to the eager loop")
+
+    def _fused_hyperparams(self, indices):
+        """Per-index (lrs, wds) with any update-count-dependent correction
+        (e.g. Adam bias correction) applied host-side in float64 — exactly
+        the numbers the eager path bakes into its op attrs. Call AFTER
+        :meth:`_update_count`."""
+        return self._get_lrs(indices), self._get_wds(indices)
+
+    def _fused_static_key(self):
+        """Everything trace-relevant that is NOT a traced argument — part of
+        the CompileCache key, so mutating one of these recompiles instead of
+        silently reusing a stale executable."""
+        return (type(self).__name__, self.clip_gradient, self.multi_precision)
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise UserWarning("LRScheduler of the optimizer has already been defined. "
@@ -167,9 +216,15 @@ class Optimizer:
             self.num_update = max(self._index_update_count[idx], self.num_update)
 
     def _get_lrs(self, indices):
-        """Learning rates for indices (parity :437)."""
+        """Learning rates for indices (parity :437). The scheduler is
+        consulted once per num_update value, not once per parameter/chunk —
+        a 160-param step costs one scheduler call, not 160."""
         if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
+            memo = getattr(self, "_lr_sched_memo", None)
+            if memo is None or memo[0] != self.num_update:
+                memo = (self.num_update, self.lr_scheduler(self.num_update))
+                self._lr_sched_memo = memo
+            lr = memo[1]
         else:
             lr = self.lr
 
@@ -383,6 +438,46 @@ class SGD(Optimizer):
         self._update_impl(index, weight, grad, state,
                           multi_precision=use_multi_precision)
 
+    fused_update_supported = True
+
+    def _fused_static_key(self):
+        return super()._fused_static_key() + (self.momentum,)
+
+    def fused_update(self, weights, grads, states, lrs, wds, rescale_grad):
+        """Mirrors sgd_update / sgd_mom_update / mp_sgd_* (optimizer_ops.py)
+        over the whole parameter list: fp32 math, results cast back."""
+        import jax.numpy as jnp
+
+        clip = float(self.clip_gradient) if self.clip_gradient else 0.0
+        mom = float(self.momentum)
+        new_ws, new_ss = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            mp = self.multi_precision and _is_low_precision(w.dtype)
+            if mp:
+                m, w32 = s  # create_state_multi_precision: (mom|None, master)
+            else:
+                m, w32 = s, w.astype(jnp.float32)
+            g32 = g.astype(jnp.float32) * rescale_grad
+            if clip > 0:
+                g32 = jnp.clip(g32, -clip, clip)
+            g32 = g32 + wd * w32
+            # branch on STATE PRESENCE exactly like the eager path's
+            # `if state is not None: sgd_mom_update else sgd_update` — a
+            # momentum later set to 0 still updates the existing state
+            # (with mom==0), never nulls it
+            if m is not None:
+                new_m = mom * (m if mp else m.astype(jnp.float32)) - lr * g32
+                new_w32 = w32 + new_m
+            else:
+                new_m = None
+                new_w32 = w32 - lr * g32
+            new_ws.append(new_w32.astype(w.dtype))
+            if mp:
+                new_ss.append((new_m, new_w32))
+            else:
+                new_ss.append(None if new_m is None else new_m.astype(m.dtype))
+        return new_ws, new_ss
+
 
 @register
 class Signum(Optimizer):
@@ -540,6 +635,44 @@ class NAG(Optimizer):
         self._update_impl(index, weight, grad, state,
                           multi_precision=use_multi_precision)
 
+    fused_update_supported = True
+
+    def _fused_static_key(self):
+        return super()._fused_static_key() + (self.momentum,)
+
+    def fused_update(self, weights, grads, states, lrs, wds, rescale_grad):
+        """Mirrors nag_mom_update / mp_nag_mom_update / sgd_update."""
+        import jax.numpy as jnp
+
+        clip = float(self.clip_gradient) if self.clip_gradient else 0.0
+        mom = float(self.momentum)
+        new_ws, new_ss = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            # NAG's eager mp check is fp16-only (parity :1031)
+            mp = self.multi_precision and numpy.dtype(w.dtype) == numpy.float16
+            if mp:
+                m, w32 = s
+            else:
+                m, w32 = s, w.astype(jnp.float32)
+            g32 = g.astype(jnp.float32) * rescale_grad
+            if clip > 0:
+                g32 = jnp.clip(g32, -clip, clip)
+            g32 = g32 + wd * w32
+            # state presence decides the branch (eager: `if state is not
+            # None: nag_mom_update`), so a zeroed momentum keeps its state
+            if m is not None:
+                new_m = mom * (m if mp else m.astype(jnp.float32)) + g32
+                new_w32 = w32 - lr * (g32 + mom * new_m)
+            else:
+                new_m = None
+                new_w32 = w32 - lr * g32
+            new_ws.append(new_w32.astype(w.dtype))
+            if mp:
+                new_ss.append((new_m, new_w32))
+            else:
+                new_ss.append(None if new_m is None else new_m.astype(m.dtype))
+        return new_ws, new_ss
+
 
 @register
 class SGLD(Optimizer):
@@ -606,6 +739,53 @@ class Adam(Optimizer):
         mean, var = state
         nd.adam_update(weight, grad, mean, var, out=weight,
                        lazy_update=self.lazy_update, **kwargs)
+
+    fused_update_supported = True
+
+    def _fused_static_key(self):
+        return super()._fused_static_key() + (self.beta1, self.beta2,
+                                              self.epsilon)
+
+    def _fused_hyperparams(self, indices):
+        """Bias correction applied host-side in float64 — bit-identical to
+        the lr the eager update() bakes into adam_update's attrs."""
+        lrs, wds = super()._fused_hyperparams(indices)
+        out = []
+        for lr, index in zip(lrs, indices):
+            t = self._index_update_count[index]
+            coef1 = 1. - self.beta1 ** t
+            coef2 = 1. - self.beta2 ** t
+            out.append(lr * math.sqrt(coef2) / coef1)
+        return out, wds
+
+    def fused_update(self, weights, grads, states, lrs, wds, rescale_grad):
+        """Mirrors adam_update (optimizer_ops.py) with the base-class
+        multi-precision convention: state = (master, (mean, var))."""
+        import jax.numpy as jnp
+
+        clip = float(self.clip_gradient) if self.clip_gradient else 0.0
+        b1, b2, eps = float(self.beta1), float(self.beta2), float(self.epsilon)
+        new_ws, new_ss = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            mp = self.multi_precision and _is_low_precision(w.dtype)
+            if mp:
+                w32, (mean, var) = s
+            else:
+                w32, (mean, var) = w.astype(jnp.float32), s
+            g32 = g.astype(jnp.float32) * rescale_grad
+            if clip > 0:
+                g32 = jnp.clip(g32, -clip, clip)
+            g32 = g32 + wd * w32
+            new_mean = b1 * mean.astype(jnp.float32) + (1 - b1) * g32
+            new_var = b2 * var.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
+            new_w32 = w32 - lr * new_mean / (jnp.sqrt(new_var) + eps)
+            new_ws.append(new_w32.astype(w.dtype))
+            if mp:
+                new_ss.append((new_w32, (new_mean, new_var)))
+            else:
+                new_ss.append((new_mean.astype(mean.dtype),
+                               new_var.astype(var.dtype)))
+        return new_ws, new_ss
 
 
 @register
@@ -961,6 +1141,86 @@ class Test(Optimizer):
 create = Optimizer.create_optimizer
 
 
+def _state_sig(s):
+    """Hashable shape/dtype signature of one state tree (CompileCache key).
+    Built every step — dtype objects, not strings."""
+    if s is None:
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(_state_sig(x) for x in s)
+    return (s._data.shape, s._data.dtype)
+
+
+def _state_to_jax(s):
+    """NDArray leaves -> raw jax arrays (same structure)."""
+    if s is None:
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(_state_to_jax(x) for x in s)
+    return s._data
+
+
+def _state_writeback(s, new):
+    """Swap each NDArray leaf's buffer for the corresponding new array —
+    the functional rendering of the reference's in-place state mutation.
+    A None in ``new`` against a live leaf means the update did not touch
+    that state — keep the old buffer, never null a live NDArray."""
+    if s is None or new is None:
+        return
+    if isinstance(s, (tuple, list)):
+        for a, b in zip(s, new):
+            _state_writeback(a, b)
+    else:
+        s._data = new
+
+
+def _snapshot_counts(opt, indices):
+    """Snapshot update-count bookkeeping so a fused step that fails BEFORE
+    executing (trace/compile error — buffers untouched) can fall back to
+    the eager loop without double-counting the step."""
+    return (opt.num_update,
+            {i: opt._index_update_count.get(i) for i in indices})
+
+
+def _restore_counts(opt, snap):
+    num_update, counts = snap
+    for i, v in counts.items():
+        if v is None:
+            opt._index_update_count.pop(i, None)
+        else:
+            opt._index_update_count[i] = v
+    opt.num_update = num_update
+
+
+def _any_donated_deleted(arrays):
+    """True when any donated input buffer was actually consumed — the line
+    between 'retry eagerly' (trace/compile failed, weights intact) and
+    'weights are gone, restore from checkpoint'."""
+    out = False
+    for a in arrays:
+        try:
+            out = out or a.is_deleted()
+        except Exception:  # noqa: BLE001 — conservative: treat as deleted
+            out = True
+    return out
+
+
+# one executable per (optimizer fingerprint, weight shapes/dtypes, state
+# structure) — shared across Updater instances (gluon Trainer keeps one
+# Updater per context; all hit the same cache). Bounded: each entry's build
+# closure pins its Optimizer instance, so a long-lived process cycling
+# through many Trainers must not accumulate them forever (oldest out)
+_fused_updater_cache = None
+
+
+def _updater_cache():
+    global _fused_updater_cache
+    if _fused_updater_cache is None:
+        _fused_updater_cache = CompileCache("optimizer.fused_update",
+                                            maxsize=64)
+    return _fused_updater_cache
+
+
 class Updater:
     """Updater for kvstore (parity optimizer.py:1621): holds per-key states,
     serializable so a kvstore server process can resume it."""
@@ -970,6 +1230,23 @@ class Updater:
         self.states = {}
         self.states_synced = {}
         self.aggregate_updates = optimizer.aggregate_num > 0
+        # set after a fused trace/compile failure: stop re-paying the
+        # failed trace every step and stay on the eager loop
+        self._fused_disabled = False
+
+    def ensure_states(self, indices, weights):
+        """Create (or context-sync) the optimizer state for each index —
+        the lazy-creation half of ``__call__``, callable on its own by the
+        fused train step (which needs the states before tracing)."""
+        for i, idx in enumerate(indices):
+            if idx not in self.states:
+                self.states[idx] = self.optimizer.create_state_multi_precision(
+                    idx, weights[i])
+                self.states_synced[idx] = True
+            elif not self.states_synced[idx]:
+                self.states[idx] = self.sync_state_context(self.states[idx],
+                                                           weights[i].context)
+                self.states_synced[idx] = True
 
     def __call__(self, index, grad, weight):
         if not isinstance(index, (list, tuple)):
@@ -980,21 +1257,91 @@ class Updater:
             indices = index
             grads = grad
             weights = weight
-        for i, idx in enumerate(indices):
-            if idx not in self.states:
-                self.states[idx] = self.optimizer.create_state_multi_precision(
-                    idx, weights[i])
-                self.states_synced[idx] = True
-            elif not self.states_synced[idx]:
-                self.states[idx] = self.sync_state_context(self.states[idx],
-                                                           weights[i].context)
-                self.states_synced[idx] = True
+        self.ensure_states(indices, weights)
+        if len(indices) > 1 and self._fused_call(indices, grads, weights):
+            return
         if self.aggregate_updates and len(indices) > 1:
             self._aggregated_update(indices, grads, weights)
             return
         for i, idx in enumerate(indices):
             self.optimizer.update_multi_precision(idx, weights[i], grads[i],
                                                   self.states[idx])
+
+    def _fused_call(self, indices, grads, weights):
+        """One jitted Optimizer.fused_update over the whole parameter group
+        with weight and state buffers donated — the entire optimizer step is
+        a single XLA computation instead of one dispatch per (chunk of)
+        parameters. Returns False (caller falls back to the eager loop) for
+        optimizers without a fused path, sparse grads, or MXNET_FUSED_STEP=0
+        — the eager loop remains the correctness reference."""
+        opt = self.optimizer
+        if self._fused_disabled or not opt.fused_update_supported \
+                or not getenv("MXNET_FUSED_STEP"):
+            return False
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if any(isinstance(g, RowSparseNDArray) or isinstance(w, RowSparseNDArray)
+               for g, w in zip(grads, weights)):
+            return False
+
+        import jax
+        import jax.numpy as jnp
+
+        count_snap = _snapshot_counts(opt, indices)
+        opt._update_count(indices)
+        try:
+            lrs, wds = opt._fused_hyperparams(indices)
+            states = [self.states[idx] for idx in indices]
+            key = (opt._fused_static_key(),
+                   tuple((w._data.shape, w._data.dtype) for w in weights),
+                   tuple((g._data.shape, g._data.dtype) for g in grads),
+                   tuple(_state_sig(s) for s in states))
+
+            def build():
+                from ..compile_cache import trace_salt
+
+                def step(ws, gs, ss, lrs_, wds_, rescale):
+                    # salt the HLO: this donated program must never be
+                    # deserialized by another process
+                    # (compile_cache.trace_salt)
+                    return opt.fused_update(ws, gs, ss, lrs_, wds_,
+                                            trace_salt(rescale))
+
+                return jax.jit(step, donate_argnums=(0, 2))
+
+            # persistent=False: donated programs must stay OUT of the
+            # on-disk XLA cache (deserialized aliasing corrupts the heap —
+            # see CompileCache.get_or_build)
+            fn = _updater_cache().get_or_build(key, build, persistent=False)
+            new_ws, new_ss = fn([w._data for w in weights],
+                                [g._data for g in grads],
+                                [_state_to_jax(s) for s in states],
+                                jnp.asarray(lrs, jnp.float32),
+                                jnp.asarray(wds, jnp.float32),
+                                jnp.float32(opt.rescale_grad))
+        except Exception as e:
+            if _any_donated_deleted(w._data for w in weights):
+                # execution consumed donated inputs before failing —
+                # weights/states are unrecoverable in-process
+                raise MXNetError(
+                    "fused optimizer update failed mid-execution; weight/"
+                    "state buffers were donated and may be invalidated — "
+                    "restore from the last checkpoint before continuing "
+                    f"({e!r})") from e
+            # trace/compile failed BEFORE any buffer was consumed (e.g. an
+            # Optimizer subclass whose states the fused path can't unpack):
+            # weights are intact — undo the count bump and stay eager
+            _restore_counts(opt, count_snap)
+            self._fused_disabled = True
+            logging.getLogger("mxnet_tpu.optimizer").warning(
+                "fused update failed to build (%r); falling back to the "
+                "eager per-op update loop", e)
+            return False
+        for w, nw in zip(weights, new_ws):
+            w._data = nw
+        for s, ns in zip(states, new_ss):
+            _state_writeback(s, ns)
+        return True
 
     def _aggregated_update(self, indices, grads, weights):
         """Group same-dtype dense updates into multi_sgd_*-sized chunks

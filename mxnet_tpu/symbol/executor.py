@@ -15,14 +15,13 @@ is_train=True)` so backward never re-runs the forward.
 """
 from __future__ import annotations
 
-import functools
-
 import numpy as _np
 
 import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
+from ..compile_cache import CompileCache
 from ..ops import registry as _reg
 
 __all__ = ["Executor"]
@@ -141,6 +140,11 @@ class Executor:
         self._monitor_callback = None
 
         self._fns = {}
+        # every compiled executable this executor holds, keyed by full shape
+        # signature — shape churn (bucketing, unpadded partial batches) shows
+        # up as compile.cache_misses instead of silently re-specializing.
+        # Bounded: churn that escapes padding caps memory too (oldest out)
+        self._cache = CompileCache("executor", maxsize=64)
 
     # -- helpers -------------------------------------------------------------
 
@@ -171,31 +175,40 @@ class Executor:
             self._fns[train] = fn
         return fn
 
-    @functools.lru_cache(maxsize=4)
-    def _jit_fwd(self, train):
-        return jax.jit(self._fn(train))
+    def _sig(self, args, auxs):
+        """Shape/dtype signature of one bound call — the compile-cache key
+        (the CachedOp signature-match model, `cached_op.cc:295`). Built
+        every call, so it uses hashable dtype objects, not strings."""
+        return (tuple((a.shape, a.dtype) for a in args),
+                tuple((a.shape, a.dtype) for a in auxs))
 
-    @functools.lru_cache(maxsize=4)
-    def _jit_fwd_vjp(self, train):
-        base = self._fn(train)
-        diff = tuple(i for i, n in enumerate(self._arg_names)
-                     if self._grad_req.get(n, "null") != "null")
+    def _jit_fwd(self, train, sig):
+        return self._cache.get_or_build(
+            ("fwd", train, sig), lambda: jax.jit(self._fn(train)))
 
-        def fwd(key, args, auxs):
-            args = list(args)
+    def _jit_fwd_vjp(self, train, sig):
+        def build():
+            base = self._fn(train)
+            diff = tuple(i for i, n in enumerate(self._arg_names)
+                         if self._grad_req.get(n, "null") != "null")
 
-            def f(*darrs):
-                full = list(args)
-                for i, a in zip(diff, darrs):
-                    full[i] = a
-                outputs, aux_new = base(key, tuple(full), auxs)
-                return outputs, aux_new
+            def fwd(key, args, auxs):
+                args = list(args)
 
-            outputs, vjp, aux_new = jax.vjp(
-                f, *[args[i] for i in diff], has_aux=True)
-            return outputs, aux_new, vjp
+                def f(*darrs):
+                    full = list(args)
+                    for i, a in zip(diff, darrs):
+                        full[i] = a
+                    outputs, aux_new = base(key, tuple(full), auxs)
+                    return outputs, aux_new
 
-        return jax.jit(fwd)
+                outputs, vjp, aux_new = jax.vjp(
+                    f, *[args[i] for i in diff], has_aux=True)
+                return outputs, aux_new, vjp
+
+            return jax.jit(fwd)
+
+        return self._cache.get_or_build(("fwd_vjp", train, sig), build)
 
     # -- API -----------------------------------------------------------------
 
@@ -215,8 +228,9 @@ class Executor:
     def output_dict(self):
         return dict(zip(self._symbol.list_outputs(), self.outputs))
 
-    def forward(self, is_train=False, **kwargs):
-        from .. import random as _random
+    def set_args(self, **kwargs):
+        """Write input values into the bound argument buffers (the feed half
+        of ``forward``, shared with the fused train step)."""
         from ..ndarray import NDArray, array as nd_array
 
         for k, v in kwargs.items():
@@ -226,15 +240,22 @@ class Executor:
             src = v if isinstance(v, NDArray) else nd_array(v)
             tgt._data = jnp.asarray(src._data, tgt.dtype)
 
+    def forward(self, is_train=False, **kwargs):
+        from .. import random as _random
+        from ..ndarray import NDArray
+
+        self.set_args(**kwargs)
+
         key = _random.next_key()
         args = tuple(self.arg_dict[n]._data for n in self._arg_names)
         auxs = tuple(self.aux_dict[n]._data for n in self._aux_names)
 
+        sig = self._sig(args, auxs)
         if is_train and any(r != "null" for r in self._grad_req.values()):
-            outputs, aux_new, vjp = self._jit_fwd_vjp(True)(key, args, auxs)
+            outputs, aux_new, vjp = self._jit_fwd_vjp(True, sig)(key, args, auxs)
             self._vjp = vjp
         else:
-            outputs, aux_new = self._jit_fwd(bool(is_train))(key, args, auxs)
+            outputs, aux_new = self._jit_fwd(bool(is_train), sig)(key, args, auxs)
             self._vjp = None
 
         if is_train:
@@ -271,6 +292,133 @@ class Executor:
                 tgt._data = g.astype(tgt.dtype)
             elif req == "add":
                 tgt._data = tgt._data + g.astype(tgt.dtype)
+
+    def fused_step(self, optimizer, updater, param_names):
+        """ONE training step — forward, backward (ones cotangents, the
+        `backward(out_grads=None)` convention), gradient rescale/clip and
+        the optimizer update for every parameter — as a single jitted XLA
+        computation, with weight, optimizer-state and aux buffers donated
+        so XLA updates them in place.
+
+        This is the bulking limit the engine exists to approach (SURVEY L2):
+        the eager path crosses the dispatch boundary once per forward, once
+        per backward and ~once per parameter chunk in the update loop; here
+        the whole step is one dispatch. The eager path remains the
+        correctness reference (test_fused_step.py asserts parity).
+
+        ``param_names`` must be the module's parameter list — updater state
+        keys are positions in it, matching the eager ``Module.update``
+        indexing. Returns the step outputs (also stored in ``self.outputs``).
+
+        Gradients are consumed INSIDE the computation and never
+        materialized: ``grad_dict`` is NOT updated by this path (reading it
+        after a fused step sees the previous eager step's values, or the
+        zeros from bind). Code that needs per-step gradients — Monitor,
+        input grads, custom gradient manipulation — must run the eager
+        decomposition (``Module._fused_step_ready`` gates the common cases).
+        """
+        from .. import random as _random
+        from ..ndarray import NDArray
+        from ..optimizer.optimizer import (_any_donated_deleted,
+                                           _restore_counts, _snapshot_counts,
+                                           _state_sig, _state_to_jax,
+                                           _state_writeback)
+
+        upd = [(i, n) for i, n in enumerate(param_names)
+               if self._grad_req.get(n, "null") != "null"]
+        indices = [i for i, _ in upd]
+        names = [n for _, n in upd]
+        name_set = set(names)
+        weights = [self.arg_dict[n] for n in names]
+        updater.ensure_states(indices, weights)
+        count_snap = _snapshot_counts(optimizer, indices)
+        optimizer._update_count(indices)
+        lrs, wds = optimizer._fused_hyperparams(indices)
+        states = [updater.states[i] for i in indices]
+
+        key = _random.next_key()
+        params = tuple(self.arg_dict[n]._data for n in names)
+        other_names = [n for n in self._arg_names if n not in name_set]
+        others = tuple(self.arg_dict[n]._data for n in other_names)
+        auxs = tuple(self.aux_dict[n]._data for n in self._aux_names)
+
+        sig = (tuple(names),
+               tuple((a.shape, a.dtype) for a in params),
+               tuple((a.shape, a.dtype) for a in others),
+               tuple((a.shape, a.dtype) for a in auxs),
+               tuple(_state_sig(s) for s in states),
+               optimizer._fused_static_key())
+
+        def build():
+            base = self._fn(True)
+            arg_pos = {n: i for i, n in enumerate(self._arg_names)}
+            param_pos = [arg_pos[n] for n in names]
+            other_pos = [arg_pos[n] for n in other_names]
+            opt = optimizer
+            n_args = len(self._arg_names)
+
+            def step(key, params, others, auxs, states, lrs_, wds_, rescale):
+                from ..compile_cache import trace_salt
+
+                # salt the HLO: this donated program must never be
+                # deserialized by another process (compile_cache.trace_salt)
+                rescale = trace_salt(rescale)
+
+                def f(*ps):
+                    full = [None] * n_args
+                    for p, i in zip(ps, param_pos):
+                        full[i] = p
+                    for o, i in zip(others, other_pos):
+                        full[i] = o
+                    return base(key, tuple(full), auxs)
+
+                outputs, vjp, aux_new = jax.vjp(f, *params, has_aux=True)
+                cts = tuple(jnp.ones(o.shape, o.dtype) for o in outputs)
+                grads = vjp(cts)
+                new_ws, new_ss = opt.fused_update(
+                    list(params), list(grads), states, lrs_, wds_, rescale)
+                return outputs, tuple(new_ws), new_ss, aux_new
+
+            return jax.jit(step, donate_argnums=(1, 3, 4))
+
+        # persistent=False: donated programs must stay OUT of the on-disk
+        # XLA cache (deserialized aliasing corrupts the heap — see
+        # CompileCache.get_or_build)
+        fn = self._cache.get_or_build(("fused_step", sig), build,
+                                      persistent=False)
+        try:
+            outputs, new_ws, new_ss, aux_new = fn(
+                key, params, others, auxs,
+                [_state_to_jax(s) for s in states],
+                jnp.asarray(lrs, jnp.float32),
+                jnp.asarray(wds, jnp.float32),
+                jnp.float32(optimizer.rescale_grad))
+        except Exception as e:
+            if _any_donated_deleted(w._data for w in weights):
+                # donated inputs were consumed before execution failed —
+                # the bound weights/states are unrecoverable in-process;
+                # say so instead of a later "Array deleted" crash
+                raise MXNetError(
+                    "fused train step failed mid-execution; weight/"
+                    "optimizer-state buffers were donated and may be "
+                    "invalidated — restore from the last checkpoint before "
+                    f"continuing ({e!r})") from e
+            # trace/compile failed BEFORE any buffer was consumed: weights
+            # are intact — undo the count bump so the caller's eager
+            # fallback doesn't double-count the step, and let the original
+            # error through (Module.fused_step turns it into a fallback)
+            _restore_counts(optimizer, count_snap)
+            raise
+
+        for n, w in zip(names, new_ws):
+            self.arg_dict[n]._data = w
+        for s, ns in zip(states, new_ss):
+            _state_writeback(s, ns)
+        for n, a in zip(self._aux_names, aux_new):
+            self.aux_dict[n]._data = a
+        self._vjp = None  # grads were consumed inside the step
+        self.outputs = [NDArray(o) for o in outputs]
+        return self.outputs
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
@@ -312,8 +460,12 @@ class Executor:
                 auxs[n] = zeros(s, dtype=cur.dtype)
             else:
                 auxs[n] = cur
-        return Executor(self._symbol, self._ctx, args=args,
-                        grad_req=self._grad_req, aux_states=auxs)
+        new = Executor(self._symbol, self._ctx, args=args,
+                       grad_req=self._grad_req, aux_states=auxs)
+        # an installed monitor must survive the rebind (it also gates the
+        # fused-step fallback in Module._fused_step_ready)
+        new._monitor_callback = self._monitor_callback
+        return new
 
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install a per-output monitor (reference

@@ -19,7 +19,15 @@ reports into one registry:
 * ``step.*``     — per-training-step breakdown (data / forward-backward /
   update / sync) recorded by ``BaseModule.fit`` and surfaced through
   ``BatchEndParam.step_stats`` so ``Speedometer`` logs p50/p99 step latency
-  alongside samples/sec.
+  alongside samples/sec; the ``step.fused`` gauge is 1 while training runs
+  the fused single-XLA-computation path and 0 on the eager fallback;
+* ``compile.*`` — the :mod:`mxnet_tpu.compile_cache` plane:
+  ``compile.cache_hits`` / ``compile.cache_misses`` counters,
+  ``compile.seconds`` (cumulative first-call/compile time),
+  ``compile.cache_entries`` gauge (live executables across all caches) and
+  the derived ``compile.cache_hit_ratio``. Unlike the rest of the registry
+  these are recorded unconditionally — recompile churn must be visible
+  even when the wider telemetry plane is off.
 
 Metric kinds: :class:`Counter` (monotonic), :class:`Gauge` (set/inc/dec),
 :class:`Histogram` (exact count/sum/min/max + a bounded reservoir for
@@ -299,6 +307,12 @@ def snapshot():
     compute = out["counters"].get("io.prefetch_compute_us_total", 0.0)
     if wait + compute > 0:
         out["derived"]["io.starvation_ratio"] = wait / (wait + compute)
+    hits = out["counters"].get("compile.cache_hits", 0)
+    misses = out["counters"].get("compile.cache_misses", 0)
+    if hits + misses > 0:
+        # low ratio at steady state = recompile churn (docs/faq/perf.md
+        # "Reading compile-cache telemetry")
+        out["derived"]["compile.cache_hit_ratio"] = hits / (hits + misses)
     return out
 
 

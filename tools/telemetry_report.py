@@ -39,6 +39,19 @@ def main(argv=None):
     from mxnet_tpu import telemetry
 
     sys.stdout.write(telemetry.dumps_table(snap, sort_by=args.sort_by))
+    counters = snap.get("counters", {})
+    hits = counters.get("compile.cache_hits", 0)
+    misses = counters.get("compile.cache_misses", 0)
+    if hits or misses:
+        secs = counters.get("compile.seconds", 0.0)
+        ratio = snap.get("derived", {}).get("compile.cache_hit_ratio")
+        line = (f"\ncompile cache: {misses} programs compiled "
+                f"({secs:.1f}s total), {hits} cache hits")
+        if ratio is not None:
+            line += f", hit ratio {ratio:.3f}"
+        line += ("\n  (a hit ratio well below 1 at steady state means "
+                 "recompile churn — docs/faq/perf.md)\n")
+        sys.stdout.write(line)
     ts = snap.get("ts")
     if ts is not None:
         import datetime
