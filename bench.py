@@ -38,7 +38,11 @@ Four measurements per run (round-3 verdict order #4):
 Env knobs:
   BENCH_FORCE_CPU=1   skip the TPU probe, run the CPU smoke path
   BENCH_ITERS=N       override timed iteration count
-  BENCH_PROBE_TIMEOUT=S  backend-probe subprocess timeout (default 900)
+  MXNET_TPU_PROBE_TIMEOUT_S=S  backend-probe subprocess timeout (default
+      120 — BENCH_r05 recorded a 900 s hang before the probe gave up; a
+      hung probe now costs seconds, not 15 minutes). The probe result is
+      cached per process, so repeated probes are free. BENCH_PROBE_TIMEOUT
+      (the old name) still wins when set.
 """
 import json
 import os
@@ -134,6 +138,23 @@ def _emit(payload):
     sys.stdout.flush()
 
 
+# memoized (backend, error) — a probe verdict holds for the process
+# lifetime, so a second caller (retry loops, library use of bench helpers)
+# must not re-pay the subprocess, and above all must not re-pay a TIMEOUT:
+# BENCH_r05 recorded "backend probe hung (> 900s)" burning 15 minutes
+_probe_cache = None
+
+
+def _probe_timeout_s():
+    """Probe timeout in seconds. `MXNET_TPU_PROBE_TIMEOUT_S` (default 120)
+    bounds the damage of a wedged TPU backend; the legacy
+    `BENCH_PROBE_TIMEOUT` name still wins when explicitly set."""
+    legacy = os.environ.get("BENCH_PROBE_TIMEOUT")
+    if legacy:
+        return int(legacy)
+    return int(os.environ.get("MXNET_TPU_PROBE_TIMEOUT_S", "120"))
+
+
 def _probe_backend():
     """Initialise the backend defensively. Returns (backend_name, error_str).
 
@@ -142,26 +163,37 @@ def _probe_backend():
     raise, and the bench must still emit a number. The probe includes a
     device_get so a tunnel that dispatches but cannot round-trip values is
     detected here rather than mid-measurement. Only after the probe passes
-    is the backend initialised in this process."""
+    is the backend initialised in this process. The verdict is cached per
+    process (`_probe_cache`)."""
     import subprocess
+
+    global _probe_cache
+    if _probe_cache is not None:
+        return _probe_cache
+
+    def _cache(backend, err):
+        global _probe_cache
+        _probe_cache = (backend, err)
+        return _probe_cache
 
     if not _FORCE_CPU:
         probe = ("import jax, jax.numpy as jnp; "
                  "v = jax.device_get(jnp.ones((8,8)) @ jnp.ones((8,8))); "
                  "assert float(v[0,0]) == 8.0; "
                  "print('BACKEND=' + jax.default_backend())")
-        timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "900"))
+        timeout_s = _probe_timeout_s()
         try:
             out = subprocess.run([sys.executable, "-c", probe],
                                  capture_output=True, text=True,
                                  timeout=timeout_s)
             if out.returncode != 0:
                 tail = out.stderr.strip().splitlines()[-1] if out.stderr.strip() else "?"
-                return None, f"backend probe failed: {tail}"
+                return _cache(None, f"backend probe failed: {tail}")
         except subprocess.TimeoutExpired:
-            return None, f"backend probe hung (> {timeout_s}s)"
+            return _cache(None, f"backend probe hung (> {timeout_s}s)")
         except Exception:  # noqa: BLE001
-            return None, traceback.format_exc(limit=2).strip().splitlines()[-1]
+            return _cache(
+                None, traceback.format_exc(limit=2).strip().splitlines()[-1])
 
     import jax
 
@@ -170,10 +202,10 @@ def _probe_backend():
         import jax.numpy as jnp
 
         jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
-        return backend, None
+        return _cache(backend, None)
     except Exception:  # noqa: BLE001 — any backend failure falls back
         err = traceback.format_exc(limit=3).strip().splitlines()[-1]
-        return None, err
+        return _cache(None, err)
 
 
 def _reexec_cpu(err):

@@ -42,7 +42,8 @@ unit() {
   python -m pytest tests/python/unittest -q -x \
       --ignore=tests/python/unittest/test_resilience.py \
       --ignore=tests/python/unittest/test_telemetry.py \
-      --ignore=tests/python/unittest/test_fused_step.py
+      --ignore=tests/python/unittest/test_fused_step.py \
+      --ignore=tests/python/unittest/test_grad_sync.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
   # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
@@ -60,6 +61,11 @@ unit() {
   # fusion or cache-accounting regression fails HERE with clean attribution
   log "fused train step suite (fused-vs-eager parity, donation, compile-cache accounting)"
   python -m pytest tests/python/unittest/test_fused_step.py -q
+  # grad-sync gate, standalone: these tests flip MXNET_GRAD_BUCKETING /
+  # MXNET_UPDATE_ON_KVSTORE and assert exact telemetry collective counts,
+  # so a bucketing or sync-scheduling regression fails HERE, attributed
+  log "grad-sync suite (bucketed-vs-per-key parity, collective counts, overlap telemetry)"
+  python -m pytest tests/python/unittest/test_grad_sync.py -q
 }
 
 train() {
@@ -76,6 +82,25 @@ entrypoints() {
   log "driver entrypoints: single-chip compile check + 8-device dryrun"
   env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python __graft_entry__.py
+  log "grad-sync bucketing smoke (8 virtual devices, measure.py --bucket-mb)"
+  # bucketing regressions fail fast without TPUs: the sweep must complete
+  # with an EXACT reduction (error==0 asserted by the harness json) and
+  # the small tier must collapse to O(#buckets) collectives
+  env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      timeout 600 python tools/bandwidth/measure.py \
+      --network resnet18_v1 --image-shape 3,32,32 --ndev 8 \
+      --kv-store device --num-batches 2 --tiers 1 --bucket-mb 0,1 \
+      --json-out /tmp/ci_grad_sync_bw.jsonl
+  python - <<'PY'
+import json
+rec = json.loads(open("/tmp/ci_grad_sync_bw.jsonl").read().strip().splitlines()[-1])
+sweep = rec["bucket_sweep"]["small_lt_256KB"]
+assert sweep["per_key"]["error"] == 0.0 and sweep["1MB"]["error"] == 0.0, sweep
+assert sweep["1MB"]["buckets"] < sweep["per_key"]["buckets"], sweep
+print("grad-sync smoke OK:", {k: v["buckets"] for k, v in sweep.items()})
+PY
+  rm -f /tmp/ci_grad_sync_bw.jsonl
+
   log "bench smoke (CPU, reduced steps)"
   # fresh compile cache: XLA:CPU AOT entries are machine-feature-pinned,
   # and a cache written on another host can SIGILL here

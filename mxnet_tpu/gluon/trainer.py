@@ -91,6 +91,7 @@ class Trainer:
         self._kvstore = None
         self._distributed = None
         self._update_on_kvstore = None
+        self._grad_sync = None  # bucketed sync scheduler (lazy, per store)
         self._params_to_init = [param for param in self._params]
 
     def _init_kvstore(self):
@@ -190,8 +191,21 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
+        """Bucketed by default (`parallel/grad_sync.py`): dense grads ride
+        O(#buckets) flat collectives — issued asynchronously in gradient
+        readiness order, drained in priority order — instead of one
+        push(+pull) per parameter. `MXNET_GRAD_BUCKETING=0` restores the
+        per-key reference loop."""
         if not self._kvstore:
             return
+        from ..parallel import grad_sync as _gs
+
+        # compressed stores keep the per-key push (quantization + error
+        # feedback live inside push); grouped update_on_kvstore pushes
+        # still compress per key, so only the flat-allreduce path gates
+        bucketed = _gs.bucketing_enabled() and (
+            self._update_on_kvstore or _gs.sync_compatible(self._kvstore))
+        dense = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -200,10 +214,26 @@ class Trainer:
                 # would densify the table): merge sparse pieces directly
                 self._allreduce_sparse_grads(i, param)
                 continue
+            if bucketed:
+                dense.append((i, param.list_grad()))
+                continue
             self._kvstore.push(i, param.list_grad(), priority=-i)
             if not self._update_on_kvstore:
                 self._kvstore.pull(i, param.list_grad(), priority=-i,
                                    ignore_sparse=self._distributed)
+        if dense:
+            grads = [g for _, g in dense]
+            prios = [-i for i, _ in dense]
+            if self._update_on_kvstore:
+                # optimizer lives on the store: one grouped push (the store
+                # buckets the keys), weights come back in `_update`'s pull
+                self._kvstore.push([i for i, _ in dense], grads,
+                                   priority=prios)
+            else:
+                if self._grad_sync is None:
+                    self._grad_sync = _gs.GradSync(self._kvstore)
+                self._grad_sync.configure_from(grads, priorities=prios)
+                self._grad_sync.sync(grads)
 
     def _allreduce_sparse_grads(self, i, param):
         """Aggregate row_sparse grads across device replicas (and worker

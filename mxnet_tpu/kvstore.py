@@ -106,6 +106,31 @@ class KVStoreBase:
     def _barrier(self):
         pass
 
+    # -- gradient-sync bucket primitive (parallel/grad_sync.py) --------------
+
+    def allreduce_flat(self, value, priority=0):
+        """Sum one flat gradient bucket across device replicas and worker
+        processes WITHOUT touching the store or the updater — the
+        collective behind `GradSync` (one call per bucket instead of one
+        push+pull per key). ``value`` is an NDArray or a list of per-device
+        NDArrays; returns the reduced NDArray (dispatch is async — callers
+        block via `GradSync.drain`)."""
+        raise NotImplementedError
+
+    @property
+    def fused_step_compatible(self):
+        """Whether `Module.fused_step` may trace this store's gradient sync
+        into the jitted train step instead of falling back to eager (see
+        `fused_grad_sync_fn`)."""
+        return False
+
+    def fused_grad_sync_fn(self, entries):
+        """A traceable ``grads_tuple -> grads_tuple`` cross-replica
+        gradient sync for `Executor.fused_step`, or None when the sync is
+        the identity (nothing to trace). ``entries`` =
+        [(shape, dtype, priority), ...] aligned with the traced grads."""
+        return None
+
 
 class KVStoreLocal(KVStoreBase):
     """Single-process multi-device store (parity `kvstore_local.h:69`)."""
@@ -129,6 +154,12 @@ class KVStoreLocal(KVStoreBase):
         if isinstance(key, (str, int)):
             key = [key]
             value = [value]
+        # grouped calls must align exactly — a silent zip truncation would
+        # drop the tail keys of a bucketed push without any error (a real
+        # error, not an assert: `python -O` would strip the check)
+        if len(key) != len(value):
+            raise MXNetError(
+                f"grouped call: {len(key)} keys but {len(value)} values")
         out = []
         for k, v in zip(key, value):
             if isinstance(v, NDArray):
@@ -195,6 +226,24 @@ class KVStoreLocal(KVStoreBase):
         """Fused push+pull (allreduce semantics)."""
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
+
+    def allreduce_flat(self, value, priority=0):
+        """One bucket collective: reduce the per-device flat buffers with
+        the same XLA `add_n` the per-key path uses — but across the whole
+        bucket at once (`comm.h:451`'s role, one program per bucket)."""
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        vals = [v if isinstance(v, NDArray) else NDArray(v) for v in vals]
+        if telemetry._enabled:
+            telemetry.counter("kvstore.bucket_collectives").inc()
+            telemetry.counter("kvstore.bucket_bytes").inc(_nd_nbytes(vals[0]))
+        return _ctx_group_sum(vals)
+
+    @property
+    def fused_step_compatible(self):
+        # the module's single-executor grads have no device replicas to
+        # reduce — the sync is the identity. Gradient compression needs the
+        # eager quantize/dequantize per push, so it keeps the eager path.
+        return not self._gc.active
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only selected rows (reference PullRowSparseImpl

@@ -58,29 +58,62 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
-    """Push grads, pull updated weights (parity model.py:150)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        name = param_names[index]
+    """Push grads, pull updated weights (parity model.py:150).
+
+    Bucketed by default: ONE grouped push + ONE grouped pull for the whole
+    parameter set — the store fuses the keys of a grouped call into flat
+    per-dtype buckets (O(#buckets) collectives, `dist._push_dense`) instead
+    of dispatching one collective per key. `MXNET_GRAD_BUCKETING=0`
+    restores the per-key reference loop."""
+    from .parallel import grad_sync as _gs
+
+    live = [(i, param_names[i], arg_list, grad_list)
+            for i, (arg_list, grad_list)
+            in enumerate(zip(param_arrays, grad_arrays))
+            if grad_list[0] is not None]
+    if not live:
+        return
+    if _gs.bucketing_enabled():
+        names = [n for _, n, _, _ in live]
+        prios = [-i for i, _, _, _ in live]
+        kvstore.push(names, [g for _, _, _, g in live], priority=prios)
+        kvstore.pull(names, [a for _, _, a, _ in live], priority=prios)
+        return
+    for index, name, arg_list, grad_list in live:
         kvstore.push(name, grad_list, priority=-index)
         kvstore.pull(name, arg_list, priority=-index)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
                    param_names=None):
-    """Local updater path (parity model.py:162)."""
+    """Local updater path (parity model.py:162). The kvstore gradient
+    allreduce rides the bucketed `GradSync` scheduler (overlapped
+    per-bucket collectives) unless `MXNET_GRAD_BUCKETING=0`."""
+    live = [i for i, (_, grad_list)
+            in enumerate(zip(param_arrays, grad_arrays))
+            if grad_list[0] is not None]
+    if kvstore and live:
+        from .parallel import grad_sync as _gs
+
+        if _gs.bucketing_enabled() and _gs.sync_compatible(kvstore):
+            grads = [grad_arrays[i] for i in live]
+            # scheduler cached ON the store: this helper is stateless but
+            # the bucket plan / persistent flat buffers must survive steps
+            sched = getattr(kvstore, "_grad_sync_sched", None)
+            if sched is None:
+                sched = _gs.GradSync(kvstore)
+                kvstore._grad_sync_sched = sched
+            sched.configure_from(grads, priorities=[-i for i in live])
+            sched.sync(grads)
+        else:
+            for index in live:
+                kvstore.push(param_names[index], grad_arrays[index],
+                             priority=-index)
+                kvstore.pull(param_names[index], grad_arrays[index],
+                             priority=-index)
     updates = [[] for _ in range(num_device)]
-    for i, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        index = i
-        if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
+    for index in live:
+        arg_list, grad_list = param_arrays[index], grad_arrays[index]
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updates[k].append((index * num_device + k, g, w))

@@ -198,7 +198,7 @@ class TransformerLM:
         mesh, c = self.mesh, self.cfg
         sp = mesh.shape.get("sp", 1)
         if sp > 1:
-            from jax import shard_map
+            from ..parallel.collectives import shard_map
             spec = P(("dp", "fsdp") if "fsdp" in mesh.shape else "dp", "sp", "tp", None)
             spec = P(*[a if (isinstance(a, tuple) or (a in mesh.shape and mesh.shape[a] > 1)) else None
                        for a in spec])

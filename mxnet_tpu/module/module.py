@@ -69,6 +69,7 @@ class Module(BaseModule):
         self._last_short_shape = None  # pad-vs-reshape hysteresis
         self._has_custom_op = None  # memoized graph scan (fused-step gate)
         self._fused_failed = False  # fused trace failed once — stay eager
+        self._grad_sync = None  # bucketed gradient-sync scheduler (lazy)
 
     # -- properties ----------------------------------------------------------
 
@@ -292,21 +293,57 @@ class Module(BaseModule):
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
-        """Apply gradients (reference module.py:664 → model.py:150/162)."""
+        """Apply gradients (reference module.py:664 → model.py:150/162).
+
+        Gradient sync is BUCKETED by default (`parallel/grad_sync.py`):
+        one grouped kvstore call — O(#buckets) collectives — instead of one
+        push+pull per parameter, and for the allreduce-then-local-update
+        flow the bucket collectives are issued asynchronously so comm
+        overlaps the remaining host work. `MXNET_GRAD_BUCKETING=0` restores
+        the eager per-key loop, the correctness reference."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         if self._kvstore is not None:
-            for i, name in enumerate(self._param_names):
-                if self._exec.grad_dict.get(name) is None:
-                    continue
-                w = self._exec.arg_dict[name]
-                g = self._exec.grad_dict[name]
+            from ..parallel import grad_sync as _gs
+
+            live = [(i, name, self._exec.grad_dict[name],
+                     self._exec.arg_dict[name])
+                    for i, name in enumerate(self._param_names)
+                    if self._exec.grad_dict.get(name) is not None]
+            if not live:
+                return
+            # compressed stores keep the per-key path for the flat-bucket
+            # allreduce (quantization lives inside push, per key); grouped
+            # push/pull (update_on_kvstore) still compresses per key
+            if _gs.bucketing_enabled() and (
+                    self._update_on_kvstore
+                    or _gs.sync_compatible(self._kvstore)):
+                idxs = [i for i, _, _, _ in live]
+                names = [n for _, n, _, _ in live]
+                grads = [g for _, _, g, _ in live]
+                weights = [w for _, _, _, w in live]
+                prios = [-i for i in idxs]
                 if self._update_on_kvstore:
-                    self._kvstore.push(name, g, priority=-i)
-                    self._kvstore.pull(name, out=w, priority=-i)
+                    # grouped push/pull: the store buckets the keys of one
+                    # call (dist `_push_dense`) — collectives O(#buckets)
+                    self._kvstore.push(names, grads, priority=prios)
+                    self._kvstore.pull(names, out=weights, priority=prios)
                 else:
-                    self._kvstore.push(name, g, priority=-i)
-                    self._kvstore.pull(name, out=g, priority=-i)
-                    self._updater(i, g, w)
+                    # pure allreduce: overlapped flat-bucket collectives,
+                    # then ONE aggregated local updater call
+                    if self._grad_sync is None:
+                        self._grad_sync = _gs.GradSync(self._kvstore)
+                    self._grad_sync.configure_from(grads, priorities=prios)
+                    self._grad_sync.sync(grads)
+                    self._updater(idxs, grads, weights)
+            else:
+                for i, name, g, w in live:
+                    if self._update_on_kvstore:
+                        self._kvstore.push(name, g, priority=-i)
+                        self._kvstore.pull(name, out=w, priority=-i)
+                    else:
+                        self._kvstore.push(name, g, priority=-i)
+                        self._kvstore.pull(name, out=g, priority=-i)
+                        self._updater(i, g, w)
         else:
             # ONE updater call for the whole step: lr/wd lookups batch once
             # per step, SGD rides the aggregated multi_sgd_* path, and
@@ -328,16 +365,29 @@ class Module(BaseModule):
     def _fused_step_ready(self):
         """Whether one jitted fwd+bwd+update computation can replace the
         eager decomposition for this module. Anything that needs per-op or
-        per-gradient visibility — a kvstore/dist updater, a Monitor, custom
+        per-gradient visibility — an on-kvstore updater, a Monitor, custom
         (python-callback) ops, input grads, grad_req='add' — falls back to
-        the eager path, which stays the correctness reference."""
+        the eager path, which stays the correctness reference.
+
+        A kvstore is NOT by itself a fallback anymore: with
+        `update_on_kvstore=False` and a store whose gradient sync is
+        traceable (`local`/`device`, and `dist_tpu_sync` in a
+        single-process group — `fused_step_compatible`), the cross-replica
+        sum over the bucketed flat grads is traced INTO the jitted step
+        (`KVStore.fused_grad_sync_fn`), so the fused path keeps its one-
+        dispatch-per-step shape instead of auto-falling back to eager."""
         if self._fused_failed or not getenv("MXNET_FUSED_STEP"):
             return False
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized and self.for_training):
             return False
-        if self._kvstore is not None or self._updater is None:
+        if self._updater is None:
             return False
+        if self._kvstore is not None:
+            if self._update_on_kvstore:
+                return False  # the optimizer lives on the store, per key
+            if not getattr(self._kvstore, "fused_step_compatible", False):
+                return False
         if not getattr(self._optimizer, "fused_update_supported", False):
             return False
         if self._exec._monitor_callback is not None or self.inputs_need_grad:
@@ -369,9 +419,35 @@ class Module(BaseModule):
             return False
         feed = self._make_feed(data_batch)
         self._exec.set_args(**feed)
+        gs_fn, gs_key = None, None
+        if self._kvstore is not None:
+            from ..parallel.grad_sync import bucket_cap_bytes
+
+            # memoized ON the executor (a reshape creates a fresh executor
+            # with no memo, so a recycled id() can never resurrect a stale
+            # layout): the sync closure is layout-invariant per executor,
+            # and rebuilding entries + bucket plan every step would be
+            # pure host overhead on the hot path. id(self._kvstore) is
+            # stable while self._kvstore holds the reference.
+            memo_key = (id(self._kvstore), bucket_cap_bytes())
+            cached = getattr(self._exec, "_fused_gsync_memo", None)
+            if cached is not None and cached[0] == memo_key:
+                _, gs_fn, gs_key = cached
+            else:
+                # entries aligned with the traced grads (params with a
+                # grad, in param order — Executor.fused_step's `upd` list)
+                entries = [(tuple(self._exec.arg_dict[n].shape),
+                            self._exec.arg_dict[n].dtype, -i)
+                           for i, n in enumerate(self._param_names)
+                           if self._exec._grad_req.get(n, "null") != "null"]
+                gs_fn = self._kvstore.fused_grad_sync_fn(entries)
+                if gs_fn is not None:
+                    gs_key = (self._kvstore.type, bucket_cap_bytes())
+                self._exec._fused_gsync_memo = (memo_key, gs_fn, gs_key)
         try:
             self._exec.fused_step(self._optimizer, self._updater,
-                                  self._param_names)
+                                  self._param_names,
+                                  grad_sync_fn=gs_fn, grad_sync_key=gs_key)
         except MXNetError:
             raise  # donation failure / graph error the eager path shares
         except Exception as e:

@@ -25,6 +25,7 @@ from .dist import (
     init_process_group, process_rank, process_count, device_count,
     KVStoreDistTPUSync,
 )
+from .grad_sync import GradSync, bucket_assign, bucketing_enabled
 from .data_parallel import ShardedTrainer, shard_batch, replicate
 from .partition import PartitionRules, infer_param_sharding
 from .ring_attention import ring_attention, ring_self_attention
@@ -39,6 +40,7 @@ __all__ = [
     "psum_scatter",
     "init_process_group", "process_rank", "process_count", "device_count",
     "KVStoreDistTPUSync",
+    "GradSync", "bucket_assign", "bucketing_enabled",
     "ShardedTrainer", "shard_batch", "replicate",
     "PartitionRules", "infer_param_sharding",
     "ring_attention", "ring_self_attention",

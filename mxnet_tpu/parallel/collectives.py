@@ -19,6 +19,29 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+try:  # newer jax exports it top-level
+    from jax import shard_map as _jax_shard_map
+except ImportError:  # older jax: experimental namespace only
+    from jax.experimental.shard_map import shard_map as _jax_shard_map
+
+
+def shard_map(f, **kwargs):
+    """Version-stable `shard_map`: jax renamed the replication-check kwarg
+    (`check_rep` -> `check_vma`) and moved the function out of
+    `jax.experimental`; route every in-repo use through this shim."""
+    import inspect
+
+    try:
+        params = inspect.signature(_jax_shard_map).parameters
+    except (TypeError, ValueError):
+        params = {}
+    if "check_vma" in kwargs and "check_vma" not in params:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in params:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _jax_shard_map(f, **kwargs)
+
+
 from .mesh import default_mesh
 
 
@@ -68,7 +91,6 @@ def _eager_allreduce_fn(mesh, axis, op):
     def body(x):
         return all_reduce(x, axis, op)
 
-    from jax import shard_map
     return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec))
 
 

@@ -236,7 +236,25 @@ def _allgather(buf, fill=0):
     return out.addressable_data(0)
 
 
-_BUCKET_CAP = int(os.environ.get("MXNET_KVSTORE_DIST_BUCKET_SIZE", str(4 << 20)))
+def _bucket_cap_elems(itemsize):
+    """Elements per fused-collective bucket. `MXNET_KVSTORE_DIST_BUCKET_SIZE`
+    (elements — the original knob) wins when set; otherwise the shared
+    grad-sync sizing knob `MXNET_KVSTORE_BUCKET_MB` (bytes) applies, so one
+    variable sizes both the in-store bucketing and `GradSync` buckets."""
+    env = os.environ.get("MXNET_KVSTORE_DIST_BUCKET_SIZE")
+    if env:
+        return int(env)
+    from .grad_sync import bucket_cap_bytes
+
+    return max(1, bucket_cap_bytes() // max(int(itemsize), 1))
+
+
+def _wire_dtype(dtype, fp32_wire):
+    """16-bit keys ship over a bf16 wire by default (fp32 exponent range,
+    half the bytes); `MXNET_KVSTORE_FP32_WIRE=1` restores the exact wire."""
+    if jnp.dtype(dtype) in (jnp.float16, jnp.bfloat16):
+        return jnp.float32 if fp32_wire else jnp.bfloat16
+    return jnp.dtype(dtype)
 
 
 class KVStoreDistTPUSync(KVStoreBase):
@@ -281,8 +299,14 @@ class KVStoreDistTPUSync(KVStoreBase):
     # -- data plane ----------------------------------------------------------
 
     def _key_list(self, key, value):
+        from ..base import MXNetError
+
         if isinstance(key, (list, tuple)):
-            assert len(key) == len(value)
+            # survive `python -O`: a stripped assert would zip-truncate and
+            # silently drop the tail keys of a grouped call
+            if len(key) != len(value):
+                raise MXNetError(
+                    f"grouped call: {len(key)} keys but {len(value)} values")
             return list(key), list(value)
         return [key], [value]
 
@@ -313,8 +337,10 @@ class KVStoreDistTPUSync(KVStoreBase):
             telemetry.counter("kvstore.push_bytes").inc(sum(
                 sum(_nd_nbytes(x) for x in v) if isinstance(v, (list, tuple))
                 else _nd_nbytes(v) for v in vals))
-        dense_keys, dense_arrs = [], []
-        for k, v in zip(keys, vals):
+        prios = list(priority) if isinstance(priority, (list, tuple)) \
+            else [priority] * len(keys)
+        dense_keys, dense_arrs, dense_prios = [], [], []
+        for k, v, p in zip(keys, vals, prios):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized (call init first)")
             if isinstance(v, RowSparseNDArray):
@@ -326,18 +352,22 @@ class KVStoreDistTPUSync(KVStoreBase):
                 arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
             dense_keys.append(k)
             dense_arrs.append(arr)
+            dense_prios.append(p)
         if dense_keys:
             if self._gc.active:
                 self._push_dense_compressed(dense_keys, dense_arrs)
             else:
-                self._push_dense(dense_keys, dense_arrs)
+                self._push_dense(dense_keys, dense_arrs, dense_prios)
         if tele:
             telemetry.histogram("kvstore.push_us").record(
                 (_time.perf_counter() - t0) * 1e6)
 
-    def _push_dense(self, keys, arrs):
+    def _push_dense(self, keys, arrs, priorities=None):
         """Bucketed allreduce: flatten+concat per dtype, one collective per
-        bucket, split back per key.
+        bucket, split back per key. Grouped (multi-key) pushes fill buckets
+        in priority order — least negative first, so the parameters the
+        next forward pass consumes first are reduced first (the engine
+        semantics the per-key `priority=-i` argument always promised).
 
         Wire dtype for 16-bit keys (round-5 verdict #9): fp16 gradients
         ship over a **bf16 wire** — the same bytes as the reference's
@@ -345,14 +375,19 @@ class KVStoreDistTPUSync(KVStoreBase):
         exponent range, so large-key sums cannot overflow the way a raw
         fp16 wire can; bf16 keys stay bf16. `MXNET_KVSTORE_FP32_WIRE=1`
         restores the (exact, 2x bytes) fp32 wire for either."""
+        order = range(len(keys))
+        if priorities is not None and len(set(priorities)) > 1:
+            order = sorted(order, key=lambda i: -priorities[i])
         buckets = []  # list of (keys, arrs)
         groups = {}
-        for k, a in zip(keys, arrs):
+        for i in order:
+            k, a = keys[i], arrs[i]
             groups.setdefault(str(a.dtype), []).append((k, a))
         for _, ka in groups.items():
+            cap = _bucket_cap_elems(ka[0][1].dtype.itemsize)
             cur_k, cur_a, cur_n = [], [], 0
             for k, a in ka:
-                if cur_k and cur_n + a.size > _BUCKET_CAP:
+                if cur_k and cur_n + a.size > cap:
                     buckets.append((cur_k, cur_a))
                     cur_k, cur_a, cur_n = [], [], 0
                 cur_k.append(k)
@@ -361,11 +396,13 @@ class KVStoreDistTPUSync(KVStoreBase):
             if cur_k:
                 buckets.append((cur_k, cur_a))
         fp32_wire = os.environ.get("MXNET_KVSTORE_FP32_WIRE", "0") == "1"
+        tele = telemetry._enabled
         for bkeys, barrs in buckets:
-            if barrs[0].dtype in (jnp.float16, jnp.bfloat16):
-                wire_dtype = jnp.float32 if fp32_wire else jnp.bfloat16
-            else:
-                wire_dtype = barrs[0].dtype
+            wire_dtype = _wire_dtype(barrs[0].dtype, fp32_wire)
+            if tele:
+                # exact wire-dispatch accounting: ONE collective per bucket
+                # (the O(#buckets) contract test_grad_sync.py pins)
+                telemetry.counter("dist.push_collectives").inc()
             if len(barrs) == 1:
                 reduced = _allreduce_sum(barrs[0].astype(wire_dtype))
                 parts = [reduced]
@@ -392,6 +429,8 @@ class KVStoreDistTPUSync(KVStoreBase):
             packs.append(packed)
             off += packed.shape[0]
         bucket = packs[0] if len(packs) == 1 else jnp.concatenate(packs)
+        if telemetry._enabled:
+            telemetry.counter("dist.push_collectives").inc()
         stack = _make_global_stack(bucket)  # fill=0 words dequantize to 0
         fn = _dequant_sum_fn(tuple(segments), float(self._gc.threshold), "float32")
         outs = fn(stack)
@@ -510,6 +549,76 @@ class KVStoreDistTPUSync(KVStoreBase):
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
+
+    def allreduce_flat(self, value, priority=0):
+        """One bucket = one AllReduce on the wire (`GradSync`'s collective):
+        local-sum the per-device replicas, then one cross-worker collective
+        over the flat buffer — no store, no updater, no per-key dispatch."""
+        from ..kvstore import _nd_nbytes
+        from ..ndarray import NDArray
+
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        arrs = [v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                for v in vals]
+        dtype = arrs[0].dtype
+        fp32_wire = os.environ.get("MXNET_KVSTORE_FP32_WIRE", "0") == "1"
+        wire = _wire_dtype(dtype, fp32_wire)
+        # cast BEFORE the local-device sum: a flat fp16 bucket sums in the
+        # wire dtype end-to-end, so neither the replica sum nor the
+        # cross-worker sum can overflow fp16's exponent
+        arrs = [a.astype(wire) for a in arrs]
+        buf = arrs[0] if len(arrs) == 1 else _local_sum(arrs)
+        if telemetry._enabled:
+            telemetry.counter("dist.push_collectives").inc()
+            telemetry.counter("dist.bucket_bytes").inc(
+                int(buf.size) * buf.dtype.itemsize)
+        reduced = _allreduce_sum(buf)
+        return NDArray(reduced.astype(dtype))
+
+    @property
+    def fused_step_compatible(self):
+        """The fused train step may trace this store's gradient sync when
+        the collective is expressible inside the module's (single-device)
+        jitted program: a single-process group, where the cross-replica sum
+        degenerates to the identity. Multi-host groups and compressed
+        pushes keep the eager decomposition (per-push quantization needs
+        host-side residual state)."""
+        return process_count() == 1 and not self._gc.active
+
+    def fused_grad_sync_fn(self, entries):
+        """Traceable bucketed gradient sync for `Executor.fused_step`:
+        flatten+concat each bucket and apply the cross-replica sum INSIDE
+        the jitted step (the psum the eager push dispatches per bucket) —
+        instead of falling back to eager whenever a kvstore is attached.
+        With one process the sum over the replica group is the identity,
+        but the bucket pack/reduce/unpack structure stays in the trace, so
+        the wire dtype and key→bucket layout match the eager path exactly."""
+        if not self.fused_step_compatible:
+            return None
+        from .grad_sync import bucket_assign, bucket_cap_bytes
+
+        buckets = bucket_assign(list(entries), bucket_cap_bytes())
+        shapes = [tuple(e[0]) for e in entries]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        fp32_wire = os.environ.get("MXNET_KVSTORE_FP32_WIRE", "0") == "1"
+
+        def sync(grads):
+            out = list(grads)
+            for b in buckets:
+                wire = _wire_dtype(b.dtype, fp32_wire)
+                parts = [out[k].reshape(-1).astype(wire) for k in b.keys]
+                flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                # single-process group: sum over replicas == identity; the
+                # multi-host lowering replaces this with lax.psum over the
+                # dp axis of an SPMD trace
+                off = 0
+                for k in b.keys:
+                    out[k] = flat[off:off + sizes[k]].reshape(
+                        shapes[k]).astype(grads[k].dtype)
+                    off += sizes[k]
+            return tuple(out)
+
+        return sync
 
     def pull_sparse_grad(self, key):
         """Hand back the merged pending row_sparse aggregate as

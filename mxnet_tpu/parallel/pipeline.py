@@ -31,8 +31,12 @@ def pipeline_step(stage_fn, params_stack, x_microbatches, axis_name, axis_size):
     # up pp-varying params and x's data-axes on the first tick; fori_loop
     # needs a fixed carry type): inherit x's axes via a zero of x, then add pp
     zero = x_microbatches[0] * 0
-    _pvary = (lambda x, axes: lax.pcast(x, axes, to="varying")) if hasattr(lax, "pcast") \
-        else lax.pvary
+    if hasattr(lax, "pcast"):
+        _pvary = lambda x, axes: lax.pcast(x, axes, to="varying")  # noqa: E731
+    elif hasattr(lax, "pvary"):
+        _pvary = lax.pvary
+    else:  # older jax has no varying-axis tracking: the cast is a no-op
+        _pvary = lambda x, axes: x  # noqa: E731
     state = _pvary(zero, (axis_name,))
     outputs = _pvary(jnp.broadcast_to(zero, (m,) + h_shape), (axis_name,))
 

@@ -147,7 +147,7 @@ def ring_self_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     """Sharded entry point: q/k/v are GLOBAL [B, L, H, D] arrays (or numpy);
     the sequence dim is sharded over `axis_name` and ring attention runs as
     one jitted SPMD program."""
-    from jax import shard_map
+    from .collectives import shard_map
 
     mesh = mesh or default_mesh()
     if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
@@ -175,7 +175,7 @@ def _full_causal_bias(lq, lk):
 
 @functools.lru_cache(maxsize=None)
 def _sharded_ring_fn(mesh, axis_name, axis_size, causal, scale):
-    from jax import shard_map
+    from .collectives import shard_map
 
     spec = P(None, axis_name)
 

@@ -293,7 +293,8 @@ class Executor:
             elif req == "add":
                 tgt._data = tgt._data + g.astype(tgt.dtype)
 
-    def fused_step(self, optimizer, updater, param_names):
+    def fused_step(self, optimizer, updater, param_names,
+                   grad_sync_fn=None, grad_sync_key=None):
         """ONE training step — forward, backward (ones cotangents, the
         `backward(out_grads=None)` convention), gradient rescale/clip and
         the optimizer update for every parameter — as a single jitted XLA
@@ -316,6 +317,14 @@ class Executor:
         zeros from bind). Code that needs per-step gradients — Monitor,
         input grads, custom gradient manipulation — must run the eager
         decomposition (``Module._fused_step_ready`` gates the common cases).
+
+        ``grad_sync_fn`` (a traceable ``grads_tuple -> grads_tuple``, from
+        ``KVStore.fused_grad_sync_fn``) is applied to the gradients INSIDE
+        the trace, between backward and the optimizer update — the
+        cross-replica sum over the bucketed flat grads that the eager path
+        dispatches as per-bucket collectives. ``grad_sync_key`` must
+        identify the sync layout (store type + bucket cap): it keys the
+        compile cache so a layout change re-specializes.
         """
         from .. import random as _random
         from ..ndarray import NDArray
@@ -347,7 +356,8 @@ class Executor:
                tuple((a.shape, a.dtype) for a in others),
                tuple((a.shape, a.dtype) for a in auxs),
                tuple(_state_sig(s) for s in states),
-               optimizer._fused_static_key())
+               optimizer._fused_static_key(),
+               grad_sync_key)
 
         def build():
             base = self._fn(True)
@@ -375,6 +385,10 @@ class Executor:
                 outputs, vjp, aux_new = jax.vjp(f, *params, has_aux=True)
                 cts = tuple(jnp.ones(o.shape, o.dtype) for o in outputs)
                 grads = vjp(cts)
+                if grad_sync_fn is not None:
+                    # cross-replica gradient sync traced into the step
+                    # (bucketed flat psum — KVStore.fused_grad_sync_fn)
+                    grads = grad_sync_fn(tuple(grads))
                 new_ws, new_ss = opt.fused_update(
                     list(params), list(grads), states, lrs_, wds_, rescale)
                 return outputs, tuple(new_ws), new_ss, aux_new

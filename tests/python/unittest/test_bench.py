@@ -64,3 +64,35 @@ def test_emit_embeds_last_onchip_capture(tmp_path, monkeypatch):
         bench._emit({"metric": "m", "value": 2.0, "backend": "axon"})
     rec = json.loads(buf.getvalue())
     assert "last_onchip" not in rec
+
+
+def test_probe_timeout_env_and_cache(monkeypatch):
+    """BENCH_r05 recorded 'backend probe hung (> 900s)' — 15 minutes lost
+    to one wedged backend. The probe timeout is now short and configurable
+    (MXNET_TPU_PROBE_TIMEOUT_S, legacy BENCH_PROBE_TIMEOUT wins), and the
+    verdict is memoized per process so a second probe is free."""
+    import importlib.util
+    import time
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_probe_test", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    spec.loader.exec_module(bench)
+
+    monkeypatch.delenv("BENCH_PROBE_TIMEOUT", raising=False)
+    monkeypatch.delenv("MXNET_TPU_PROBE_TIMEOUT_S", raising=False)
+    assert bench._probe_timeout_s() == 120  # seconds, not 15 minutes
+    monkeypatch.setenv("MXNET_TPU_PROBE_TIMEOUT_S", "7")
+    assert bench._probe_timeout_s() == 7
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "9")  # legacy name wins
+    assert bench._probe_timeout_s() == 9
+
+    first = bench._probe_backend()
+    assert first == ("cpu", None)
+    t0 = time.perf_counter()
+    again = bench._probe_backend()
+    dt = time.perf_counter() - t0
+    assert again == first
+    assert dt < 0.05, f"cached probe should be instant, took {dt:.3f}s"

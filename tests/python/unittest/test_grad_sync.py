@@ -1,0 +1,555 @@
+"""Cross-key bucketed, overlapped gradient synchronization (PR 4).
+
+Pins the tentpole contracts:
+* bucket assignment: dtype grouping, size cap, reverse-topological fill,
+  priority bookkeeping;
+* collective count per sync step drops from O(#parameters) to O(#buckets)
+  — EXACT counts via the telemetry collective counters, for both the
+  GradSync scheduler and a grouped multi-key kvstore push;
+* bucketed sync is bit-exact vs the eager per-key reference
+  (`MXNET_GRAD_BUCKETING=0`) through Module / model / gluon Trainer;
+* grouped/list push+pull and pushpull on `local`, `device` and
+  single-process `dist_tpu_sync` (key/value alignment, multi-out pulls,
+  priority ordering);
+* fused-step with a local/device/dist kvstore no longer falls back to
+  eager when `update_on_kvstore=False` (parity over >= 5 steps);
+* gradient-compression error-feedback parity local vs dist (the residual
+  is carried per key on both paths);
+* overlap telemetry: per-bucket issue/wait histograms and the derived
+  overlap ratio.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu import telemetry
+from mxnet_tpu.parallel.grad_sync import (GradSync, bucket_assign,
+                                          bucket_cap_bytes)
+
+
+@pytest.fixture
+def tele():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _counter(name):
+    import json
+    return json.loads(telemetry.dumps())["counters"].get(name, 0)
+
+
+def _gauge(name):
+    import json
+    return json.loads(telemetry.dumps())["gauges"].get(name)
+
+
+def _hist_count(name):
+    import json
+    h = json.loads(telemetry.dumps())["histograms"].get(name)
+    return 0 if h is None else h["count"]
+
+
+# ---------------------------------------------------------------------------
+# bucket assignment
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_assign_cap_and_dtype():
+    entries = [((256,), np.float32, 0),       # 1 KB
+               ((256,), np.float32, -1),      # 1 KB
+               ((1024,), np.float16, -2),     # 2 KB, other dtype
+               ((1024, 512), np.float32, -3)]  # 2 MB, oversized alone
+    buckets = bucket_assign(entries, 4 << 10)  # 4 KB cap
+    # the two small fp32 keys share a bucket; fp16 lives alone; the 2 MB
+    # key exceeds the cap but still gets its own bucket
+    by_keys = {b.keys: b for b in buckets}
+    assert (1, 0) in by_keys or (0, 1) in by_keys
+    small = by_keys.get((1, 0)) or by_keys[(0, 1)]
+    assert small.nbytes == 2048 and small.priority == 0
+    assert any(b.keys == (2,) and str(b.dtype) == "float16" for b in buckets)
+    assert any(b.keys == (3,) and b.nbytes == 2 << 20 for b in buckets)
+
+
+def test_bucket_assign_reverse_topological_fill():
+    # 4 equal keys, cap fits exactly 2: reverse walk pairs (3,2) and (1,0)
+    entries = [((256,), np.float32, -i) for i in range(4)]
+    buckets = bucket_assign(entries, 2048)
+    assert [b.keys for b in buckets] == [(3, 2), (1, 0)]
+    # drain rank: the max (least negative) member priority
+    assert [b.priority for b in buckets] == [-2, 0]
+
+
+def test_bucket_cap_env(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_MB", "2.5")
+    assert bucket_cap_bytes() == int(2.5 * (1 << 20))
+    assert bucket_cap_bytes(1) == 1 << 20  # explicit arg wins
+    assert bucket_cap_bytes(0) == 0
+
+
+# ---------------------------------------------------------------------------
+# collective count: O(#parameters) -> O(#buckets)
+# ---------------------------------------------------------------------------
+
+
+def _resnet50_like_sizes():
+    """193 keys with the BANDWIDTH_r05 tier mix: many tiny, some medium."""
+    rng = np.random.RandomState(3)
+    sizes = [int(s) for s in rng.randint(8, 2048, size=151)]        # small
+    sizes += [int(s) for s in rng.randint(1 << 16, 1 << 18, size=32)]
+    sizes += [1 << 20] * 10
+    return sizes  # 193 keys
+
+
+def test_collective_count_grad_sync(tele):
+    kv = kvs.create("dist_tpu_sync")
+    sizes = _resnet50_like_sizes()
+    grads = [mx.nd.ones((s,)) for s in sizes]
+    sched = GradSync(kv, bucket_mb=4)
+    sched.configure_from(grads)
+    n_buckets = len(sched.buckets)
+    assert n_buckets < 20 < 193  # O(#buckets), not O(#keys)
+    before = _counter("dist.push_collectives")
+    sched.sync(grads)
+    assert _counter("dist.push_collectives") - before == n_buckets
+    assert _counter("grad_sync.collectives") == n_buckets
+
+
+def test_collective_count_grouped_push(tele):
+    """ONE grouped push of 193 keys costs O(#buckets) wire collectives;
+    193 per-key pushes cost exactly 193."""
+    sizes = _resnet50_like_sizes()
+
+    kv = kvs.create("dist_tpu_sync")
+    for i, s in enumerate(sizes):
+        kv.init(i, mx.nd.zeros((s,)))
+    vals = [mx.nd.ones((s,)) for s in sizes]
+
+    before = _counter("dist.push_collectives")
+    kv.push(list(range(len(sizes))), vals,
+            priority=[-i for i in range(len(sizes))])
+    grouped = _counter("dist.push_collectives") - before
+    assert grouped < 20
+
+    before = _counter("dist.push_collectives")
+    for i, v in enumerate(vals):
+        kv.push(i, v, priority=-i)
+    per_key = _counter("dist.push_collectives") - before
+    assert per_key == len(sizes) == 193
+
+
+def test_grad_sync_values_and_overlap_telemetry(tele):
+    kv = kvs.create("device")
+    grads = [[mx.nd.ones((4, 4)) * (i + 1), mx.nd.ones((4, 4)) * 10]
+             for i in range(6)]
+    sched = GradSync(kv, bucket_mb=4)
+    sched.configure_from(grads)
+    sched.issue(grads)
+    sched.drain(grads)
+    for i, g in enumerate(grads):
+        for rep in g:  # reduced value written into every device replica
+            assert np.allclose(rep.asnumpy(), (i + 1) + 10)
+    n = len(sched.buckets)
+    assert _hist_count("grad_sync.issue_us") == n
+    assert _hist_count("grad_sync.exposed_wait_us") == 1
+    ratio = _gauge("grad_sync.overlap_ratio")
+    assert ratio is not None and 0.0 <= ratio <= 1.0
+    assert _gauge("grad_sync.buckets") == n
+
+
+def test_grad_sync_scatter_restores_device_placement():
+    """Reduced values must land back on each replica's own device (the
+    eager pull's as_in_context contract) — not stay parked on the reduce
+    device, where a later per-device op would see a cross-device mix."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    kv = kvs.create("device")
+    ctx0, ctx1 = mx.Context("cpu", 0), mx.Context("cpu", 1)
+    grads = [[mx.nd.ones((4,), ctx=ctx0), mx.nd.ones((4,), ctx=ctx1) * 2]
+             for _ in range(3)]
+    sched = GradSync(kv, bucket_mb=4)
+    sched.configure_from(grads)
+    sched.sync(grads)
+    for g in grads:
+        for rep, ctx in zip(g, (ctx0, ctx1)):
+            assert np.allclose(rep.asnumpy(), 3)
+            assert list(rep._data.devices()) == [ctx.jax_device], \
+                f"replica for {ctx} left on {rep._data.devices()}"
+
+
+def test_grad_sync_outs_and_persistent_plan():
+    kv = kvs.create("local")
+    grads = [mx.nd.ones((8,)) * 3, mx.nd.ones((8,)) * 4]
+    outs = [mx.nd.zeros((8,)), mx.nd.zeros((8,))]
+    sched = GradSync(kv, bucket_mb=1)
+    sched.configure_from(grads)
+    plan = sched.buckets
+    sched.sync(grads, outs=outs)
+    assert np.allclose(outs[0].asnumpy(), 3)
+    assert np.allclose(grads[0].asnumpy(), 3)  # inputs untouched
+    # same layout -> configure is a no-op (the persistent bucket plan)
+    sched.configure_from(grads)
+    assert sched.buckets is plan
+
+
+# ---------------------------------------------------------------------------
+# grouped / list push+pull+pushpull on every store type
+# ---------------------------------------------------------------------------
+
+
+STORES = ["local", "device", "dist_tpu_sync"]
+
+
+@pytest.mark.parametrize("store", STORES)
+def test_grouped_push_pull_alignment(store):
+    kv = kvs.create(store)
+    keys = [11, 7, 3]
+    shapes = [(2, 3), (4,), (3, 2)]
+    kv.init(keys, [mx.nd.zeros(s) for s in shapes])
+    vals = [mx.nd.ones(s) * (i + 1) for i, s in enumerate(shapes)]
+    kv.push(keys, vals, priority=[0, -1, -2])
+    outs = [mx.nd.zeros(s) for s in shapes]
+    kv.pull(keys, out=outs, priority=[0, -1, -2])
+    for i, o in enumerate(outs):
+        assert o.shape == shapes[i]
+        assert np.allclose(o.asnumpy(), i + 1), f"key {keys[i]} misaligned"
+
+
+@pytest.mark.parametrize("store", STORES)
+def test_grouped_pushpull(store):
+    kv = kvs.create(store)
+    keys = ["a", "b"]
+    kv.init(keys, [mx.nd.zeros((2, 2))] * 2)
+    vals = [mx.nd.ones((2, 2)) * 2, mx.nd.ones((2, 2)) * 5]
+    outs = [mx.nd.zeros((2, 2)), mx.nd.zeros((2, 2))]
+    kv.pushpull(keys, vals, out=outs, priority=[0, -1])
+    assert np.allclose(outs[0].asnumpy(), 2)
+    assert np.allclose(outs[1].asnumpy(), 5)
+
+
+@pytest.mark.parametrize("store", STORES)
+def test_multi_out_pull(store):
+    """One key pulled into several destination arrays (per-device fanout)."""
+    kv = kvs.create(store)
+    kv.init(1, mx.nd.ones((3,)) * 7)
+    outs = [mx.nd.zeros((3,)) for _ in range(3)]
+    kv.pull(1, out=outs)
+    for o in outs:
+        assert np.allclose(o.asnumpy(), 7)
+
+
+@pytest.mark.parametrize("store", STORES)
+def test_grouped_push_priority_ordering_exact(store):
+    """Priority may reorder the wire schedule but never the key->value
+    mapping: distinct priorities, distinct values, exact readback."""
+    kv = kvs.create(store)
+    keys = list(range(8))
+    kv.init(keys, [mx.nd.zeros((4,))] * 8)
+    vals = [mx.nd.ones((4,)) * (10 + i) for i in keys]
+    kv.push(keys, vals, priority=[-i for i in keys])
+    outs = [mx.nd.zeros((4,)) for _ in keys]
+    kv.pull(keys, out=outs, priority=[-i for i in keys])
+    for i, o in enumerate(outs):
+        assert np.allclose(o.asnumpy(), 10 + i)
+
+
+@pytest.mark.parametrize("store", ["local", "dist_tpu_sync"])
+def test_grouped_push_alignment_error(store):
+    """Misaligned grouped calls raise MXNetError (not a stripped-under-
+    python-O assert, not a silent zip truncation)."""
+    from mxnet_tpu.base import MXNetError
+
+    kv = kvs.create(store)
+    kv.init([0, 1], [mx.nd.zeros((2,))] * 2)
+    with pytest.raises(MXNetError):
+        kv.push([0, 1], [mx.nd.ones((2,))])  # 2 keys, 1 value
+
+
+# ---------------------------------------------------------------------------
+# allreduce_flat: the bucket primitive
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("store", STORES)
+def test_allreduce_flat(store):
+    kv = kvs.create(store)
+    flats = [mx.nd.ones((16,)) * 2, mx.nd.ones((16,)) * 3]
+    red = kv.allreduce_flat(flats)
+    assert np.allclose(red.asnumpy(), 5)
+    red1 = kv.allreduce_flat(mx.nd.ones((8,)) * 4)
+    assert np.allclose(red1.asnumpy(), 4)
+
+
+def test_allreduce_flat_16bit_wire_exact_range():
+    """fp16 buckets ride the bf16 wire: a TRANSIENT overflow (partial sum
+    past fp16's 65504 max, final value back in range) must survive —
+    on a raw fp16 wire the running sum saturates to inf and never
+    recovers."""
+    kv = kvs.create("dist_tpu_sync")
+    big = mx.nd.array(np.full((8,), 4.0e4), dtype="float16")
+    neg = mx.nd.array(np.full((8,), -4.0e4), dtype="float16")
+    # 4e4 + 4e4 = 8e4 (inf in fp16) ... - 4e4 -> 4e4, representable
+    red = kv.allreduce_flat([big, big, neg])
+    out = red.asnumpy().astype(np.float64)
+    assert np.all(np.isfinite(out))
+    assert np.allclose(out, 4.0e4, rtol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# Module / model / Trainer: bucketed == per-key reference
+# ---------------------------------------------------------------------------
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _fit_module(store, bucketing, fused=False, update_on_kv=True, seed=7):
+    os.environ["MXNET_GRAD_BUCKETING"] = "1" if bucketing else "0"
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    os.environ["MXNET_UPDATE_ON_KVSTORE"] = "1" if update_on_kv else "0"
+    try:
+        mx.random.seed(seed)
+        rng = np.random.RandomState(0)
+        X = rng.uniform(-1, 1, (40, 8)).astype(np.float32)
+        Y = rng.randint(0, 4, (40,)).astype(np.float32)
+        it = mx.io.NDArrayIter(X, Y, batch_size=8, shuffle=False)
+        m = mx.mod.Module(_mlp(), context=mx.cpu())
+        m.fit(it, num_epoch=2, optimizer="sgd",
+              optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+              initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2),
+              kvstore=kvs.create(store))
+        arg_p, _ = m.get_params()
+        return m, {k: v.asnumpy() for k, v in arg_p.items()}
+    finally:
+        for v in ("MXNET_GRAD_BUCKETING", "MXNET_FUSED_STEP",
+                  "MXNET_UPDATE_ON_KVSTORE"):
+            os.environ.pop(v, None)
+
+
+@pytest.mark.parametrize("store", STORES)
+@pytest.mark.parametrize("update_on_kv", [True, False])
+def test_module_bucketed_matches_per_key(store, update_on_kv):
+    """fp32 sums are associativity-stable here: bucketed must be EXACT."""
+    _, ref = _fit_module(store, bucketing=False, update_on_kv=update_on_kv)
+    _, got = _fit_module(store, bucketing=True, update_on_kv=update_on_kv)
+    assert ref.keys() == got.keys()
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+
+
+@pytest.mark.parametrize("store", STORES)
+def test_fused_step_engages_with_kvstore(store):
+    """The acceptance contract: update_on_kvstore=False + local/device/
+    single-process dist store runs the FUSED step (no eager fallback) and
+    matches the eager per-key path over >= 5 steps (2 epochs x 5)."""
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        m, fused_w = _fit_module(store, bucketing=True, fused=True,
+                                 update_on_kv=False)
+        assert m._kvstore is not None
+        assert m._fused_step_ready(), \
+            f"{store}: fused step fell back to eager"
+        assert _gauge("step.fused") == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    _, eager_w = _fit_module(store, bucketing=False, fused=False,
+                             update_on_kv=False)
+    for k in eager_w:
+        np.testing.assert_allclose(fused_w[k], eager_w[k],
+                                   rtol=3e-5, atol=3e-6, err_msg=k)
+
+
+def test_fused_step_still_falls_back_on_update_on_kvstore():
+    m, _ = _fit_module("local", bucketing=True, fused=True,
+                       update_on_kv=True)
+    assert m._kvstore is not None
+    assert not m._fused_step_ready()
+
+
+def test_update_params_helpers_bucketed_match(monkeypatch):
+    """model._update_params / _update_params_on_kvstore grouped rewrites."""
+    from mxnet_tpu.model import _update_params, _update_params_on_kvstore
+    from mxnet_tpu import optimizer as opt
+
+    def run(bucketing, on_kv):
+        monkeypatch.setenv("MXNET_GRAD_BUCKETING", "1" if bucketing else "0")
+        names = [f"p{i}" for i in range(5)]
+        params = [[mx.nd.ones((4,)) * (i + 1)] for i in range(5)]
+        grads = [[mx.nd.ones((4,)) * 0.5] for _ in range(5)]
+        kv = kvs.create("local")
+        if on_kv:
+            kv.set_optimizer(opt.SGD(learning_rate=0.1))
+            for n, p in zip(names, params):
+                kv.init(n, p[0])
+            _update_params_on_kvstore(params, grads, kv, names)
+        else:
+            for n, p in zip(names, params):
+                kv.init(n, p[0])
+            updater = opt.get_updater(opt.SGD(learning_rate=0.1))
+            _update_params(params, grads, updater, 1, kvstore=kv,
+                           param_names=names)
+        return [p[0].asnumpy() for p in params]
+
+    for on_kv in (True, False):
+        ref = run(False, on_kv)
+        got = run(True, on_kv)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(r, g)
+
+
+@pytest.mark.parametrize("update_on_kv", [True, False])
+def test_trainer_bucketed_matches_per_key(monkeypatch, update_on_kv):
+    from mxnet_tpu import gluon
+
+    def run(bucketing):
+        monkeypatch.setenv("MXNET_GRAD_BUCKETING", "1" if bucketing else "0")
+        mx.random.seed(11)
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1},
+                                kvstore=kvs.create("device"),
+                                update_on_kvstore=update_on_kv)
+        rng = np.random.RandomState(2)
+        from mxnet_tpu import autograd
+        for _ in range(5):
+            x = mx.nd.array(rng.uniform(-1, 1, (8, 8)))
+            with autograd.record():
+                y = net(x)
+                loss = (y * y).sum()
+            loss.backward()
+            trainer.step(8)
+        return {k: v.data().asnumpy()
+                for k, v in net.collect_params().items()}
+
+    ref = run(False)
+    got = run(True)
+    # gluon auto-names blocks with a per-process counter (dense0 vs
+    # dense1 across the two runs): compare by sorted position
+    for (rk, rv), (gk, gv) in zip(sorted(ref.items()), sorted(got.items())):
+        np.testing.assert_array_equal(rv, gv, err_msg=f"{rk} vs {gk}")
+
+
+# ---------------------------------------------------------------------------
+# gradient compression: error-feedback parity local vs dist
+# ---------------------------------------------------------------------------
+
+
+def test_compression_error_feedback_parity_local_vs_dist():
+    """Both stores must carry the 2-bit error-feedback residual PER KEY:
+    with one worker the dist per-worker residual and the local merged-
+    gradient residual are the same state, so N identical push sequences
+    must produce identical pulled values — including the second push,
+    which only moves if the first push's dropped remainder was kept."""
+    rng = np.random.RandomState(5)
+    seq = [rng.uniform(-1, 1, (64,)).astype(np.float32) for _ in range(4)]
+
+    def run(store):
+        kv = kvs.create(store)
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("w", mx.nd.zeros((64,)))
+        outs = []
+        for g in seq:
+            kv.push("w", mx.nd.array(g))
+            out = mx.nd.zeros((64,))
+            kv.pull("w", out=out)
+            outs.append(out.asnumpy().copy())
+        return outs
+
+    local = run("local")
+    dist = run("dist_tpu_sync")
+    for step, (l, d) in enumerate(zip(local, dist)):
+        np.testing.assert_array_equal(l, d, err_msg=f"step {step}")
+    # residual carry: values in (-0.5, 0.5) are dropped at step 1 but the
+    # accumulated residual must eventually emit +-threshold steps
+    assert any(np.abs(l).max() > 0 for l in local)
+
+
+@pytest.mark.parametrize("store", ["device", "dist_tpu_sync"])
+def test_compression_not_bypassed_by_bucketing(store, monkeypatch):
+    """A compressed store must keep compressing with bucketing at its
+    default (on): the flat-bucket allreduce has no quantize step, so
+    compressed stores take the per-key path — sub-threshold grads still
+    come back as 0 (dropped into the residual), never as raw values."""
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.parallel.grad_sync import sync_compatible
+
+    kv = kvs.create(store)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 10.0})
+    assert not sync_compatible(kv)
+    monkeypatch.setenv("MXNET_GRAD_BUCKETING", "1")
+    mx.random.seed(13)
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv,
+                            update_on_kvstore=False)
+    before = {k: v.data().asnumpy().copy()
+              for k, v in net.collect_params().items()}
+    x = mx.nd.ones((4, 8)) * 0.01
+    with autograd.record():
+        loss = (net(x) * net(x)).sum()
+    loss.backward()
+    trainer.step(4)
+    # every gradient is far below threshold=10: the quantizer drops all of
+    # them into the residual, so the update must be a no-op. If bucketing
+    # bypassed compression, the raw gradient would move the weights.
+    for k, v in net.collect_params().items():
+        np.testing.assert_array_equal(before[k], v.data().asnumpy(),
+                                      err_msg=f"{k}: compression bypassed")
+
+
+def test_compression_residual_is_per_key():
+    kv = kvs.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("a", mx.nd.zeros((4,)))
+    kv.init("b", mx.nd.zeros((4,)))
+    # 0.3 < threshold: dropped, kept in a's residual
+    kv.push("a", mx.nd.ones((4,)) * 0.3)
+    out = mx.nd.zeros((4,))
+    kv.pull("a", out=out)
+    assert np.allclose(out.asnumpy(), 0)
+    # b's residual must NOT see a's leftovers
+    kv.push("b", mx.nd.ones((4,)) * 0.3)
+    kv.pull("b", out=out)
+    assert np.allclose(out.asnumpy(), 0)
+    # second 0.3 on a crosses threshold thanks to a's own residual
+    kv.push("a", mx.nd.ones((4,)) * 0.3)
+    kv.pull("a", out=out)
+    assert np.allclose(out.asnumpy(), 0.5)
+
+
+# ---------------------------------------------------------------------------
+# eager reference switch
+# ---------------------------------------------------------------------------
+
+
+def test_bucketing_disabled_uses_per_key_path(tele, monkeypatch):
+    monkeypatch.setenv("MXNET_GRAD_BUCKETING", "0")
+    from mxnet_tpu.model import _update_params_on_kvstore
+    from mxnet_tpu import optimizer as opt
+
+    kv = kvs.create("dist_tpu_sync")
+    kv.set_optimizer(opt.SGD(learning_rate=0.1))
+    names = [f"p{i}" for i in range(6)]
+    params = [[mx.nd.ones((4,))] for _ in names]
+    grads = [[mx.nd.ones((4,))] for _ in names]
+    for n, p in zip(names, params):
+        kv.init(n, p[0])
+    before = _counter("dist.push_collectives")
+    _update_params_on_kvstore(params, grads, kv, names)
+    assert _counter("dist.push_collectives") - before == 6  # one per key

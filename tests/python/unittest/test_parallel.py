@@ -11,7 +11,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from mxnet_tpu.parallel.collectives import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import mxnet_tpu as mx

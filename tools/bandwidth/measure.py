@@ -19,6 +19,13 @@ pass --ndev 1 (latency probe); for the 8-device virtual CPU mesh run
 (`mxnet_tpu/parallel/dist.py`) instead of the local reducer; with one
 process it degenerates to the local path but drives the same code the
 multi-process launcher uses (tools/launch.py).
+
+--bucket-mb 0,1,4 sweeps the bucketed grad-sync scheduler
+(`mxnet_tpu/parallel/grad_sync.py`) per key-size tier: '0' is the per-key
+baseline, other values the flat-bucket size. Reported in the same tier
+schema as BANDWIDTH_r05.json plus bucket counts and the per-config
+reduction error (must be exactly 0) — the harness that pins the
+O(#parameters) -> O(#buckets) collective-count win.
 """
 import argparse
 import logging
@@ -56,6 +63,12 @@ def parse_args():
     p.add_argument("--tiers", type=int, default=0,
                    help="1: also time push+pull per key-size tier "
                         "(small <256KB / medium <4MB / large >=4MB)")
+    p.add_argument("--bucket-mb", type=str, default="",
+                   help="comma-separated bucket sizes in MB (0 = per-key "
+                        "baseline), e.g. '0,1,4': sweep the bucketed "
+                        "grad-sync scheduler per tier and report "
+                        "bucketed-vs-per-key wire throughput (implies "
+                        "--tiers schema; reduction must be exact)")
     p.add_argument("--json-out", type=str, default="",
                    help="rank-0 appends one JSON result line to this file")
     return p.parse_args()
@@ -169,20 +182,21 @@ def run(args):
         avg = sum(r.bandwidth for r in res) / len(res)
         logging.info("average %f GB/sec per device over %d iters", avg, len(res))
 
+    # per-key-size tiers (the reference harness reports one number per
+    # key-size regime; BANDWIDTH_r*.json keeps the tiers explicit)
+    n_eff = max(ndev, getattr(kv, "num_workers", 1))
+    tiers = {"small_lt_256KB": [], "medium_lt_4MB": [], "large_ge_4MB": []}
+    for i, s in enumerate(shapes):
+        nbytes = float(np.prod(s)) * 4
+        if nbytes < 256 << 10:
+            tiers["small_lt_256KB"].append(i)
+        elif nbytes < 4 << 20:
+            tiers["medium_lt_4MB"].append(i)
+        else:
+            tiers["large_ge_4MB"].append(i)
+
     tier_stats = {}
     if args.tiers:
-        # per-key-size tiers (the reference harness reports one number per
-        # key-size regime; BANDWIDTH_r*.json keeps the tiers explicit)
-        n_eff = max(ndev, getattr(kv, "num_workers", 1))
-        tiers = {"small_lt_256KB": [], "medium_lt_4MB": [], "large_ge_4MB": []}
-        for i, s in enumerate(shapes):
-            nbytes = float(np.prod(s)) * 4
-            if nbytes < 256 << 10:
-                tiers["small_lt_256KB"].append(i)
-            elif nbytes < 4 << 20:
-                tiers["medium_lt_4MB"].append(i)
-            else:
-                tiers["large_ge_4MB"].append(i)
         for tname, idxs in tiers.items():
             if not idxs:
                 continue
@@ -208,6 +222,60 @@ def run(args):
                          "%.3f GB/s wire", tname, len(idxs), tbytes / 1e6,
                          per_iter, wire_bytes_s / 1e9)
 
+    bucket_sweep = {}
+    if args.bucket_mb:
+        # bucketed-vs-per-key sweep: the same tier schema, but synced
+        # through the GradSync scheduler (one flat collective per bucket;
+        # 0 MB = one bucket per key, the per-key baseline expressed in the
+        # identical code path). BANDWIDTH_r05 showed the small tier at
+        # ~1 MB/s vs ~141 MB/s large at 4 workers — per-key dispatch, the
+        # overhead bucketing amortizes; this mode pins the win.
+        from mxnet_tpu.parallel.grad_sync import GradSync
+
+        mbs = [float(x) for x in args.bucket_mb.split(",") if x != ""]
+        for tname, idxs in tiers.items():
+            if not idxs:
+                continue
+            tbytes = sum(float(np.prod(shapes[i])) * 4 for i in idxs)
+            tier_grads = [grads[i] for i in idxs]
+            tier_weights = [weights[i] for i in idxs]
+            sweep = {}
+            for mb in mbs:
+                sched = GradSync(kv, bucket_mb=mb)
+                sched.configure_from(tier_grads,
+                                     priorities=[-i for i in idxs])
+                for _ in range(2):  # warm (compile) + measure
+                    tic = time.time()
+                    for _b in range(args.num_batches):
+                        sched.sync(tier_grads, outs=tier_weights)
+                        for ws in tier_weights:
+                            for w in ws:
+                                w.wait_to_read()
+                    dt = time.time() - tic
+                per_iter = dt / args.num_batches
+                # exactness: the reduced value must equal the host oracle
+                num = den = 0.0
+                for i in idxs:
+                    on = cpu_grads[i].asnumpy()
+                    den += np.abs(on).sum()
+                    for w in weights[i]:
+                        num += np.abs(w.asnumpy() - on).sum()
+                err = num / max(den, 1e-12)
+                wire_bytes_s = tbytes * 2 * (n_eff - 1) / max(n_eff, 1) / \
+                    max(per_iter, 1e-12)
+                label = "per_key" if mb == 0 else f"{mb:g}MB"
+                sweep[label] = {
+                    "keys": len(idxs), "bytes": tbytes,
+                    "buckets": len(sched.buckets),
+                    "sec_per_iter": per_iter,
+                    "wire_bytes_per_sec": wire_bytes_s,
+                    "error": float(err)}
+                logging.info(
+                    "tier %s bucket=%s: %d keys -> %d buckets, %.4f s/iter, "
+                    "%.3f GB/s wire, error %g", tname, label, len(idxs),
+                    len(sched.buckets), per_iter, wire_bytes_s / 1e9, err)
+            bucket_sweep[tname] = sweep
+
     if args.json_out and getattr(kv, "rank", 0) == 0:
         import json
 
@@ -216,7 +284,7 @@ def run(args):
                 "ndev_local": ndev, "total_MB": size_mb,
                 "avg_gb_per_sec_per_device": avg,
                 "error": float(res[-1].error) if res else None,
-                "tiers": tier_stats}
+                "tiers": tier_stats, "bucket_sweep": bucket_sweep}
         with open(args.json_out, "a") as f:
             f.write(json.dumps(line) + "\n")
     return res
