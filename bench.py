@@ -556,6 +556,110 @@ def _measure_module(on_tpu, fetch_cost, fused=True):
     return img_s_fetch, img_s_disp, compile_s
 
 
+def _measure_serving(on_tpu):
+    """serving_throughput probe: closed-loop clients firing ragged-size
+    requests at a `serving.DynamicBatcher` over a small MLP Predictor —
+    reports req/s plus client-measured p50/p99 end-to-end latency, with
+    the cold (warmup compile) seconds separated from warm steady state
+    exactly as the fused-step PR separated compile from throughput. The
+    net is small ON PURPOSE: this measures the batching/admission plane
+    (coalescing, padding, queueing), not matmul throughput — and it
+    asserts the serving cache stayed cold-free (`steady_state_compiles`
+    must be 0; a nonzero value is a bucket-churn regression)."""
+    import threading
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.io.io import DataDesc
+
+    dim, classes = 64, 8
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(sym)
+    mod.bind([DataDesc("data", (8, dim))], [DataDesc("softmax_label", (8,))],
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+
+    buckets = (2, 4, 8, 16)
+    pred = mod.as_predictor(buckets=buckets)
+    warm = serving.warmup(pred)  # the cold phase: every bucket compiles here
+    misses_warm = pred.cache.misses
+
+    n_clients = 4
+    per_client = int(os.environ.get(
+        "BENCH_SERVING_REQS", "200" if on_tpu else "100"))
+    sizes = [1, 2, 3, 5, 8, 11]
+    rng = np.random.RandomState(0)
+    payloads = {s: rng.uniform(-1, 1, (s, dim)).astype(np.float32)
+                for s in set(sizes)}
+    lat = [[] for _ in range(n_clients)]
+
+    def closed_loop(fn, record):
+        errors = []
+
+        def client(k):
+            try:
+                for i in range(per_client):
+                    s = sizes[(k + i) % len(sizes)]
+                    t = time.perf_counter()
+                    fn(payloads[s])
+                    if record:
+                        lat[k].append(time.perf_counter() - t)
+            except Exception as e:  # noqa: BLE001 — re-raised below: a
+                # dead client thread must become a serving_error entry,
+                # not silently-partial req/s and percentile numbers
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_clients)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if errors:
+            raise errors[0]
+        return time.perf_counter() - t0
+
+    with serving.DynamicBatcher(pred, max_wait_ms=1.0) as srv:
+        # warm-in: thread pools, first-call paths, allocator — untimed
+        # (the compile cold phase was already separated out by warmup())
+        for s in sizes:
+            srv.predict(payloads[s])
+        wall = closed_loop(srv.predict, record=True)
+
+    all_lat = sorted(x for per in lat for x in per)
+
+    def pct(q):
+        return all_lat[min(len(all_lat) - 1,
+                           int(round(q / 100.0 * (len(all_lat) - 1))))]
+
+    total = n_clients * per_client
+    # the comparison point: the same clients hammering the lock-shared
+    # Predictor directly (no queue, no coalescing). With sub-ms CPU
+    # compute the batcher's thread handoffs are visible against this; with
+    # real accelerator compute the coalescing wins (docs/faq/perf.md)
+    direct_wall = closed_loop(pred.predict, record=False)
+    return {
+        "metric": "serving_throughput",
+        "requests": total,
+        "clients": n_clients,
+        "req_per_s": round(total / wall, 1),
+        "p50_ms": round(pct(50) * 1e3, 3),
+        "p99_ms": round(pct(99) * 1e3, 3),
+        "direct_req_per_s": round(total / direct_wall, 1),
+        "cold_compile_s": round(warm["seconds"], 3),
+        "warmup_compiles": warm["compiles"],
+        "steady_state_compiles": pred.cache.misses - misses_warm,
+        "buckets": list(buckets),
+    }
+
+
 def _measure_peak_flops(on_tpu, fetch_cost):
     """Measured MXU peak: sustained FLOP/s of a chained large bf16 matmul,
     value-fetch timed (each matmul consumes the previous result, so the
@@ -689,6 +793,15 @@ def main():
             result["framework_bf16_dispatch"] = round(bf_disp, 2)
         except Exception:  # noqa: BLE001
             result["bf16_error"] = traceback.format_exc(limit=3).strip().splitlines()[-1]
+        try:
+            # the serving plane: req/s + tail latency through the dynamic
+            # micro-batcher, warm (post-warmup) vs cold compile separated;
+            # lands in the BENCH json and — via the serving.* histograms —
+            # in the BENCH_TELEMETRY.json sidecar
+            result["serving"] = _measure_serving(on_tpu)
+        except Exception:  # noqa: BLE001
+            result["serving_error"] = \
+                traceback.format_exc(limit=3).strip().splitlines()[-1]
         try:
             import jax
 

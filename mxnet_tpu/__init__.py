@@ -56,6 +56,7 @@ from . import optimizer
 from . import kvstore as kv
 from . import kvstore
 from . import model
+from . import serving
 from . import recordio
 from . import rnn
 from . import test_utils

@@ -39,8 +39,8 @@ import weakref
 from . import telemetry
 from .base import getenv, register_env
 
-__all__ = ["CompileCache", "persistent_cache_dir", "stats", "all_caches",
-           "donation_warnings_suppressed", "trace_salt"]
+__all__ = ["CompileCache", "persistent_cache_dir", "stats", "named_stats",
+           "all_caches", "donation_warnings_suppressed", "trace_salt"]
 
 register_env("MXNET_FUSED_STEP", True,
              "fuse forward+backward+optimizer update into one jitted XLA "
@@ -51,6 +51,22 @@ register_env("MXNET_COMPILE_CACHE_DIR", "",
 
 _caches = weakref.WeakSet()
 _caches_lock = threading.Lock()
+
+# monotonic per-NAME hit/miss/compile-time totals, surviving cache GC —
+# `named_stats("serving")` must answer "did steady state compile anything?"
+# with a counter that can only grow, not a sum over whatever instances
+# happen to still be alive (a collected Predictor would silently subtract
+# its history and break delta-based zero-compile assertions)
+_name_totals = {}
+
+
+def _totals(name):
+    with _caches_lock:
+        t = _name_totals.get(name)
+        if t is None:
+            t = _name_totals[name] = {"hits": 0, "misses": 0,
+                                      "compile_seconds": 0.0}
+        return t
 
 # Process-unique constant mixed into donated programs' HLO (trace_salt):
 # a donated-buffer executable deserialized from the on-disk cache by a
@@ -160,6 +176,7 @@ class CompileCache:
         self.hits = 0
         self.misses = 0
         self.compile_seconds = 0.0
+        self._name_totals = _totals(name)
         self._entries = {}
         self._lock = threading.Lock()
         with _caches_lock:
@@ -186,6 +203,7 @@ class CompileCache:
         fn = self._entries.get(key)
         if fn is not None:
             self.hits += 1
+            self._name_totals["hits"] += 1
             telemetry.counter("compile.cache_hits").inc()
             if self.maxsize is not None:
                 # LRU, not FIFO: refresh position so overflow evicts a COLD
@@ -198,9 +216,11 @@ class CompileCache:
             fn = self._entries.get(key)
             if fn is not None:
                 self.hits += 1
+                self._name_totals["hits"] += 1
                 telemetry.counter("compile.cache_hits").inc()
                 return fn
             self.misses += 1
+            self._name_totals["misses"] += 1
             telemetry.counter("compile.cache_misses").inc()
             fn = self._wrap_first_call(build(), persistent)
             if self.maxsize is not None and len(self._entries) >= self.maxsize:
@@ -246,6 +266,7 @@ class CompileCache:
                     self._first = False
                     dt = time.perf_counter() - t0
                     cache.compile_seconds += dt
+                    cache._name_totals["compile_seconds"] += dt
                     telemetry.counter("compile.seconds").inc(dt)
                     telemetry.histogram("compile.first_call_us").record(dt * 1e6)
                     return out
@@ -279,6 +300,23 @@ def stats():
             "misses": sum(p["misses"] for p in per),
             "compile_seconds": sum(p["compile_seconds"] for p in per),
             "caches": sorted(per, key=lambda p: p["name"])}
+
+
+def named_stats(name):
+    """The per-subsystem view of :func:`stats` for every cache ever named
+    ``name`` (e.g. ``named_stats("serving")`` answers "did steady-state
+    traffic compile anything?" without counting the training-side
+    executors that share the process). ``hits``/``misses``/
+    ``compile_seconds`` are MONOTONIC process-lifetime totals — a
+    garbage-collected cache keeps its contribution, so deltas are safe to
+    assert on; ``entries``/``caches`` describe the currently-live ones."""
+    per = [c.snapshot() for c in all_caches() if c.name == name]
+    totals = _totals(name)
+    return {"entries": sum(p["entries"] for p in per),
+            "hits": totals["hits"],
+            "misses": totals["misses"],
+            "compile_seconds": totals["compile_seconds"],
+            "caches": len(per)}
 
 
 persistent_cache_dir()

@@ -181,9 +181,28 @@ class Block:
         visible to the 1.x symbolic surfaces — ``HybridBlock.export`` and
         prefix-keyed ``ParameterDict.save/load`` — which match the
         ParameterDict-created prefixed names; use ``self.params.get``
-        for parameters that must round-trip through symbol JSON."""
+        for parameters that must round-trip through symbol JSON.
+
+        The result is IDENTITY-deduplicated: a Parameter shared across
+        blocks (tied weights held as a direct attribute on two blocks)
+        appears exactly once, under its first-encountered key — two keys
+        for one Parameter would register it twice in ``Trainer``, which
+        then double-applies its update with two separate optimizer slots
+        (the reference's name-keyed ParameterDict dedupes tied params
+        naturally)."""
         self._check_container_with_block()
         ret = ParameterDict(self._params.prefix)
+        seen = set()
+
+        def merge(items):
+            fresh = {}
+            for name, p in items:
+                if id(p) in seen:
+                    continue
+                seen.add(id(p))
+                fresh[name] = p
+            ret.update(fresh)
+
         # direct Parameter ATTRIBUTES (2.x style: `self.w = Parameter(...)`)
         # live in _reg_params only; without this they would be saved by
         # save_parameters (which walks _reg_params) yet invisible to
@@ -195,16 +214,16 @@ class Block:
                   for attr, p in self._reg_params.items()
                   if id(p) not in lib_params}
         if not select:
-            ret.update(self.params)
-            ret.update(direct)
+            merge(self.params.items())
+            merge(direct.items())
         else:
             pattern = re.compile(select)
-            ret.update({name: value for name, value in self.params.items()
-                        if pattern.match(name)})
-            ret.update({name: value for name, value in direct.items()
-                        if pattern.match(name)})
+            merge((name, value) for name, value in self.params.items()
+                  if pattern.match(name))
+            merge((name, value) for name, value in direct.items()
+                  if pattern.match(name))
         for cld in self._children.values():
-            ret.update(cld.collect_params(select=select))
+            merge(cld.collect_params(select=select).items())
         return ret
 
     def _check_container_with_block(self):

@@ -130,11 +130,18 @@ class Parameter:
             ctx = [ctx]
         if init is None and self.init is not None:
             init = self.init
-        # NOTE: init stays None when the param merely inherits the GLOBAL
-        # default_init — _finish_deferred_init then routes through the
-        # name-suffix dispatch (weight->init_weight, bias->zeros, ...).
-        # Collapsing default_init into init here would ride the InitDesc
-        # `__init__` attr and force e.g. Xavier onto a 1-d "bias" param.
+        # DELIBERATE DIVERGENCE from the reference: init stays None when
+        # the param merely inherits the GLOBAL default_init —
+        # _finish_deferred_init then routes through the name-suffix
+        # dispatch (weight->init_weight, bias->zeros, ...). The reference
+        # instead resolves default_init into the InitDesc `__init__` attr,
+        # so a raw non-suffix name ('transitions') silently takes the
+        # global initializer there; here it raises 'Unknown initialization
+        # pattern'. The stricter behavior is intentional — an unmatched
+        # name fails loudly instead of training with a surprise init — and
+        # collapsing default_init into init here would also force e.g.
+        # Xavier onto a 1-d "bias" param. Pinned (as a divergence) by
+        # test_custom_named_parameter_init_dispatch.
         if not _shape_complete(self._shape):
             if self.allow_deferred_init:
                 self._deferred_init = (init, ctx, default_init, None)

@@ -34,7 +34,7 @@ class Trainer:
                 f"got {type(params)}.")
         self._params = []
         self._param2idx = {}
-        for i, param in enumerate(params):
+        for param in params:
             if not isinstance(param, Parameter):
                 raise ValueError(
                     "First argument must be a list or dict of Parameters, "
@@ -43,7 +43,13 @@ class Trainer:
             # blocks (2.x-style direct attributes, e.g. two "weight"s) and a
             # name-keyed table would silently collapse two params onto one
             # kvstore slot in multi-context/dist runs
-            self._param2idx[id(param)] = i
+            if id(param) in self._param2idx:
+                # the SAME Parameter passed twice (tied weights collected
+                # under two keys, or a duplicated list): register once — a
+                # second slot would double-apply its update and warn about
+                # a stale gradient on the first step
+                continue
+            self._param2idx[id(param)] = len(self._params)
             self._params.append(param)
             param._set_trainer(self)
         self._compression_params = compression_params

@@ -149,6 +149,13 @@ class BaseModule:
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False, sparse_row_id_fn=None):
+        """Run inference over an eval iterator (reference base_module.py).
+
+        This is the single-caller, iterator-driven path. For concurrent
+        request traffic (a server), use ``mxnet_tpu.serving`` — a
+        ``Module.as_predictor()`` behind a ``DynamicBatcher`` coalesces
+        callers into bucket-padded batches instead of recompiling or
+        serializing them here."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()

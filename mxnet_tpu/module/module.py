@@ -530,6 +530,17 @@ class Module(BaseModule):
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
 
+    def as_predictor(self, buckets=None, **kwargs):
+        """This module's trained weights behind a thread-safe
+        ``serving.Predictor``: per-bucket ``for_training=False`` executors,
+        compile-ahead ``warmup()``, and dynamic micro-batching when wrapped
+        in a ``serving.DynamicBatcher``. The Predictor takes COPIES of the
+        current parameters (``get_params``), so continuing to train this
+        module never mutates a live server."""
+        from ..serving import Predictor
+
+        return Predictor.from_module(self, buckets=buckets, **kwargs)
+
     def install_monitor(self, mon):
         assert self.binded
         mon.install(self._exec)

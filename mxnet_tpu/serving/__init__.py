@@ -1,0 +1,46 @@
+"""mxnet_tpu.serving — the inference serving subsystem.
+
+Everything before this package is training-side; this is the first layer
+driven by *concurrent callers* instead of a training loop. It turns a
+trained ``(symbol, params)`` checkpoint (or a bound ``Module``) into a
+thread-safe server:
+
+* :class:`Predictor` — ``for_training=False`` executors bound per
+  batch-size bucket (``MXNET_SERVING_BUCKETS``), requests padded up to
+  the smallest fitting bucket via ``io.pad_arrays``, every compile in ONE
+  named ``CompileCache("serving")``;
+* :class:`DynamicBatcher` — queues individual requests and coalesces them
+  into padded batches, flushing on max-batch or
+  ``MXNET_SERVING_MAX_WAIT_MS``, each caller getting exactly its own rows
+  back;
+* admission control — ``MXNET_SERVING_MAX_QUEUE`` bounds the queue
+  (synchronous :class:`QueueFullError` backpressure), per-request
+  deadlines (:class:`DeadlineExceededError`), graceful ``close()`` drain
+  (:class:`ServerClosedError` for new work), transient executor failures
+  retried with ``resilience.retry_call`` semantics but never past a
+  deadline;
+* :func:`warmup` — compile-ahead of every bucket so steady-state traffic
+  never pays a compile (exact count pinned by test);
+* telemetry — ``serving.*`` metrics: queue-depth gauge, batch-occupancy
+  histogram, time-in-queue / compute / end-to-end latency p50-p95-p99,
+  timeout + rejected counters, and the derived
+  ``serving.batch_fill_ratio`` (``tools/telemetry_report.py`` renders a
+  summary; ``docs/faq/perf.md`` explains how to size buckets from it).
+
+Quick start::
+
+    pred = serving.Predictor.load("model", data_shapes=[("data", (1, 3, 224, 224))])
+    serving.warmup(pred)                     # compile every bucket now
+    with serving.DynamicBatcher(pred) as srv:
+        fut = srv.submit(batch_of_rows, timeout=0.5)
+        probs = fut.result()
+"""
+from .admission import (AdmissionQueue, DeadlineExceededError, QueueFullError,
+                        Request, ServerClosedError, ServingError)
+from .batcher import DynamicBatcher
+from .predictor import Predictor, bucket_ladder
+from .warmup import warmup
+
+__all__ = ["Predictor", "DynamicBatcher", "AdmissionQueue", "Request",
+           "ServingError", "QueueFullError", "DeadlineExceededError",
+           "ServerClosedError", "bucket_ladder", "warmup"]

@@ -1,0 +1,257 @@
+"""Admission control: a bounded request queue with backpressure.
+
+A server that queues without bound does not degrade, it collapses — every
+request eventually times out after burning queue memory and compute on
+work nobody is waiting for. This module is the serving subsystem's intake
+valve:
+
+* **bounded depth** — :meth:`AdmissionQueue.put` fast-rejects with
+  :class:`QueueFullError` the moment ``MXNET_SERVING_MAX_QUEUE`` requests
+  are waiting. The caller learns *immediately* that the server is
+  saturated (and can shed load or retry elsewhere) instead of discovering
+  it via a timeout later.
+* **per-request deadlines** — a request carries an optional absolute
+  deadline; the batcher fails expired requests with
+  :class:`DeadlineExceededError` *before* spending compute on them, and
+  never retries a transient failure past the deadline.
+* **graceful drain** — :meth:`close` stops admission
+  (:class:`ServerClosedError` for new work) while
+  :meth:`get_batch` keeps handing out already-accepted requests until the
+  queue is empty, so shutdown completes every promise it admitted.
+
+The flush policy lives here too: :meth:`get_batch` blocks until whichever
+comes first of (a) enough queued rows to fill the largest batch bucket, or
+(b) the *oldest* queued request having waited ``max_wait`` — timing the
+flush from the oldest enqueue means a backlog never waits the full window
+again for each successive batch.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from .. import telemetry
+from ..base import MXNetError, getenv, register_env
+
+__all__ = ["AdmissionQueue", "Request", "ServingError", "QueueFullError",
+           "DeadlineExceededError", "ServerClosedError"]
+
+register_env("MXNET_SERVING_MAX_QUEUE", 1024,
+             "admission-queue depth bound: serving submit() fast-rejects "
+             "with QueueFullError once this many requests are waiting")
+
+
+class ServingError(MXNetError):
+    """Base class of serving-plane failures."""
+
+
+class QueueFullError(ServingError):
+    """Backpressure: the admission queue is at ``MXNET_SERVING_MAX_QUEUE``
+    requests. Raised synchronously from ``submit()`` — the cheap signal to
+    shed load now rather than time out later."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed before a result could be computed
+    (in queue, or between transient-failure retries — a retry is never
+    attempted past the deadline)."""
+
+
+class ServerClosedError(ServingError):
+    """``submit()`` after ``close()``: the server is draining/stopped."""
+
+
+class Request:
+    """One admitted inference request: input arrays plus delivery future.
+
+    ``deadline`` is an absolute ``time.monotonic()`` instant (or None);
+    ``enqueued_at`` is stamped at construction and drives both the flush
+    timer and the ``serving.time_in_queue_us`` histogram.
+
+    A request may be SPLIT at a batch boundary (``AdmissionQueue``
+    ``_split``) so that every max-batch flush is exactly full: the popped
+    head piece points back at the original via ``parent``/``offset`` and
+    the original is mutated down to its tail rows in place (keeping its
+    queue position, future and enqueue time). The batcher reassembles the
+    pieces by offset before resolving the future.
+    """
+
+    __slots__ = ("arrays", "rows", "future", "deadline", "enqueued_at",
+                 "parent", "offset", "total_rows", "parts")
+
+    def __init__(self, arrays, rows, future, deadline=None):
+        self.arrays = arrays
+        self.rows = int(rows)
+        self.future = future
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.parent = None          # set on split-off head pieces
+        self.offset = 0             # row offset within the original request
+        self.total_rows = self.rows  # original size (pieces keep parent's)
+        self.parts = None           # on the original: delivered pieces
+
+    @property
+    def origin(self):
+        """The request whose future this piece resolves (itself, unless
+        split off)."""
+        return self.parent if self.parent is not None else self
+
+
+class AdmissionQueue:
+    """Bounded FIFO of :class:`Request` with the batch-flush wait logic."""
+
+    def __init__(self, max_depth=None):
+        self._max_depth = int(getenv("MXNET_SERVING_MAX_QUEUE")
+                              if max_depth is None else max_depth)
+        if self._max_depth < 1:
+            raise MXNetError("serving queue depth must be >= 1, got "
+                             f"{self._max_depth}")
+        self._q = collections.deque()
+        self._rows = 0
+        self._cond = threading.Condition()
+        self._closed = False
+        # set (by the batcher, under its assist lock) while a blocking
+        # caller is draining inline: put() then skips the worker wakeup —
+        # the assistant will pop the request anyway, and a woken worker
+        # would only convoy with it on the GIL. The assistant kick()s the
+        # worker for anything it leaves behind.
+        self.assist_active = False
+
+    def __len__(self):
+        with self._cond:
+            return len(self._q)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    @property
+    def max_depth(self):
+        return self._max_depth
+
+    def put(self, req):
+        """Admit ``req`` or reject NOW (QueueFullError / ServerClosedError).
+        Never blocks — backpressure is a synchronous signal, not a stall."""
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError(
+                    "serving queue is closed; no new requests accepted")
+            if len(self._q) >= self._max_depth:
+                if telemetry._enabled:
+                    telemetry.counter("serving.rejected").inc()
+                raise QueueFullError(
+                    f"serving queue full ({len(self._q)} >= "
+                    f"{self._max_depth} requests); shed load or raise "
+                    "MXNET_SERVING_MAX_QUEUE")
+            self._q.append(req)
+            self._rows += req.rows
+            if telemetry._enabled:
+                telemetry.gauge("serving.queue_depth").set(len(self._q))
+            if not self.assist_active:
+                self._cond.notify()
+
+    def kick(self):
+        """Wake the worker (an exiting assistant calls this so requests it
+        left queued are not stranded behind a swallowed notify)."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def get_batch(self, max_rows, max_wait_s):
+        """Block until a flushable batch is ready and pop it.
+
+        Returns ``(requests, reason)`` with ``reason`` one of ``"full"``
+        (queued rows reached ``max_rows``), ``"timeout"`` (the oldest
+        request waited ``max_wait_s``) or ``"drain"`` (queue closed,
+        handing out the remainder) — or ``(None, None)`` once closed AND
+        empty, the worker's exit signal.
+
+        The pop is FIFO in row order: whole requests while they fit, and
+        the boundary request is SPLIT so a ``"full"`` flush carries
+        exactly ``max_rows`` rows (the tail piece keeps the head of the
+        queue, its future and its enqueue time). Oversize requests
+        (rows > max_rows) are consumed the same way, max_rows per batch.
+        Pieces whose future already resolved (an earlier piece failed)
+        are dropped unrun."""
+        with self._cond:
+            while True:
+                while not self._q and not self._closed:
+                    self._cond.wait()
+                if not self._q:
+                    return None, None  # closed and drained
+                if self._closed:
+                    reason = "drain"
+                elif self._rows >= max_rows:
+                    reason = "full"
+                else:
+                    remaining = (self._q[0].enqueued_at + max_wait_s
+                                 - time.monotonic())
+                    if remaining > 0:
+                        self._cond.wait(timeout=remaining)
+                        continue
+                    reason = "timeout"
+                out = self._pop(max_rows)
+                if out:
+                    return out, reason
+                # everything queued was already-failed pieces: wait again
+
+    def get_batch_nowait(self, max_rows):
+        """Non-blocking pop for an ASSISTING caller (a blocking
+        ``predict()`` that found the batcher idle runs batches inline
+        instead of paying two thread handoffs): whatever is queued right
+        now — reason ``"inline"`` (``"drain"`` once closed) — or
+        ``(None, None)`` when the queue is empty."""
+        with self._cond:
+            out = self._pop(max_rows)
+            if not out:
+                return None, None
+            return out, ("drain" if self._closed else "inline")
+
+    def _pop(self, max_rows):
+        """FIFO row-order pop under the held condition: whole requests
+        while they fit, the boundary request split at ``max_rows``."""
+        out, rows = [], 0
+        while self._q and rows < max_rows:
+            req = self._q[0]
+            if req.origin.future.done():
+                # an earlier piece already failed this request — don't
+                # burn compute on the rest of it
+                self._q.popleft()
+                self._rows -= req.rows
+                continue
+            if rows + req.rows <= max_rows:
+                self._q.popleft()
+                self._rows -= req.rows
+                rows += req.rows
+                out.append(req)
+            else:
+                k = max_rows - rows
+                out.append(self._split(req, k))
+                self._rows -= k
+                rows += k
+        if telemetry._enabled:
+            telemetry.gauge("serving.queue_depth").set(len(self._q))
+        return out
+
+    @staticmethod
+    def _split(req, k):
+        """Carve the first ``k`` rows of ``req`` into a piece pointing back
+        at the original; ``req`` keeps the tail in place (same future,
+        deadline and enqueue time — the flush timer still sees the
+        original age)."""
+        head = Request([a[0:k] for a in req.arrays], k, req.future,
+                       deadline=req.deadline)
+        head.enqueued_at = req.enqueued_at
+        head.parent = req.origin
+        head.offset = req.offset
+        head.total_rows = req.total_rows
+        req.arrays = [a[k:] for a in req.arrays]
+        req.rows -= k
+        req.offset += k
+        return head
+
+    def close(self):
+        """Stop admitting; wake every waiter so the worker can drain."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

@@ -1,0 +1,296 @@
+"""DynamicBatcher — coalesce concurrent requests into padded bucket batches.
+
+The economics of accelerator inference: one request of 3 rows and one of
+5 cost the same single dispatch as their 8-row union, so under concurrent
+traffic the scheduler's job is to *merge* callers, not interleave them.
+This batcher is the serving subsystem's scheduler:
+
+* callers ``submit()`` individual requests (any row count) and get a
+  ``concurrent.futures.Future``;
+* one worker thread pops rows FIFO from the
+  :class:`~mxnet_tpu.serving.admission.AdmissionQueue` when either enough
+  rows queue up to fill the largest bucket or the oldest request has
+  waited ``MXNET_SERVING_MAX_WAIT_MS`` — latency is bounded by *your own*
+  wait budget, throughput by how full the flush was
+  (``serving.batch_fill_ratio``). The request at the batch boundary is
+  SPLIT so a max-batch flush is exactly full (its tail keeps the queue
+  head); oversize requests stream through the same mechanism, max_batch
+  rows per flush;
+* the coalesced rows are concatenated, padded up to the smallest bucket
+  that fits (``io.pad_arrays``), computed ONCE, and sliced back per
+  request — pieces of a split request are reassembled in row order, so
+  each caller receives exactly its own rows.
+
+Failure semantics: expired requests are failed with
+:class:`DeadlineExceededError` *before* compute; transient executor errors
+(``Predictor.retry_on``, default ``OSError``) are retried with
+``resilience.retry_call`` backoff but NEVER past the earliest deadline in
+the batch; non-transient errors fail every request in the batch with the
+original exception. ``close()`` drains: admitted requests complete, new
+ones are rejected with :class:`ServerClosedError`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+from .. import ndarray as nd
+from .. import telemetry
+from ..base import getenv, register_env
+from ..log import get_logger
+from ..resilience import retry_call
+from .admission import AdmissionQueue, DeadlineExceededError, Request
+
+__all__ = ["DynamicBatcher"]
+
+register_env("MXNET_SERVING_MAX_WAIT_MS", 5.0,
+             "dynamic micro-batcher flush deadline: a queued request waits "
+             "at most this long for co-batchable traffic before its batch "
+             "is flushed short")
+
+
+class DynamicBatcher:
+    """Queue-and-coalesce front end over a :class:`Predictor`.
+
+    Parameters
+    ----------
+    predictor : Predictor
+        The bucket-bound engine; its largest bucket is the coalescing
+        target (``max_batch``).
+    max_wait_ms : float, optional
+        Flush deadline override (default ``MXNET_SERVING_MAX_WAIT_MS``).
+    max_queue : int, optional
+        Admission bound override (default ``MXNET_SERVING_MAX_QUEUE``).
+    retries / backoff_s :
+        Transient-failure retry budget handed to ``resilience.retry_call``
+        (what counts as transient is ``predictor.retry_on``).
+    """
+
+    def __init__(self, predictor, max_wait_ms=None, max_queue=None,
+                 retries=2, backoff_s=0.02):
+        self._predictor = predictor
+        wait_ms = (getenv("MXNET_SERVING_MAX_WAIT_MS")
+                   if max_wait_ms is None else max_wait_ms)
+        self._max_wait_s = float(wait_ms) / 1e3
+        self._max_batch = predictor.max_batch
+        self._admission = AdmissionQueue(max_queue)
+        self._retries = retries
+        self._backoff_s = backoff_s
+        self._logger = get_logger("mxnet_tpu.serving")
+        # one assisting caller at a time; piece reassembly of split
+        # requests is then reachable from two runner threads, so delivery
+        # state is guarded by _result_lock
+        self._assist = threading.Lock()
+        self._result_lock = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._loop, daemon=True, name="mxnet_tpu.serving.batcher")
+        self._worker.start()
+
+    # -- client API ----------------------------------------------------------
+
+    @property
+    def predictor(self):
+        return self._predictor
+
+    @property
+    def queue_depth(self):
+        return len(self._admission)
+
+    def submit(self, data, timeout=None):
+        """Enqueue one request; returns a Future resolving to the same
+        value ``predictor.predict(data)`` would. ``timeout`` (seconds)
+        sets the request deadline: expire in queue (or before a retry) and
+        the future fails with :class:`DeadlineExceededError`. Raises
+        :class:`QueueFullError` / :class:`ServerClosedError` synchronously.
+        Any row count is accepted — requests larger than the biggest
+        bucket stream through successive batches and reassemble."""
+        arrays = self._predictor._as_arrays(data)
+        n = int(arrays[0].shape[0])
+        deadline = (time.monotonic() + float(timeout)
+                    if timeout is not None else None)
+        return self._submit_one(arrays, n, deadline)
+
+    def predict(self, data, timeout=None):
+        """Blocking convenience: ``submit(...).result()`` — with
+        CALLER-RUNS assistance. A blocking caller that finds the assist
+        slot free drains queued batches inline (its own plus whatever
+        coalesced behind it) instead of paying two thread handoffs to the
+        worker; under tiny per-batch compute the handoffs, not the math,
+        dominate latency (the GIL hands off in multi-ms quanta). Async
+        ``submit()`` traffic keeps the worker + flush-window path."""
+        fut = self.submit(data, timeout=timeout)
+        if self._assist.acquire(blocking=False):
+            self._admission.assist_active = True
+            try:
+                while not fut.done():
+                    batch, reason = self._admission.get_batch_nowait(
+                        self._max_batch)
+                    if batch is None:
+                        break  # our request is mid-compute on the worker
+                    self._run_batch_guarded(batch, reason)
+            finally:
+                self._admission.assist_active = False
+                self._assist.release()
+                self._admission.kick()  # anything left is the worker's
+        return fut.result()
+
+    def warmup(self, buckets=None):
+        """Compile-ahead every bucket — see :func:`mxnet_tpu.serving.warmup`."""
+        from .warmup import warmup
+
+        return warmup(self._predictor, buckets=buckets)
+
+    def close(self, timeout=None):
+        """Graceful drain: stop admission, let the worker finish every
+        already-accepted request, join it. Idempotent."""
+        self._admission.close()
+        if self._worker.is_alive():
+            self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- worker --------------------------------------------------------------
+
+    def _submit_one(self, arrays, rows, deadline):
+        fut = Future()
+        self._admission.put(Request(arrays, rows, fut, deadline=deadline))
+        if telemetry._enabled:
+            telemetry.counter("serving.requests").inc()
+        return fut
+
+    def _loop(self):
+        while True:
+            batch, reason = self._admission.get_batch(
+                self._max_batch, self._max_wait_s)
+            if batch is None:
+                return
+            self._run_batch_guarded(batch, reason)
+
+    def _run_batch_guarded(self, batch, reason):
+        """_run_batch with the never-strand guarantee: an unexpected bug in
+        the batching/delivery path fails every popped future instead of
+        killing the worker — or, on the assist path, instead of leaking
+        batch-mates' futures (popped, so no one else would run them) while
+        the exception propagates to the one assisting caller."""
+        try:
+            self._run_batch(batch, reason)
+        except Exception as e:  # noqa: BLE001
+            for r in batch:
+                if not r.origin.future.done():
+                    self._fail(r, e)
+            self._logger.error("serving batch failed unexpectedly: %r", e)
+
+    def _fail(self, req, exc, timeout=False):
+        """Fail the request a piece belongs to (once — later pieces of a
+        split request are dropped unrun by the queue's done() check)."""
+        orig = req.origin
+        with self._result_lock:
+            if orig.future.done():
+                return
+            if telemetry._enabled:
+                telemetry.counter(
+                    "serving.timeouts" if timeout else "serving.errors").inc()
+            orig.future.set_exception(exc)
+
+    def _deliver(self, req, sliced, done_ts):
+        """Hand a computed piece its rows; a split request resolves once
+        every piece has arrived, reassembled in row order. Pieces may be
+        delivered by the worker AND an assisting caller, so the
+        accumulation is lock-guarded."""
+        orig = req.origin
+        with self._result_lock:
+            if orig.future.done():
+                return
+            if req.offset == 0 and req.rows == orig.total_rows:
+                orig.future.set_result(self._predictor._wrap_outputs(sliced))
+            else:
+                if orig.parts is None:
+                    orig.parts = []
+                orig.parts.append((req.offset, req.rows, sliced))
+                if sum(r for _, r, _ in orig.parts) < orig.total_rows:
+                    return
+                orig.parts.sort(key=lambda p: p[0])
+                merged = [nd.concatenate([p[2][k] for p in orig.parts],
+                                         axis=0)
+                          for k in range(len(sliced))]
+                orig.parts = None
+                orig.future.set_result(self._predictor._wrap_outputs(merged))
+            if telemetry._enabled:
+                telemetry.histogram("serving.e2e_us").record(
+                    (done_ts - orig.enqueued_at) * 1e6)
+
+    def _run_batch(self, reqs, reason):
+        tele = telemetry._enabled
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now >= r.deadline:
+                self._fail(r, DeadlineExceededError(
+                    f"request waited {now - r.enqueued_at:.3f}s in queue, "
+                    "past its deadline"), timeout=True)
+            elif not r.origin.future.done():
+                live.append(r)
+        if not live:
+            return
+        if tele:
+            for r in live:
+                telemetry.histogram("serving.time_in_queue_us").record(
+                    (now - r.enqueued_at) * 1e6)
+        rows = sum(r.rows for r in live)
+        bucket = self._predictor.bucket_for(rows)
+        feeds = []
+        for i in range(len(self._predictor.data_names)):
+            parts = [r.arrays[i] for r in live]
+            feeds.append(parts[0] if len(parts) == 1
+                         else nd.concatenate(parts, axis=0))
+        earliest = min((r.deadline for r in live if r.deadline is not None),
+                       default=None)
+
+        def attempt():
+            # a retry must never run past the batch's earliest deadline —
+            # DeadlineExceededError is not in retry_on, so raising it here
+            # ends the retry loop immediately
+            if earliest is not None and time.monotonic() >= earliest:
+                raise DeadlineExceededError(
+                    "deadline passed before a (re)try could run")
+            return self._predictor._run(bucket, feeds)
+
+        try:
+            outs = retry_call(attempt, desc=f"serving forward bucket={bucket}",
+                              retries=self._retries, backoff=self._backoff_s,
+                              retry_on=self._predictor.retry_on)
+        except DeadlineExceededError as e:
+            now = time.monotonic()
+            expired, rest = [], []
+            for r in live:
+                (expired if r.deadline is not None and now >= r.deadline
+                 else rest).append(r)
+            for r in expired:
+                self._fail(r, e, timeout=True)
+            if rest:
+                # survivors still have deadline budget: re-run without the
+                # expired requests (their rows no longer pad the batch)
+                self._run_batch(rest, reason)
+            return
+        except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
+            for r in live:
+                self._fail(r, e)
+            return
+        if tele:
+            telemetry.counter("serving.batches").inc()
+            telemetry.counter("serving.batch_rows").inc(rows)
+            telemetry.counter("serving.batch_slots").inc(bucket)
+            telemetry.counter(f"serving.flush_{reason}").inc()
+            telemetry.histogram("serving.batch_occupancy").record(rows)
+        off = 0
+        done_ts = time.monotonic()
+        for r in live:
+            sliced = [o[off:off + r.rows] for o in outs]
+            off += r.rows
+            self._deliver(r, sliced, done_ts)

@@ -1,0 +1,49 @@
+"""Compile-ahead warmup: pay every bucket's compile before traffic exists.
+
+A serving process that compiles lazily pays its XLA compile on the first
+unlucky *user* request of each bucket shape — seconds of p99 latency that
+look like an outage. Warmup runs a zeros batch through every bucket at
+startup, so the ``"serving"`` compile cache is fully populated before the
+first real request and steady state pays ZERO compiles (pinned by
+test_serving.py the way the fused-step PR pinned its padded-batch miss
+count). With ``MXNET_COMPILE_CACHE_DIR`` set, later processes deserialize
+these programs instead of rebuilding them — warmup then costs disk reads,
+not compiles.
+"""
+from __future__ import annotations
+
+import time
+
+from .. import telemetry
+from ..log import get_logger
+
+__all__ = ["warmup"]
+
+
+def warmup(target, buckets=None):
+    """Compile every bucket executable of ``target`` (a ``Predictor`` or a
+    ``DynamicBatcher``) ahead of traffic.
+
+    Returns ``{"buckets", "compiles", "seconds", "cache_entries"}`` —
+    ``compiles`` is the exact number of new programs built (cache-miss
+    delta), so a second call reports 0. ``serving.warmup_compiles`` rides
+    the telemetry registry when enabled.
+    """
+    pred = getattr(target, "predictor", target)
+    buckets = (pred.buckets if buckets is None
+               else tuple(sorted({int(b) for b in buckets})))
+    cache = pred.cache
+    misses0 = cache.misses
+    t0 = time.perf_counter()
+    for b in buckets:
+        pred.warm_bucket(b)
+    compiles = cache.misses - misses0
+    seconds = time.perf_counter() - t0
+    if telemetry._enabled:
+        telemetry.counter("serving.warmup_compiles").inc(compiles)
+    get_logger("mxnet_tpu.serving").info(
+        "serving warmup: %d bucket(s) -> %d compile(s) in %.2fs "
+        "(cache %r now holds %d executables)",
+        len(buckets), compiles, seconds, cache.name, len(cache))
+    return {"buckets": list(buckets), "compiles": compiles,
+            "seconds": seconds, "cache_entries": len(cache)}

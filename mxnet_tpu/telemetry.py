@@ -313,6 +313,13 @@ def snapshot():
         # low ratio at steady state = recompile churn (docs/faq/perf.md
         # "Reading compile-cache telemetry")
         out["derived"]["compile.cache_hit_ratio"] = hits / (hits + misses)
+    rows = out["counters"].get("serving.batch_rows", 0)
+    slots = out["counters"].get("serving.batch_slots", 0)
+    if slots > 0:
+        # real rows per padded batch slot — low fill means the bucket
+        # ladder or flush window is wasting compute on padding
+        # (docs/faq/perf.md "Sizing serving buckets")
+        out["derived"]["serving.batch_fill_ratio"] = rows / slots
     return out
 
 

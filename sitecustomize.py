@@ -30,6 +30,7 @@ Behavior:
 import os
 import signal
 import sys
+import time
 
 _AXON_SITE = "/root/.axon_site/sitecustomize.py"
 
@@ -48,7 +49,15 @@ def _load_axon():
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
         return
 
-    timeout = int(os.environ.get("MXNET_AXON_REGISTER_TIMEOUT", "120"))
+    try:
+        timeout = int(os.environ.get("MXNET_AXON_REGISTER_TIMEOUT", "120"))
+    except ValueError:
+        # a malformed value must degrade to the default, not silently skip
+        # loading the axon site for every process in the environment
+        print("[sitecustomize] malformed MXNET_AXON_REGISTER_TIMEOUT "
+              f"{os.environ.get('MXNET_AXON_REGISTER_TIMEOUT')!r}; "
+              "using 120s", file=sys.stderr)
+        timeout = 120
     # the exec'd code does `from axon.register import register`; that
     # package lives inside /root/.axon_site, which may sit BEHIND this
     # directory on sys.path (or be absent if PYTHONPATH was rewritten)
@@ -72,7 +81,11 @@ def _load_axon():
         raise _RegisterTimeout()
 
     old = signal.signal(signal.SIGALRM, _on_alarm)
-    signal.alarm(timeout)
+    armed_at = time.monotonic()
+    # signal.alarm returns the seconds REMAINING of any alarm the embedding
+    # process had already armed — that countdown must be restored below,
+    # not silently cancelled by our cleanup
+    prev_remaining = signal.alarm(timeout)
     try:
         exec(code, glb)
     except _RegisterTimeout:
@@ -86,6 +99,12 @@ def _load_axon():
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
+        if prev_remaining:
+            # re-arm the pre-existing countdown, less the time we consumed
+            # (floored at 1s: the embedder's deadline has effectively
+            # passed and should fire promptly, not be dropped)
+            elapsed = int(time.monotonic() - armed_at)
+            signal.alarm(max(1, prev_remaining - elapsed))
 
 
 _load_axon()

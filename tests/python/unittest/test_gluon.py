@@ -348,12 +348,16 @@ def test_direct_parameter_attribute_collected():
 
 
 def test_custom_named_parameter_init_dispatch():
-    """Reference parity (initializer suffix dispatch): a raw Parameter
-    whose name matches no weight/bias/... pattern must raise a CLEAR
-    error under a global initializer (the reference's 'Unknown
-    initialization pattern'), while a per-param init= applies regardless
-    of the name, and suffix-matched names route correctly (bias -> zeros
-    even under a global Xavier, which cannot init 1-d arrays)."""
+    """DELIBERATE DIVERGENCE from the reference (documented in
+    Parameter.initialize): the reference resolves a global default_init
+    into the InitDesc '__init__' attr, so a raw Parameter with a
+    non-suffix name ('transitions') silently takes the global
+    initializer. Here the global default stays on the name-suffix
+    dispatch, so that same param raises a CLEAR 'Unknown initialization
+    pattern' error instead of training with a surprise init. A per-param
+    init= still applies regardless of the name, and suffix-matched names
+    route correctly (bias -> zeros even under a global Xavier, which
+    cannot init 1-d arrays)."""
     p = gluon.Parameter("transitions", shape=(3, 3))
     with pytest.raises(Exception, match="[Uu]nknown|pattern"):
         p.initialize(default_init=mx.init.Xavier())
@@ -365,3 +369,66 @@ def test_custom_named_parameter_init_dispatch():
     b = gluon.Parameter("bias", shape=(4,))
     b.initialize(default_init=mx.init.Xavier())     # suffix -> zeros, no crash
     assert float(np.abs(b.data().asnumpy()).max()) == 0.0
+
+
+def test_collect_params_dedupes_shared_parameter():
+    """Tied weights (one Parameter held as a direct attribute on two
+    blocks, 2.x style) must collect exactly ONCE: two keys for the same
+    Parameter would register it twice in Trainer, which then warns about
+    a stale gradient on the first step and — with ignore_stale_grad —
+    double-applies the update with two separate momentum slots."""
+    class Leaf(gluon.Block):
+        def __init__(self, shared=None):
+            super().__init__()
+            self.w = shared if shared is not None \
+                else gluon.Parameter("tied_weight", shape=(2, 2))
+
+        def forward(self, x):
+            return mx.nd.dot(x, self.w.data())
+
+    class Net(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.enc = Leaf()
+            self.dec = Leaf(shared=self.enc.w)
+
+        def forward(self, x):
+            return self.dec(self.enc(x))
+
+    net = Net()
+    params = net.collect_params()
+    assert len([p for p in params.values() if p is net.enc.w]) == 1, \
+        sorted(params.keys())
+    ids = [id(p) for p in params.values()]
+    assert len(ids) == len(set(ids))
+
+    params.initialize(mx.init.Uniform(0.5))
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.1})
+    assert len(trainer._params) == len(ids)
+    w0 = net.enc.w.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = net(mx.nd.ones((3, 2))).sum()
+    loss.backward()
+    g = net.enc.w.grad().asnumpy().copy()
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the stale-grad warning must NOT fire
+        trainer.step(1)
+    # exactly ONE sgd update with the accumulated (enc+dec) gradient
+    np.testing.assert_allclose(net.enc.w.data().asnumpy(), w0 - 0.1 * g,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_trainer_dedupes_duplicate_parameter_list():
+    """Trainer itself also dedupes by identity — a duplicated list entry
+    (tied weights collected under two keys by older code, or a user
+    mistake) must not create two optimizer slots for one Parameter."""
+    p = gluon.Parameter("dup_weight", shape=(3,))
+    p.initialize(init="ones", ctx=[mx.cpu()])
+    trainer = gluon.Trainer([p, p], "sgd", {"learning_rate": 0.5})
+    assert len(trainer._params) == 1
+    with autograd.record():
+        (p.data() * 2.0).sum().backward()
+    trainer.step(1)
+    # one update: 1 - 0.5*2 = 0 (a double-apply would land at -1)
+    assert np.allclose(p.data().asnumpy(), np.zeros(3), atol=1e-6)

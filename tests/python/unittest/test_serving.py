@@ -1,0 +1,507 @@
+"""Inference serving subsystem: Predictor, DynamicBatcher, admission,
+compile-ahead warmup.
+
+Covers the serving PR end to end:
+* Predictor parity — checkpoint / Module construction paths, bucket
+  padding + oversize chunking, bit-exact vs the bound Module;
+* warmup compile pinning — exactly one compile per bucket, zero on
+  repeat, and the acceptance test: after warmup(), 1k mixed-size
+  concurrent requests cause ZERO new 'serving' compile-cache misses and
+  every response is bit-exact vs single-request eager predict;
+* dynamic micro-batching — N threads x M requests each get exactly their
+  own rows back, batch count bounded by ceil(total/max_batch) plus
+  timeout/drain flushes;
+* admission control — QueueFullError fast-reject, per-request deadlines
+  (in queue and across retries), graceful close() drain, transient
+  executor failures retried but never past a deadline;
+* telemetry — serving.* counters/histograms and the derived
+  serving.batch_fill_ratio, plus the tools/telemetry_report.py summary.
+
+Buckets here start at 2 on purpose: XLA:CPU lowers batch 1 to the vector
+codepath whose results can differ by 1 ulp from the batched (>=2) GEMM
+codepath, while buckets >=2 are bit-identical per row regardless of
+bucket size, row position or padding (verified empirically; see
+predictor.py's determinism note).
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.io.io import DataDesc
+from mxnet_tpu.serving import (DeadlineExceededError, DynamicBatcher,
+                               Predictor, QueueFullError, ServerClosedError)
+
+DIM, CLASSES = 8, 4
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _module(batch=4, seed=7):
+    mod = mx.mod.Module(_mlp_symbol())
+    mod.bind([DataDesc("data", (batch, DIM))],
+             [DataDesc("softmax_label", (batch,))], for_training=False)
+    mx.random.seed(seed)
+    mod.init_params(mx.init.Xavier())
+    return mod
+
+
+def _predictor(buckets=(2, 4, 8), **kwargs):
+    return _module().as_predictor(buckets=buckets, **kwargs)
+
+
+def _x(n, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, (n, DIM)).astype(np.float32)
+
+
+@pytest.fixture
+def tele():
+    """Telemetry on for the test, restored after (counters asserted as
+    DELTAS — the registry is process-global and shared with other suites)."""
+    prev = telemetry.enabled()
+    telemetry.enable()
+    yield telemetry
+    telemetry.enable(prev)
+
+
+def _counter(name):
+    m = telemetry.get(name)
+    return m.value if m is not None else 0
+
+
+# ---------------------------------------------------------------------------
+# Predictor
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_ladder_parsing(monkeypatch):
+    assert serving.bucket_ladder("1, 2,4") == (1, 2, 4)
+    assert serving.bucket_ladder([8, 2, 2, 4]) == (2, 4, 8)
+    monkeypatch.setenv("MXNET_SERVING_BUCKETS", "3,6")
+    assert serving.bucket_ladder() == (3, 6)
+    with pytest.raises(mx.MXNetError):
+        serving.bucket_ladder("2,nope")
+    with pytest.raises(mx.MXNetError):
+        serving.bucket_ladder([0, 2])
+
+
+def test_predictor_matches_module_bit_exact():
+    """Predictor at bucket==module batch runs the same program — outputs
+    are bitwise identical to the bound Module's."""
+    mod = _module(batch=4)
+    pred = mod.as_predictor(buckets=(2, 4, 8))
+    X = _x(4)
+    from mxnet_tpu.io.io import DataBatch
+
+    mod.forward(DataBatch([mx.nd.array(X)], [mx.nd.zeros((4,))]),
+                is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+    got = pred.predict(X).asnumpy()
+    assert np.array_equal(ref, got)
+
+
+def test_predictor_pads_and_chunks():
+    pred = _predictor(buckets=(2, 4))
+    X = _x(11, seed=3)
+    got = pred.predict(X)                      # chunks 4+4+3(pad to 4)
+    assert got.shape == (11, CLASSES)
+    per_row = np.concatenate(
+        [pred.predict(X[i:i + 2]).asnumpy() for i in range(0, 10, 2)]
+        + [pred.predict(X[10:11]).asnumpy()], axis=0)
+    assert np.allclose(got.asnumpy(), per_row, atol=1e-6)
+
+
+def test_predictor_load_checkpoint(tmp_path):
+    mod = _module()
+    prefix = str(tmp_path / "served")
+    arg_p, aux_p = mod.get_params()
+    mx.model.save_checkpoint(prefix, 3, mod.symbol, arg_p, aux_p)
+    pred = Predictor.load(prefix, data_shapes=[("data", (1, DIM))],
+                          buckets=(2, 4))
+    ref = mod.as_predictor(buckets=(2, 4))
+    X = _x(4, seed=5)
+    assert np.array_equal(pred.predict(X).asnumpy(),
+                          ref.predict(X).asnumpy())
+
+
+def test_predictor_missing_weight_raises():
+    mod = _module()
+    arg_p, aux_p = mod.get_params()
+    arg_p.pop("fc2_weight")
+    with pytest.raises(mx.MXNetError, match="fc2_weight"):
+        Predictor(mod.symbol, arg_p, aux_p,
+                  data_shapes=[("data", (1, DIM))], buckets=(2,))
+
+
+def test_predictor_missing_aux_raises():
+    """Aux states must be as loud as weights: binding zeros for a missing
+    BatchNorm moving_mean/var would serve silently wrong predictions."""
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=mx.sym.FullyConnected(
+        data, num_hidden=8, name="fc1"), name="bn")
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        bn, num_hidden=CLASSES, name="fc2"), name="softmax")
+    mod = mx.mod.Module(sym)
+    mod.bind([DataDesc("data", (4, DIM))], [DataDesc("softmax_label", (4,))],
+             for_training=False)
+    mod.init_params(mx.init.Xavier())
+    arg_p, aux_p = mod.get_params()
+    assert aux_p  # the model really has aux states
+    with pytest.raises(mx.MXNetError, match="aux"):
+        Predictor(mod.symbol, arg_p, {},
+                  data_shapes=[("data", (1, DIM))], buckets=(2,))
+    # with aux present it binds and serves
+    pred = Predictor(mod.symbol, arg_p, aux_p,
+                     data_shapes=[("data", (1, DIM))], buckets=(2,))
+    assert pred.predict(_x(2)).shape == (2, CLASSES)
+
+
+def test_predictor_request_validation():
+    pred = _predictor(buckets=(2, 4))
+    with pytest.raises(mx.MXNetError, match="0 rows"):
+        pred.predict(np.zeros((0, DIM), np.float32))
+    with pytest.raises(mx.MXNetError, match="trailing shape"):
+        pred.predict(np.zeros((2, DIM + 1), np.float32))
+
+
+def test_module_training_does_not_mutate_predictor():
+    """as_predictor snapshots the weights: further init/training on the
+    module must not change a live server's results."""
+    mod = _module()
+    pred = mod.as_predictor(buckets=(2,))
+    X = _x(2, seed=9)
+    before = pred.predict(X).asnumpy()
+    mx.random.seed(123)
+    mod.init_params(mx.init.Uniform(1.0), force_init=True)
+    assert np.array_equal(before, pred.predict(X).asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# Warmup / compile accounting
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_compiles_each_bucket_exactly_once():
+    pred = _predictor(buckets=(2, 4, 8))
+    assert pred.cache.misses == 0
+    summary = serving.warmup(pred)
+    assert summary["compiles"] == 3 and summary["cache_entries"] == 3
+    assert pred.cache.misses == 3
+    again = serving.warmup(pred)
+    assert again["compiles"] == 0
+    assert pred.cache.misses == 3
+    # a batcher warms up through the same ledger
+    with DynamicBatcher(pred, max_wait_ms=1) as srv:
+        assert srv.warmup()["compiles"] == 0
+
+
+def test_named_stats_aggregates_serving_cache():
+    from mxnet_tpu import compile_cache
+
+    pred = _predictor(buckets=(2, 4))
+    serving.warmup(pred)
+    s = compile_cache.named_stats("serving")
+    assert s["misses"] >= 2 and s["caches"] >= 1
+    assert set(s) == {"entries", "hits", "misses", "compile_seconds", "caches"}
+
+
+# ---------------------------------------------------------------------------
+# DynamicBatcher
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_single_request_roundtrip():
+    pred = _predictor(buckets=(2, 4, 8))
+    X = _x(3, seed=11)
+    ref = pred.predict(X).asnumpy()
+    with DynamicBatcher(pred, max_wait_ms=1) as srv:
+        got = srv.predict(X).asnumpy()
+    assert np.array_equal(ref, got)
+
+
+def test_batcher_oversize_request_gathered():
+    pred = _predictor(buckets=(2, 4))
+    X = _x(10, seed=13)
+    ref = pred.predict(X).asnumpy()            # eager chunks 4+4+2
+    with DynamicBatcher(pred, max_wait_ms=1) as srv:
+        got = srv.predict(X).asnumpy()
+    assert got.shape == (10, CLASSES)
+    assert np.array_equal(ref, got)
+
+
+def test_warmup_then_serve_zero_compiles_and_bit_exact(tele):
+    """THE acceptance test: after warmup(), 1k mixed-size requests across
+    all configured buckets cause ZERO new 'serving' compile-cache misses,
+    every response is bit-exact vs single-request eager predict, and the
+    batch count respects ceil(total_rows/max_batch) + non-full flushes."""
+    pred = _predictor(buckets=(2, 4, 8, 16))
+    serving.warmup(pred)
+    misses_after_warmup = pred.cache.misses
+    assert misses_after_warmup == 4
+
+    n_threads, per_thread = 8, 125             # 1000 requests
+    sizes = [1, 2, 3, 4, 5, 7, 8, 11, 16]
+    rng = np.random.RandomState(42)
+    payloads = [rng.uniform(-1, 1, (sizes[i % len(sizes)], DIM))
+                .astype(np.float32) for i in range(n_threads * per_thread)]
+    refs = [pred.predict(p).asnumpy() for p in payloads]
+    assert pred.cache.misses == misses_after_warmup  # eager predict: warm too
+    from mxnet_tpu import compile_cache
+
+    # the process-wide serving ledger counts OTHER live predictors too
+    # (earlier tests in the same process) — assert its delta, not absolute
+    ledger0 = compile_cache.named_stats("serving")["misses"]
+
+    batches0 = _counter("serving.batches")
+    to0 = _counter("serving.flush_timeout")
+    dr0 = _counter("serving.flush_drain")
+    results = [None] * len(payloads)
+    errors = []
+
+    with DynamicBatcher(pred, max_wait_ms=2, max_queue=4096) as srv:
+        def client(t):
+            base = t * per_thread
+            try:
+                futs = [(base + i, srv.submit(payloads[base + i]))
+                        for i in range(per_thread)]
+                for idx, f in futs:
+                    results[idx] = f.result(timeout=60).asnumpy()
+            except Exception as e:  # noqa: BLE001 — surface in main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    assert not errors, errors
+
+    # 1) zero steady-state compiles (telemetry-asserted, both ledgers)
+    assert pred.cache.misses == misses_after_warmup
+    assert compile_cache.named_stats("serving")["misses"] == ledger0
+
+    # 2) every caller got its own rows back, bit-exact vs eager predict
+    for got, ref in zip(results, refs):
+        assert got is not None
+        assert np.array_equal(got, ref)
+
+    # 3) coalescing actually happened: batch count is bounded by
+    #    ceil(total_rows / max_batch) plus the non-full (timeout/drain)
+    #    flushes, and strictly below one-batch-per-request
+    total_rows = sum(p.shape[0] for p in payloads)
+    batches = _counter("serving.batches") - batches0
+    non_full = (_counter("serving.flush_timeout") - to0) + \
+        (_counter("serving.flush_drain") - dr0)
+    assert batches <= -(-total_rows // pred.max_batch) + non_full
+    assert batches < len(payloads)
+
+
+def test_batcher_concurrent_threads_bit_exact(tele):
+    """The satellite concurrency test at a smaller scale with ragged
+    multi-row requests: N threads x M requests, every request's rows come
+    back bit-exact vs its own single-request predict."""
+    pred = _predictor(buckets=(2, 4, 8))
+    serving.warmup(pred)
+    n_threads, per_thread = 4, 20
+    rng = np.random.RandomState(1)
+    payloads = {}
+    for t in range(n_threads):
+        for i in range(per_thread):
+            payloads[(t, i)] = rng.uniform(
+                -1, 1, (1 + (t + i) % 8, DIM)).astype(np.float32)
+    refs = {k: pred.predict(v).asnumpy() for k, v in payloads.items()}
+    got = {}
+    lock = threading.Lock()
+    with DynamicBatcher(pred, max_wait_ms=1) as srv:
+        def client(t):
+            for i in range(per_thread):
+                out = srv.predict(payloads[(t, i)]).asnumpy()
+                with lock:
+                    got[(t, i)] = out
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    assert len(got) == n_threads * per_thread
+    for k, ref in refs.items():
+        assert np.array_equal(got[k], ref), k
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+
+class _Gate:
+    """Blocks Predictor._run until released — lets tests pile up a queue
+    behind a 'slow' compute."""
+
+    def __init__(self, pred):
+        self.event = threading.Event()
+        self.calls = 0
+        self._orig = pred._run
+        pred._run = self._run
+        self._pred = pred
+
+    def _run(self, bucket, arrays):
+        self.calls += 1
+        self.event.wait(10)
+        return self._orig(bucket, arrays)
+
+
+def test_queue_full_fast_reject(tele):
+    pred = _predictor(buckets=(1,))            # max_batch 1: no coalescing
+    serving.warmup(pred)
+    gate = _Gate(pred)
+    rej0 = _counter("serving.rejected")
+    srv = DynamicBatcher(pred, max_wait_ms=1, max_queue=3)
+    try:
+        first = srv.submit(_x(1))              # worker picks this up, blocks
+        deadline = time.monotonic() + 5
+        queued = []
+        # fill the queue (worker may drain one between submits — keep going)
+        with pytest.raises(QueueFullError):
+            while time.monotonic() < deadline:
+                queued.append(srv.submit(_x(1)))
+        assert _counter("serving.rejected") > rej0
+    finally:
+        gate.event.set()
+        srv.close()
+    assert first.result(timeout=10) is not None
+    for f in queued:                           # admitted work was drained
+        assert f.result(timeout=10) is not None
+
+
+def test_deadline_in_queue(tele):
+    pred = _predictor(buckets=(1,))
+    serving.warmup(pred)
+    gate = _Gate(pred)
+    to0 = _counter("serving.timeouts")
+    srv = DynamicBatcher(pred, max_wait_ms=1)
+    try:
+        blocked = srv.submit(_x(1))            # occupies the worker
+        doomed = srv.submit(_x(1), timeout=0.02)
+        time.sleep(0.1)                        # let the deadline pass
+    finally:
+        gate.event.set()
+        srv.close()
+    assert blocked.result(timeout=10) is not None
+    with pytest.raises(DeadlineExceededError):
+        doomed.result(timeout=10)
+    assert _counter("serving.timeouts") > to0
+
+
+def test_close_drains_then_rejects():
+    pred = _predictor(buckets=(2, 4))
+    serving.warmup(pred)
+    srv = DynamicBatcher(pred, max_wait_ms=50)  # long window: close must flush
+    futs = [srv.submit(_x(2, seed=i)) for i in range(5)]
+    srv.close()
+    for f in futs:
+        assert f.result(timeout=10).shape == (2, CLASSES)
+    with pytest.raises(ServerClosedError):
+        srv.submit(_x(2))
+    srv.close()                                # idempotent
+
+
+def test_transient_error_retried():
+    pred = _predictor(buckets=(2,))
+    serving.warmup(pred)
+    orig = pred._run
+    state = {"failures": 1, "calls": 0}
+
+    def flaky(bucket, arrays):
+        state["calls"] += 1
+        if state["failures"] > 0:
+            state["failures"] -= 1
+            import errno
+
+            raise OSError(errno.EIO, "injected transient executor failure")
+        return orig(bucket, arrays)
+
+    pred._run = flaky
+    X = _x(2, seed=21)
+    ref = orig(2, [mx.nd.array(X)])[0].asnumpy()
+    with DynamicBatcher(pred, max_wait_ms=1, backoff_s=0.01) as srv:
+        got = srv.predict(X).asnumpy()
+    assert state["calls"] == 2                 # one failure + one retry
+    assert np.array_equal(got, ref)
+
+
+def test_no_retry_past_deadline():
+    pred = _predictor(buckets=(2,))
+    serving.warmup(pred)
+    calls = {"n": 0}
+
+    def always_fails(bucket, arrays):
+        calls["n"] += 1
+        import errno
+
+        raise OSError(errno.EIO, "injected")
+
+    pred._run = always_fails
+    with DynamicBatcher(pred, max_wait_ms=1, retries=5, backoff_s=0.05) as srv:
+        fut = srv.submit(_x(2), timeout=0.02)
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=10)
+    # first attempt failed, deadline passed during backoff — NO retry ran
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_serving_telemetry_and_fill_ratio(tele):
+    pred = _predictor(buckets=(2, 4, 8))
+    serving.warmup(pred)
+    rows0 = _counter("serving.batch_rows")
+    slots0 = _counter("serving.batch_slots")
+    with DynamicBatcher(pred, max_wait_ms=1) as srv:
+        futs = [srv.submit(_x(3, seed=i)) for i in range(6)]
+        for f in futs:
+            f.result(timeout=10)
+    assert _counter("serving.requests") >= 6
+    assert _counter("serving.batch_rows") - rows0 == 18
+    assert _counter("serving.batch_slots") - slots0 >= 18
+    snap = telemetry.snapshot()
+    ratio = snap["derived"]["serving.batch_fill_ratio"]
+    assert 0 < ratio <= 1
+    for h in ("serving.time_in_queue_us", "serving.compute_us",
+              "serving.e2e_us", "serving.batch_occupancy"):
+        assert snap["histograms"][h]["count"] > 0, h
+    assert snap["gauges"]["serving.queue_depth"] == 0
+
+
+def test_telemetry_report_serving_summary(tele, tmp_path, capsys):
+    pred = _predictor(buckets=(2,))
+    serving.warmup(pred)
+    with DynamicBatcher(pred, max_wait_ms=1) as srv:
+        srv.predict(_x(2))
+    path = tmp_path / "snap.json"
+    path.write_text(telemetry.dumps())
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    try:
+        import telemetry_report
+    finally:
+        _sys.path.pop(0)
+    assert telemetry_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "serving:" in out and "fill ratio" in out

@@ -62,3 +62,41 @@ def test_register_crash_does_not_propagate(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv("JAX_PLATFORMS", "")
     guard._load_axon()                  # swallowed, warned
     assert "axon site failed" in capsys.readouterr().err
+
+
+def test_malformed_timeout_env_still_loads(tmp_path, monkeypatch, capsys):
+    """A malformed MXNET_AXON_REGISTER_TIMEOUT must degrade to the default
+    (warned), not crash int() before the guard and silently skip the axon
+    site for every process in the environment."""
+    guard = _load_guard()
+    marker = tmp_path / "ran"
+    fake = tmp_path / "fake_site.py"
+    fake.write_text(f"open({str(marker)!r}, 'w').write('ran')\n")
+    monkeypatch.setattr(guard, "_AXON_SITE", str(fake))
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("MXNET_AXON_REGISTER_TIMEOUT", "not-a-number")
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    guard._load_axon()
+    assert marker.exists()
+    assert "malformed MXNET_AXON_REGISTER_TIMEOUT" in capsys.readouterr().err
+
+
+def test_preexisting_alarm_rearmed(tmp_path, monkeypatch):
+    """The guard borrows SIGALRM; an embedding process's own alarm
+    countdown must be re-armed afterwards, not silently cancelled."""
+    import signal
+
+    guard = _load_guard()
+    fake = tmp_path / "fake_site.py"
+    fake.write_text("pass\n")
+    monkeypatch.setattr(guard, "_AXON_SITE", str(fake))
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("MXNET_AXON_REGISTER_TIMEOUT", "5")
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    signal.alarm(60)                    # the embedder's own countdown
+    try:
+        guard._load_axon()
+        remaining = signal.alarm(0)     # read-and-cancel what the guard left
+        assert 0 < remaining <= 60, remaining
+    finally:
+        signal.alarm(0)

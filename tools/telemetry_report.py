@@ -52,6 +52,25 @@ def main(argv=None):
         line += ("\n  (a hit ratio well below 1 at steady state means "
                  "recompile churn — docs/faq/perf.md)\n")
         sys.stdout.write(line)
+    req = counters.get("serving.requests", 0)
+    if req:
+        hists = snap.get("histograms", {})
+        derived = snap.get("derived", {})
+        batches = counters.get("serving.batches", 0)
+        line = f"\nserving: {req} requests in {batches} batches"
+        fill = derived.get("serving.batch_fill_ratio")
+        if fill is not None:
+            line += f", fill ratio {fill:.3f}"
+        e2e = hists.get("serving.e2e_us") or {}
+        if e2e.get("count"):
+            line += (f"; e2e p50 {e2e['p50'] / 1e3:.2f} ms"
+                     f" / p99 {e2e['p99'] / 1e3:.2f} ms")
+        line += (f"; timeouts {counters.get('serving.timeouts', 0)},"
+                 f" rejected {counters.get('serving.rejected', 0)}")
+        line += ("\n  (low fill ratio = padding waste - resize the bucket "
+                 "ladder or flush window, docs/faq/perf.md \"Sizing serving "
+                 "buckets\")\n")
+        sys.stdout.write(line)
     ts = snap.get("ts")
     if ts is not None:
         import datetime
