@@ -43,6 +43,11 @@ Env knobs:
       hung probe now costs seconds, not 15 minutes). The probe result is
       cached per process, so repeated probes are free. BENCH_PROBE_TIMEOUT
       (the old name) still wins when set.
+  MXNET_TPU_PROBE_CACHE=path  persist the probe verdict to a JSON file:
+      later processes reuse it without re-paying the probe (above all
+      without re-paying a TIMEOUT — BENCH_r05's >900s hang recurred in
+      EVERY process because the verdict died with each one). Delete the
+      file to force a re-probe.
 """
 import json
 import os
@@ -155,45 +160,147 @@ def _probe_timeout_s():
     return int(os.environ.get("MXNET_TPU_PROBE_TIMEOUT_S", "120"))
 
 
+# Phase-marked probe body: PHASE lines go to the (file-backed) stdout as
+# the child progresses, so a hang is attributable to import vs device init
+# vs compute even after the child is killed.
+_PROBE_BODY = """\
+import sys
+print("PHASE=import", flush=True)
+import jax, jax.numpy as jnp
+print("PHASE=device_init", flush=True)
+jax.devices()
+print("PHASE=compute", flush=True)
+v = jax.device_get(jnp.ones((8,8)) @ jnp.ones((8,8)))
+assert float(v[0,0]) == 8.0
+print("BACKEND=" + jax.default_backend(), flush=True)
+"""
+
+
+def _probe_disk_cache_path():
+    return os.environ.get("MXNET_TPU_PROBE_CACHE", "")
+
+
+def _probe_disk_load():
+    path = _probe_disk_cache_path()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return (rec.get("backend"), rec.get("error"))
+    except Exception:  # noqa: BLE001 — a corrupt cache just re-probes
+        return None
+
+
+def _probe_disk_store(backend, err, phase=None):
+    path = _probe_disk_cache_path()
+    if not path:
+        return
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"backend": backend, "error": err, "phase": phase,
+                       "written_at": time.time()}, f)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — the disk cache is an optimisation
+        pass
+
+
+def _run_probe_subprocess(timeout_s):
+    """One spawn-mode probe child in its own PROCESS GROUP, output to temp
+    files (no pipes). Returns (ok, error_str, phase).
+
+    Why not subprocess.run(capture_output=True, timeout=...): on timeout it
+    kills only the direct child, then blocks in a second communicate()
+    until the stdout/stderr pipes hit EOF — a TPU runtime's forked helpers
+    inherit those pipes and never close them, which is exactly how
+    BENCH_r05 hung >900s PAST the configured timeout. File-backed output
+    can always be read after a kill, and killpg takes the helpers down
+    with the child."""
+    import signal
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+") as fout, \
+            tempfile.TemporaryFile(mode="w+") as ferr:
+        proc = subprocess.Popen([sys.executable, "-c", _PROBE_BODY],
+                                stdout=fout, stderr=ferr,
+                                start_new_session=True)
+        timed_out = False
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:  # hard kill of the WHOLE group (child + runtime helpers)
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # unreapable; the group kill still freed the pipes
+        fout.seek(0)
+        out = fout.read()
+        phase = None
+        for line in out.splitlines():
+            if line.startswith("PHASE="):
+                phase = line.split("=", 1)[1].strip()
+        if timed_out:
+            return False, (f"backend probe hung (> {timeout_s}s) during "
+                           f"phase {phase or 'spawn'} "
+                           "(import vs device init vs compute)"), phase
+        if proc.returncode != 0:
+            ferr.seek(0)
+            errtxt = ferr.read().strip()
+            tail = errtxt.splitlines()[-1] if errtxt else "?"
+            return False, (f"backend probe failed during phase "
+                           f"{phase or 'spawn'}: {tail}"), phase
+        return True, None, phase
+
+
 def _probe_backend():
     """Initialise the backend defensively. Returns (backend_name, error_str).
 
-    The probe (init + one compile+execute+FETCH) runs in a SUBPROCESS with
-    a timeout first: a broken TPU backend can hang indefinitely, not just
-    raise, and the bench must still emit a number. The probe includes a
-    device_get so a tunnel that dispatches but cannot round-trip values is
-    detected here rather than mid-measurement. Only after the probe passes
-    is the backend initialised in this process. The verdict is cached per
-    process (`_probe_cache`)."""
-    import subprocess
-
+    The probe (import -> device init -> one compile+execute+FETCH) runs in
+    a throwaway subprocess in its own process group with a hard-kill
+    timeout: a broken TPU backend can hang indefinitely, not just raise,
+    and the bench must still emit a number. PHASE markers attribute a
+    wedge to import vs device init vs compute. The verdict is cached per
+    process (`_probe_cache`) and — when `MXNET_TPU_PROBE_CACHE` names a
+    file — on disk, so later processes skip the probe entirely."""
     global _probe_cache
     if _probe_cache is not None:
         return _probe_cache
 
-    def _cache(backend, err):
+    def _cache(backend, err, phase=None, store=True):
         global _probe_cache
         _probe_cache = (backend, err)
+        # a BENCH_FORCE_CPU child never writes the disk cache: its cpu
+        # verdict says nothing about the TPU backend, and storing it would
+        # clobber the failure verdict the parent just paid the probe for
+        if store and not _FORCE_CPU:
+            _probe_disk_store(backend, err, phase)
         return _probe_cache
 
     if not _FORCE_CPU:
-        probe = ("import jax, jax.numpy as jnp; "
-                 "v = jax.device_get(jnp.ones((8,8)) @ jnp.ones((8,8))); "
-                 "assert float(v[0,0]) == 8.0; "
-                 "print('BACKEND=' + jax.default_backend())")
+        disk = _probe_disk_load()
+        if disk is not None and disk[1] is not None:
+            # a cached FAILURE verdict skips straight to fallback
+            return _cache(disk[0], disk[1], store=False)
+        # no cached failure: pay the subprocess probe. A stored SUCCESS is
+        # deliberately NOT trusted across processes — the backend can wedge
+        # after the verdict was written, and the subprocess is the only
+        # hang-safe gate before the unprotected in-process init below (a
+        # success verdict on disk is diagnostics, not a skip)
         timeout_s = _probe_timeout_s()
         try:
-            out = subprocess.run([sys.executable, "-c", probe],
-                                 capture_output=True, text=True,
-                                 timeout=timeout_s)
-            if out.returncode != 0:
-                tail = out.stderr.strip().splitlines()[-1] if out.stderr.strip() else "?"
-                return _cache(None, f"backend probe failed: {tail}")
-        except subprocess.TimeoutExpired:
-            return _cache(None, f"backend probe hung (> {timeout_s}s)")
+            ok, err, phase = _run_probe_subprocess(timeout_s)
+            if not ok:
+                return _cache(None, err, phase)
         except Exception:  # noqa: BLE001
             return _cache(
-                None, traceback.format_exc(limit=2).strip().splitlines()[-1])
+                None,
+                traceback.format_exc(limit=2).strip().splitlines()[-1])
 
     import jax
 

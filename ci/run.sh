@@ -44,7 +44,8 @@ unit() {
       --ignore=tests/python/unittest/test_telemetry.py \
       --ignore=tests/python/unittest/test_fused_step.py \
       --ignore=tests/python/unittest/test_grad_sync.py \
-      --ignore=tests/python/unittest/test_serving.py
+      --ignore=tests/python/unittest/test_serving.py \
+      --ignore=tests/python/unittest/test_zero1.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
   # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
@@ -73,6 +74,12 @@ unit() {
   # batching, admission or warmup regression fails HERE, attributed
   log "serving suite (predictor parity, micro-batching, admission control, warmup compile pinning)"
   python -m pytest tests/python/unittest/test_serving.py -q
+  # zero1 gate, standalone: these tests flip MXNET_ZERO1/MXNET_ZERO1_NDEV
+  # and pin sharding invariance, 1/N state allocation, checkpoint
+  # round-trips and exact compile-cache miss counts — a sharded-update
+  # regression fails HERE, attributed
+  log "ZeRO-1 suite (sharded-vs-replicated update parity, 1/N state, checkpoint round-trip)"
+  python -m pytest tests/python/unittest/test_zero1.py -q
 }
 
 train() {
@@ -107,6 +114,28 @@ assert sweep["1MB"]["buckets"] < sweep["per_key"]["buckets"], sweep
 print("grad-sync smoke OK:", {k: v["buckets"] for k, v in sweep.items()})
 PY
   rm -f /tmp/ci_grad_sync_bw.jsonl
+
+  log "ZeRO-1 sharded-update smoke (8 virtual devices, measure.py --zero1)"
+  # weight-update sharding regressions fail fast without TPUs: the sweep
+  # must complete with ulp-level exactness vs the unsharded flat update
+  # and the MEASURED per-replica state bytes must be 1/N of replicated
+  env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      timeout 600 python tools/bandwidth/measure.py \
+      --network mobilenet0.25 --image-shape 3,32,32 --num-classes 10 \
+      --ndev 8 --kv-store device --num-batches 1 --test-results 0 \
+      --zero1 2,4 --json-out /tmp/ci_zero1_bw.jsonl
+  python - <<'PY'
+import json
+rec = json.loads(open("/tmp/ci_zero1_bw.jsonl").read().strip().splitlines()[-1])
+sweep = rec["zero1_sweep"]
+assert set(sweep) == {"2", "4"}, sweep
+for n, r in sweep.items():
+    assert r["error_vs_unsharded"] < 1e-5, (n, r)
+    assert abs(r["state_ratio"] - 1.0 / int(n)) < 0.01, (n, r)
+print("zero1 smoke OK:", {n: (r["state_ratio"], r["error_vs_unsharded"])
+                          for n, r in sweep.items()})
+PY
+  rm -f /tmp/ci_zero1_bw.jsonl
 
   log "bench smoke (CPU, reduced steps)"
   # fresh compile cache: XLA:CPU AOT entries are machine-feature-pinned,

@@ -10,7 +10,15 @@ the 'dist_tpu_sync' kvstore allreduces over ICI/DCN — `update_on_kvstore`
 is forced False there (no server processes exist; the reference's
 server-side optimizer `kvstore_dist_server.h:346` maps to
 allreduce-then-local-update, the Horovod-style flow the reference itself
-uses at `gluon/trainer.py:327`)."""
+uses at `gluon/trainer.py:327`).
+
+ZeRO-1 (`MXNET_ZERO1=1`): the aggregated updater call `step()` makes per
+context rides `Updater._zero1_call` — the optimizer state lives dp-SHARDED
+in flat buckets (1/N per replica, `parallel/zero1.py`) and the update runs
+on each replica's shard, allgathered back into the full weights.
+`save_states`/`load_states` stay transparent: the updater gathers shards
+into ordinary per-parameter states before pickling and re-shards on load.
+"""
 from __future__ import annotations
 
 from .. import optimizer as opt

@@ -117,6 +117,19 @@ class KVStoreBase:
         block via `GradSync.drain`)."""
         raise NotImplementedError
 
+    def reduce_scatter_flat(self, value, num_shards, shard_index,
+                            priority=0):
+        """The ZeRO-1 sibling of :meth:`allreduce_flat`: reduce one flat
+        bucket across replicas/workers but hand back only shard
+        ``shard_index`` of ``num_shards`` equal slices (the bucket length
+        must be divisible — pad with `parallel.pad_to_shards` first).
+        A native ring ReduceScatter is HALF the allreduce bytes ((N-1)/N·B
+        vs 2(N-1)/N·B), but the shipped eager implementations all reduce
+        the full bucket and slice locally — the wire saving is realized
+        only on the traced path (zero1.py's psum + sharding constraint,
+        lowered by XLA). Returns the reduced shard NDArray."""
+        raise NotImplementedError
+
     @property
     def fused_step_compatible(self):
         """Whether `Module.fused_step` may trace this store's gradient sync
@@ -237,6 +250,21 @@ class KVStoreLocal(KVStoreBase):
             telemetry.counter("kvstore.bucket_collectives").inc()
             telemetry.counter("kvstore.bucket_bytes").inc(_nd_nbytes(vals[0]))
         return _ctx_group_sum(vals)
+
+    def reduce_scatter_flat(self, value, num_shards, shard_index,
+                            priority=0):
+        """Local reduce-scatter: :meth:`allreduce_flat`'s whole-bucket
+        replica sum, sliced to one 1/num_shards shard host-side."""
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        vals = [v if isinstance(v, NDArray) else NDArray(v) for v in vals]
+        n = int(vals[0].shape[0])
+        if n % int(num_shards):
+            raise MXNetError(
+                f"reduce_scatter_flat: bucket length {n} not divisible "
+                f"into {num_shards} shards (pad with pad_to_shards first)")
+        step = n // int(num_shards)
+        lo = step * int(shard_index)
+        return self.allreduce_flat(vals, priority)[lo:lo + step]
 
     @property
     def fused_step_compatible(self):

@@ -88,7 +88,11 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
                    param_names=None):
     """Local updater path (parity model.py:162). The kvstore gradient
     allreduce rides the bucketed `GradSync` scheduler (overlapped
-    per-bucket collectives) unless `MXNET_GRAD_BUCKETING=0`."""
+    per-bucket collectives) unless `MXNET_GRAD_BUCKETING=0`. The
+    aggregated updater call below engages the ZeRO-1 sharded update when
+    `MXNET_ZERO1=1` (`Updater._zero1_call`); checkpointing through
+    `save_checkpoint` + updater `get_states` stays format-identical —
+    shards are gathered on save and re-sharded on load."""
     live = [i for i, (_, grad_list)
             in enumerate(zip(param_arrays, grad_arrays))
             if grad_list[0] is not None]

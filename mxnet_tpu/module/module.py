@@ -70,6 +70,8 @@ class Module(BaseModule):
         self._has_custom_op = None  # memoized graph scan (fused-step gate)
         self._fused_failed = False  # fused trace failed once — stay eager
         self._grad_sync = None  # bucketed gradient-sync scheduler (lazy)
+        self._zero1 = None  # ZeRO-1 sharded-update context (MXNET_ZERO1=1)
+        self._zero1_failed = False  # zero1 trace failed — stay replicated
 
     # -- properties ----------------------------------------------------------
 
@@ -419,6 +421,29 @@ class Module(BaseModule):
             return False
         feed = self._make_feed(data_batch)
         self._exec.set_args(**feed)
+        z1 = None
+        if not self._zero1_failed:
+            from ..parallel.zero1 import zero1_enabled
+
+            if zero1_enabled():
+                if self._zero1 is None:
+                    from ..parallel.zero1 import Zero1Context
+
+                    try:
+                        self._zero1 = Zero1Context()
+                    except Exception as e:  # noqa: BLE001 — bad mesh/env
+                        # (e.g. MXNET_ZERO1_NDEV > device count): same
+                        # graceful fallback as the Updater path
+                        self._zero1_failed = True
+                        self.logger.warning(
+                            "ZeRO-1 context unavailable (%r); using the "
+                            "replicated fused step", e)
+                z1 = self._zero1
+                if z1 is not None:
+                    # register on the updater: checkpoint save/load stays
+                    # transparent (get_states gathers shards, set_states
+                    # invalidates so the next step re-shards)
+                    self._updater._zero1 = z1
         gs_fn, gs_key = None, None
         if self._kvstore is not None:
             from ..parallel.grad_sync import bucket_cap_bytes
@@ -447,10 +472,24 @@ class Module(BaseModule):
         try:
             self._exec.fused_step(self._optimizer, self._updater,
                                   self._param_names,
-                                  grad_sync_fn=gs_fn, grad_sync_key=gs_key)
+                                  grad_sync_fn=gs_fn, grad_sync_key=gs_key,
+                                  zero1=z1)
         except MXNetError:
             raise  # donation failure / graph error the eager path shares
         except Exception as e:
+            if z1 is not None:
+                # the ZeRO-1 trace failed with buffers intact: retry THIS
+                # step on the replicated fused path (still fused), and stay
+                # replicated from now on. The ctx stays registered on the
+                # updater — its ensure_states hook gathers any dirty
+                # shards from earlier sharded steps before the replicated
+                # path consumes per-parameter states
+                self._zero1_failed = True
+                self._zero1 = None
+                self.logger.warning(
+                    "ZeRO-1 sharded step failed to build (%r); falling "
+                    "back to the replicated fused step", e)
+                return self.fused_step(data_batch)
             # trace/compile failure with buffers intact (Executor.fused_step
             # already restored the update counts): run this and all later
             # steps on the eager decomposition

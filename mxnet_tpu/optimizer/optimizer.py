@@ -171,6 +171,19 @@ class Optimizer:
         silently reusing a stale executable."""
         return (type(self).__name__, self.clip_gradient, self.multi_precision)
 
+    def fused_state_init(self, w32, dtype):
+        """Fresh optimizer state for ONE flat weight bucket of ``dtype``,
+        as the tree :meth:`fused_update` expects for a single parameter —
+        the traceable rendering of :meth:`create_state_multi_precision`
+        over a packed bucket. ``w32`` is the fp32 cast of the bucket (the
+        master copy under multi-precision). Used by the ZeRO-1 sharded
+        update (`parallel/zero1.py`), which jits this with a dp-sharded
+        output layout so only 1/N of the state ever materializes per
+        replica; optimizers without it fall back to the replicated path."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no fused flat-state init; the "
+            "caller must fall back to the replicated update")
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise UserWarning("LRScheduler of the optimizer has already been defined. "
@@ -443,6 +456,18 @@ class SGD(Optimizer):
     def _fused_static_key(self):
         return super()._fused_static_key() + (self.momentum,)
 
+    def fused_state_init(self, w32, dtype):
+        """Flat-bucket state matching create_state_multi_precision: mp ->
+        (momentum|None in fp32, master); else momentum|None in weight
+        dtype."""
+        import jax.numpy as jnp
+
+        mp = self.multi_precision and _is_low_precision(dtype)
+        mom = None
+        if self.momentum != 0.0:
+            mom = jnp.zeros_like(w32, dtype=jnp.float32 if mp else dtype)
+        return (mom, w32) if mp else mom
+
     def fused_update(self, weights, grads, states, lrs, wds, rescale_grad):
         """Mirrors sgd_update / sgd_mom_update / mp_sgd_* (optimizer_ops.py)
         over the whole parameter list: fp32 math, results cast back."""
@@ -640,6 +665,17 @@ class NAG(Optimizer):
     def _fused_static_key(self):
         return super()._fused_static_key() + (self.momentum,)
 
+    def fused_state_init(self, w32, dtype):
+        """Like SGD's, but NAG's multi-precision check is fp16-only
+        (parity :1031)."""
+        import jax.numpy as jnp
+
+        mp = self.multi_precision and numpy.dtype(dtype) == numpy.float16
+        mom = None
+        if self.momentum != 0.0:
+            mom = jnp.zeros_like(w32, dtype=jnp.float32 if mp else dtype)
+        return (mom, w32) if mp else mom
+
     def fused_update(self, weights, grads, states, lrs, wds, rescale_grad):
         """Mirrors nag_mom_update / mp_nag_mom_update / sgd_update."""
         import jax.numpy as jnp
@@ -745,6 +781,18 @@ class Adam(Optimizer):
     def _fused_static_key(self):
         return super()._fused_static_key() + (self.beta1, self.beta2,
                                               self.epsilon)
+
+    def fused_state_init(self, w32, dtype):
+        """Flat-bucket state matching the base-class multi-precision
+        convention: mp -> (master, (mean, var) in fp32); else (mean, var)
+        in weight dtype."""
+        import jax.numpy as jnp
+
+        mp = self.multi_precision and _is_low_precision(dtype)
+        sd = jnp.float32 if mp else dtype
+        mean = jnp.zeros_like(w32, dtype=sd)
+        var = jnp.zeros_like(w32, dtype=sd)
+        return (w32, (mean, var)) if mp else (mean, var)
 
     def _fused_hyperparams(self, indices):
         """Bias correction applied host-side in float64 — bit-identical to
@@ -1233,11 +1281,21 @@ class Updater:
         # set after a fused trace/compile failure: stop re-paying the
         # failed trace every step and stay on the eager loop
         self._fused_disabled = False
+        # ZeRO-1 sharded-update context (parallel/zero1.py): owns the
+        # dp-sharded flat optimizer state when MXNET_ZERO1=1
+        self._zero1 = None
+        self._zero1_failed = False
 
     def ensure_states(self, indices, weights):
         """Create (or context-sync) the optimizer state for each index —
         the lazy-creation half of ``__call__``, callable on its own by the
         fused train step (which needs the states before tracing)."""
+        z1 = getattr(self, "_zero1", None)
+        if z1 is not None and z1.dirty:
+            # a sharded run handing over to an eager/replicated step (or a
+            # checkpoint save): gather the shards into the per-parameter
+            # states FIRST, or this path would consume stale ones
+            z1.export_to_updater(self)
         for i, idx in enumerate(indices):
             if idx not in self.states:
                 self.states[idx] = self.optimizer.create_state_multi_precision(
@@ -1257,9 +1315,9 @@ class Updater:
             indices = index
             grads = grad
             weights = weight
-        self.ensure_states(indices, weights)
         if len(indices) > 1 and self._fused_call(indices, grads, weights):
             return
+        self.ensure_states(indices, weights)
         if self.aggregate_updates and len(indices) > 1:
             self._aggregated_update(indices, grads, weights)
             return
@@ -1284,9 +1342,19 @@ class Updater:
                for g, w in zip(grads, weights)):
             return False
 
+        from ..parallel.zero1 import zero1_enabled
+
+        if zero1_enabled() and not getattr(self, "_zero1_failed", False):
+            took = self._zero1_call(indices, grads, weights)
+            if took is not None:
+                return took
+            # zero1 declined (unsupported optimizer / trace failure with
+            # buffers intact): fall through to the replicated fused path
+
         import jax
         import jax.numpy as jnp
 
+        self.ensure_states(indices, weights)
         count_snap = _snapshot_counts(opt, indices)
         opt._update_count(indices)
         try:
@@ -1343,6 +1411,86 @@ class Updater:
             _state_writeback(s, ns)
         return True
 
+    def _zero1_call(self, indices, grads, weights):
+        """ZeRO-1 variant of :meth:`_fused_call` (`MXNET_ZERO1=1`): ONE
+        jitted program whose weight update runs on each replica's 1/N
+        shard of the flat parameter buckets with 1/N optimizer state
+        (`parallel/zero1.py`), weights allgathered back replicated.
+        Returns True when taken, None to fall through to the replicated
+        fused path (buffers intact)."""
+        import jax.numpy as jnp
+
+        from ..parallel.zero1 import Zero1Context
+
+        opt = self.optimizer
+        if self._zero1 is None:
+            try:
+                self._zero1 = Zero1Context()
+            except Exception as e:  # noqa: BLE001 — bad mesh/env (e.g.
+                # MXNET_ZERO1_NDEV > device count): no buffer was touched,
+                # stay on the replicated fused path
+                self._zero1_failed = True
+                logging.getLogger("mxnet_tpu.optimizer").warning(
+                    "ZeRO-1 context unavailable (%r); falling back to the "
+                    "replicated fused update", e)
+                return None
+        ctx = self._zero1
+        count_snap = _snapshot_counts(opt, indices)
+        opt._update_count(indices)
+        try:
+            lrs, wds = opt._fused_hyperparams(indices)
+            ctx.ensure(opt, self, indices, weights)
+            key = ("zero1", ctx.key(), opt._fused_static_key(),
+                   tuple((w._data.shape, w._data.dtype) for w in weights),
+                   tuple((g._data.shape, g._data.dtype) for g in grads))
+
+            def build():
+                import jax
+
+                from ..compile_cache import trace_salt
+
+                def step(ws, gs, flat, lrs_, wds_, rescale):
+                    return ctx.traced_update(opt, list(ws), list(gs), flat,
+                                             lrs_, wds_, trace_salt(rescale))
+
+                return jax.jit(step, donate_argnums=(0, 2))
+
+            fn = _updater_cache().get_or_build(key, build, persistent=False)
+            new_ws, new_flat = fn(
+                [ctx.put_replicated(w._data) for w in weights],
+                [ctx.put_replicated(g._data) for g in grads],
+                ctx.flat_states,
+                ctx.put_replicated(jnp.asarray(lrs, jnp.float32)),
+                ctx.put_replicated(jnp.asarray(wds, jnp.float32)),
+                ctx.put_replicated(jnp.float32(opt.rescale_grad)))
+        except Exception as e:
+            from jax import tree_util as jtu
+
+            # the sharded flat state was donated too — and it is the ONLY
+            # copy once dirty, so a consumed state buffer is just as fatal
+            # as a consumed weight
+            donated = [w._data for w in weights]
+            donated += jtu.tree_leaves(ctx.flat_states or [])
+            if _any_donated_deleted(donated):
+                raise MXNetError(
+                    "ZeRO-1 fused update failed mid-execution; weight/"
+                    "state buffers were donated and may be invalidated — "
+                    "restore from the last checkpoint before continuing "
+                    f"({e!r})") from e
+            # trace/compile failed before any buffer was consumed: undo the
+            # count bump and let the replicated fused path take the step
+            _restore_counts(opt, count_snap)
+            self._zero1_failed = True
+            logging.getLogger("mxnet_tpu.optimizer").warning(
+                "ZeRO-1 sharded update failed to build (%r); falling back "
+                "to the replicated fused update", e)
+            return None
+        for w, nw in zip(weights, new_ws):
+            w._data = nw
+        ctx.flat_states = new_flat
+        ctx.dirty = True
+        return True
+
     def _aggregated_update(self, indices, grads, weights):
         """Group same-dtype dense updates into multi_sgd_*-sized chunks
         (parity optimizer.py:1637-1664: the aggregate_updates branch of
@@ -1383,15 +1531,26 @@ class Updater:
         return state
 
     def set_states(self, states):
-        """Set updater states from serialized bytes."""
+        """Set updater states from serialized bytes. A live ZeRO-1 context
+        is invalidated so the next sharded step re-shards the LOADED
+        per-parameter states instead of keeping pre-load shards."""
         states = pickle.loads(states)
         if isinstance(states, tuple) and len(states) == 2:
             self.states, self.optimizer = states
         else:
             self.states = states
         self.states_synced = dict.fromkeys(self.states.keys(), False)
+        z1 = getattr(self, "_zero1", None)
+        if z1 is not None:
+            z1.invalidate()
 
     def get_states(self, dump_optimizer=False):
+        """Serialized states. Under ZeRO-1 the shards are gathered back
+        into ordinary per-parameter states first (checkpoints stay
+        store-format-identical to replicated runs; loading re-shards)."""
+        z1 = getattr(self, "_zero1", None)
+        if z1 is not None and z1.dirty:
+            z1.export_to_updater(self)
         return pickle.dumps((self.states, self.optimizer) if dump_optimizer
                             else self.states)
 

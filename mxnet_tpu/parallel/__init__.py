@@ -16,18 +16,24 @@ Axes convention (any subset may be present, size 1 axes are free):
 """
 from .mesh import (
     MeshSpec, create_mesh, default_mesh, current_mesh, use_mesh, local_mesh,
+    dp_mesh, mesh_from_env, axis_size, has_axis,
     AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_PP, AXIS_EP,
 )
 from .collectives import (
     all_reduce, all_gather, reduce_scatter, ppermute, barrier, psum_scatter,
+    sharding_constraint,
 )
 from .dist import (
     init_process_group, process_rank, process_count, device_count,
     KVStoreDistTPUSync,
 )
 from .grad_sync import GradSync, bucket_assign, bucketing_enabled
+from .zero1 import Zero1Context, zero1_enabled
 from .data_parallel import ShardedTrainer, shard_batch, replicate
-from .partition import PartitionRules, infer_param_sharding
+from .partition import (
+    PartitionRules, infer_param_sharding, replicated, flat_shard,
+    pad_to_shards,
+)
 from .ring_attention import ring_attention, ring_self_attention
 from .pipeline import pipeline_step
 from .launcher import initialize_from_env
@@ -41,8 +47,12 @@ __all__ = [
     "init_process_group", "process_rank", "process_count", "device_count",
     "KVStoreDistTPUSync",
     "GradSync", "bucket_assign", "bucketing_enabled",
+    "Zero1Context", "zero1_enabled",
     "ShardedTrainer", "shard_batch", "replicate",
-    "PartitionRules", "infer_param_sharding",
+    "PartitionRules", "infer_param_sharding", "replicated", "flat_shard",
+    "pad_to_shards",
+    "dp_mesh", "mesh_from_env", "axis_size", "has_axis",
+    "sharding_constraint",
     "ring_attention", "ring_self_attention",
     "pipeline_step",
     "initialize_from_env",

@@ -71,6 +71,16 @@ def reduce_scatter(x, axis_name, axis=0):
 psum_scatter = reduce_scatter
 
 
+def sharding_constraint(x, sharding):
+    """Version-stable `with_sharding_constraint` — the GSPMD annotation the
+    sharded-weight-update paper (arXiv:2004.13336) is built on: a psum
+    followed by a constraint to a sharded layout lowers to ReduceScatter,
+    a constraint from sharded back to replicated lowers to AllGather."""
+    from jax import lax as _lax
+
+    return _lax.with_sharding_constraint(x, sharding)
+
+
 def ppermute(x, axis_name, perm):
     """Point-to-point ring shift; the building block of ring attention."""
     return lax.ppermute(x, axis_name, perm)

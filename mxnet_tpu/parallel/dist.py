@@ -575,6 +575,29 @@ class KVStoreDistTPUSync(KVStoreBase):
         reduced = _allreduce_sum(buf)
         return NDArray(reduced.astype(dtype))
 
+    def reduce_scatter_flat(self, value, num_shards, shard_index,
+                            priority=0):
+        """Reduce-scatter across workers — the ZeRO-1 eager wire primitive
+        next to `allreduce_flat`: each worker gets back only its
+        1/num_shards slice of the cross-worker sum. This eager lane always
+        ships the FULL allreduce bytes and slices host-side after the
+        collective (gloo has no reduce-scatter primitive); the true
+        (N-1)/N·B ReduceScatter exists only on the traced path, where XLA
+        lowers zero1.py's psum + sharding constraint onto ICI."""
+        from ..base import MXNetError
+        from ..ndarray import NDArray
+
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        n = int(vals[0].shape[0])
+        if n % int(num_shards):
+            raise MXNetError(
+                f"reduce_scatter_flat: bucket length {n} not divisible "
+                f"into {num_shards} shards (pad with pad_to_shards first)")
+        step = n // int(num_shards)
+        lo = step * int(shard_index)
+        merged = self.allreduce_flat(value, priority)
+        return NDArray(merged._data[lo:lo + step])
+
     @property
     def fused_step_compatible(self):
         """The fused train step may trace this store's gradient sync when
@@ -592,7 +615,14 @@ class KVStoreDistTPUSync(KVStoreBase):
         instead of falling back to eager whenever a kvstore is attached.
         With one process the sum over the replica group is the identity,
         but the bucket pack/reduce/unpack structure stays in the trace, so
-        the wire dtype and key→bucket layout match the eager path exactly."""
+        the wire dtype and key→bucket layout match the eager path exactly.
+
+        ZeRO-1 composition (`MXNET_ZERO1=1`): the sharded update
+        (`parallel/zero1.py`) runs downstream of this sync in the same
+        trace and immediately re-constrains each bucket to the dp-sharded
+        layout — XLA fuses the cross-replica sum + sharded constraint into
+        ONE ReduceScatter (the reduce-scatter variant of this allreduce,
+        arXiv:2004.13336), so no second wire pass is paid."""
         if not self.fused_step_compatible:
             return None
         from .grad_sync import bucket_assign, bucket_cap_bytes

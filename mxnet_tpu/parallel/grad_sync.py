@@ -35,9 +35,12 @@ This module is the gradient-sync scheduler that fixes it:
   per-key push/pull path in every caller; `tests/python/unittest/
   test_grad_sync.py` pins bucketed == per-key bit-exactly on fp32.
 
-The optional reduce-scatter refinement (shard the update itself, PAPERS.md
-arxiv 2004.13336) composes with this layout: a bucket's flat buffer is the
-natural reduce-scatter operand.
+The reduce-scatter refinement (shard the update itself, PAPERS.md arxiv
+2004.13336) is implemented on top of this layout by `parallel/zero1.py`
+(`MXNET_ZERO1=1`): a bucket's flat buffer is the reduce-scatter operand,
+the optimizer update runs on each replica's 1/N slice, and
+`KVStore.reduce_scatter_flat` is the eager wire primitive next to
+`allreduce_flat`.
 """
 from __future__ import annotations
 

@@ -17,6 +17,13 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+from ..base import getenv, register_env
+
+register_env("MXNET_MESH_SHAPE", "",
+             "default device-mesh shape as 'axis=size' pairs, e.g. "
+             "'dp=4,tp=2' ('-1' once absorbs the rest); empty = 1-D dp "
+             "mesh over every device")
+
 AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
@@ -76,17 +83,86 @@ def local_mesh(**axes):
     return create_mesh(devices=jax.local_devices(), **(axes or {"dp": -1}))
 
 
+def dp_mesh(ndev=None, devices=None):
+    """1-D data-parallel mesh over the first ``ndev`` devices (all when
+    None/0) — the ZeRO-1 update shard group and the plain-DP default."""
+    devices = list(devices if devices is not None else jax.devices())
+    if ndev:
+        if ndev > len(devices):
+            raise ValueError(f"dp_mesh(ndev={ndev}) but only "
+                             f"{len(devices)} devices are available")
+        devices = devices[:ndev]
+    return create_mesh(devices=devices, dp=-1)
+
+
+def mesh_from_env():
+    """Mesh described by ``MXNET_MESH_SHAPE`` ('dp=4,tp=2'), or None.
+    A fully-fixed shape smaller than the host's device count takes the
+    FIRST matching devices (a '-1' axis absorbs the rest instead)."""
+    spec = str(getenv("MXNET_MESH_SHAPE") or "").strip()
+    if not spec:
+        return None
+    axes = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue  # tolerate trailing/doubled commas
+        name, eq, size = part.partition("=")
+        name = name.strip()
+        try:
+            if not eq or not name:
+                raise ValueError
+            axes[name] = int(size)
+        except ValueError:
+            raise ValueError(
+                "MXNET_MESH_SHAPE: expected 'axis=size' pairs like "
+                f"'dp=4,tp=2', got {part!r} in {spec!r}") from None
+    if not axes:
+        return None
+    devices = list(jax.devices())
+    if -1 not in axes.values():
+        total = int(np.prod(list(axes.values())))
+        if total < len(devices):
+            devices = devices[:total]
+    return create_mesh(devices=devices, **axes)
+
+
 def default_mesh():
-    """The ambient mesh: the entered one, else a 1-D dp mesh over all
-    devices (cached)."""
+    """The ambient mesh: the entered one, else ``MXNET_MESH_SHAPE``, else a
+    1-D dp mesh over all devices (cached)."""
     m = current_mesh()
     if m is not None:
         return m
+    # keyed on the inputs that determine the result (spec may resolve to a
+    # device SUBSET, so the cached mesh's own devices can't be the check)
+    key = (str(getenv("MXNET_MESH_SHAPE") or ""),
+           tuple(d.id for d in jax.devices()))
     cached = getattr(_state, "default", None)
-    if cached is None or set(cached.devices.flat) != set(jax.devices()):
-        cached = create_mesh(dp=-1)
+    if cached is None or getattr(_state, "default_key", None) != key:
+        cached = mesh_from_env() or create_mesh(dp=-1)
         _state.default = cached
+        _state.default_key = key
     return cached
+
+
+def axis_size(mesh, axis):
+    """Size of ``axis`` in ``mesh`` (1 when absent — the degenerate case
+    every sharded path must treat as 'replicated')."""
+    return int(mesh.shape.get(axis, 1))
+
+
+def has_axis(mesh, axis):
+    return axis in mesh.shape
+
+
+def devices_key(mesh):
+    """Hashable identity of the mesh's device assignment — part of every
+    compile-cache key a sharded program uses, so re-meshing (a different
+    device subset or axis order) re-specializes instead of silently
+    reusing an executable laid out for other devices."""
+    return (tuple(mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape),
+            tuple(d.id for d in mesh.devices.flat))
 
 
 def current_mesh():

@@ -56,6 +56,41 @@ def _prune_axes(spec, mesh):
     return P(*[keep(a) for a in tuple(spec)])
 
 
+def replicated(mesh):
+    """Fully-replicated NamedSharding — the reference's per-device weight
+    copies (`kvstore_local.h`) expressed as a GSPMD layout."""
+    return NamedSharding(mesh, P())
+
+
+def flat_shard(mesh, axis="dp"):
+    """1-D sharding of a flat buffer over one mesh axis (falls back to the
+    mesh's first axis when ``axis`` is absent) — the layout of a ZeRO-1
+    optimizer-state shard and of a reduce-scattered gradient bucket."""
+    if axis not in mesh.shape:
+        axis = mesh.axis_names[0]
+    return NamedSharding(mesh, P(axis))
+
+
+def pad_to_shards(n, nshards):
+    """Trailing zero-padding that makes an ``n``-element flat buffer
+    divisible into ``nshards`` equal slices (uneven-shard padding)."""
+    nshards = max(int(nshards), 1)
+    return (-int(n)) % nshards
+
+
+def nbytes_on_device(arr, device=None):
+    """Bytes of ``arr`` resident on one device (default: the first device
+    holding a shard) — the per-replica memory a sharded allocation costs,
+    measurable without trusting the sharding annotation."""
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return int(arr.size) * arr.dtype.itemsize
+    if device is None:
+        device = shards[0].device
+    return sum(int(np.prod(s.data.shape)) * arr.dtype.itemsize
+               for s in shards if s.device == device)
+
+
 def infer_param_sharding(mesh, name, shape, fsdp_min_size=2 ** 16):
     """Default sharding policy for a parameter:
 

@@ -294,7 +294,7 @@ class Executor:
                 tgt._data = tgt._data + g.astype(tgt.dtype)
 
     def fused_step(self, optimizer, updater, param_names,
-                   grad_sync_fn=None, grad_sync_key=None):
+                   grad_sync_fn=None, grad_sync_key=None, zero1=None):
         """ONE training step — forward, backward (ones cotangents, the
         `backward(out_grads=None)` convention), gradient rescale/clip and
         the optimizer update for every parameter — as a single jitted XLA
@@ -325,6 +325,15 @@ class Executor:
         dispatches as per-bucket collectives. ``grad_sync_key`` must
         identify the sync layout (store type + bucket cap): it keys the
         compile cache so a layout change re-specializes.
+
+        ``zero1`` (a ``parallel.zero1.Zero1Context``, from Module when
+        `MXNET_ZERO1=1`) replaces the replicated per-parameter update with
+        the sharded one: gradients are constrained to the dp-sharded flat
+        bucket layout (with the upstream cross-replica sum this lowers to
+        ReduceScatter), the optimizer runs on each replica's 1/N shard of
+        params and state (state lives SHARDED in the context, not in
+        ``updater.states``), and the updated shards are allgathered back —
+        still one donated-buffer XLA computation per signature.
         """
         from .. import random as _random
         from ..ndarray import NDArray
@@ -339,11 +348,23 @@ class Executor:
         names = [n for _, n in upd]
         name_set = set(names)
         weights = [self.arg_dict[n] for n in names]
-        updater.ensure_states(indices, weights)
+        if zero1 is not None:
+            # sharded state lives in the context (1/N per replica); the
+            # per-parameter updater states are not materialized
+            zero1.ensure(optimizer, updater, indices, weights)
+            states = None
+        else:
+            updater.ensure_states(indices, weights)
         count_snap = _snapshot_counts(optimizer, indices)
         optimizer._update_count(indices)
         lrs, wds = optimizer._fused_hyperparams(indices)
-        states = [updater.states[i] for i in indices]
+        if zero1 is None:
+            states = [updater.states[i] for i in indices]
+            state_sig = tuple(_state_sig(s) for s in states)
+            states_arg = [_state_to_jax(s) for s in states]
+        else:
+            state_sig = zero1.key()
+            states_arg = zero1.flat_states
 
         key = _random.next_key()
         params = tuple(self.arg_dict[n]._data for n in names)
@@ -355,7 +376,7 @@ class Executor:
                tuple((a.shape, a.dtype) for a in params),
                tuple((a.shape, a.dtype) for a in others),
                tuple((a.shape, a.dtype) for a in auxs),
-               tuple(_state_sig(s) for s in states),
+               state_sig,
                optimizer._fused_static_key(),
                grad_sync_key)
 
@@ -367,7 +388,7 @@ class Executor:
             opt = optimizer
             n_args = len(self._arg_names)
 
-            def step(key, params, others, auxs, states, lrs_, wds_, rescale):
+            def step(key, params, others, auxs, ss, lrs_, wds_, rescale):
                 from ..compile_cache import trace_salt
 
                 # salt the HLO: this donated program must never be
@@ -389,8 +410,17 @@ class Executor:
                     # cross-replica gradient sync traced into the step
                     # (bucketed flat psum — KVStore.fused_grad_sync_fn)
                     grads = grad_sync_fn(tuple(grads))
-                new_ws, new_ss = opt.fused_update(
-                    list(params), list(grads), states, lrs_, wds_, rescale)
+                if zero1 is not None:
+                    # sharded weight update: grads constrained to the
+                    # dp-sharded flat buckets (sum+constraint lowers to
+                    # ReduceScatter), 1/N-shard optimizer step, weights
+                    # allgathered back replicated (parallel/zero1.py)
+                    new_ws, new_ss = zero1.traced_update(
+                        opt, list(params), list(grads), ss,
+                        lrs_, wds_, rescale)
+                else:
+                    new_ws, new_ss = opt.fused_update(
+                        list(params), list(grads), ss, lrs_, wds_, rescale)
                 return outputs, tuple(new_ws), new_ss, aux_new
 
             return jax.jit(step, donate_argnums=(1, 3, 4))
@@ -400,15 +430,27 @@ class Executor:
         # CompileCache.get_or_build)
         fn = self._cache.get_or_build(("fused_step", sig), build,
                                       persistent=False)
+        call_args = [key, params, others, auxs, states_arg,
+                     jnp.asarray(lrs, jnp.float32),
+                     jnp.asarray(wds, jnp.float32),
+                     jnp.float32(optimizer.rescale_grad)]
+        if zero1 is not None:
+            # everything but the (already-sharded) state enters the mesh
+            # replicated; steady state is a no-op for weights/aux (they
+            # come back replicated), feeds broadcast here once per step
+            put = zero1.put_replicated
+            call_args = [jax.tree_util.tree_map(put, a) if i != 4 else a
+                         for i, a in enumerate(call_args)]
         try:
-            outputs, new_ws, new_ss, aux_new = fn(
-                key, params, others, auxs,
-                [_state_to_jax(s) for s in states],
-                jnp.asarray(lrs, jnp.float32),
-                jnp.asarray(wds, jnp.float32),
-                jnp.float32(optimizer.rescale_grad))
+            outputs, new_ws, new_ss, aux_new = fn(*call_args)
         except Exception as e:
-            if _any_donated_deleted(w._data for w in weights):
+            donated = [w._data for w in weights]
+            if zero1 is not None:
+                # the sharded flat state (donated via states_arg) is the
+                # only copy once dirty — a consumed state buffer is as
+                # fatal as a consumed weight
+                donated += jax.tree_util.tree_leaves(zero1.flat_states or [])
+            if _any_donated_deleted(donated):
                 # donated inputs were consumed before execution failed —
                 # the bound weights/states are unrecoverable in-process;
                 # say so instead of a later "Array deleted" crash
@@ -426,8 +468,12 @@ class Executor:
 
         for n, w in zip(names, new_ws):
             self.arg_dict[n]._data = w
-        for s, ns in zip(states, new_ss):
-            _state_writeback(s, ns)
+        if zero1 is not None:
+            zero1.flat_states = new_ss
+            zero1.dirty = True
+        else:
+            for s, ns in zip(states, new_ss):
+                _state_writeback(s, ns)
         for n, a in zip(self._aux_names, aux_new):
             self.aux_dict[n]._data = a
         self._vjp = None  # grads were consumed inside the step

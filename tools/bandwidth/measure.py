@@ -69,9 +69,146 @@ def parse_args():
                         "grad-sync scheduler per tier and report "
                         "bucketed-vs-per-key wire throughput (implies "
                         "--tiers schema; reduction must be exact)")
+    p.add_argument("--zero1", type=str, default="",
+                   help="comma-separated update shard-group sizes (e.g. "
+                        "'2,4,8'): benchmark the ZeRO-1 sharded weight "
+                        "update (MXNET_ZERO1, parallel/zero1.py) vs the "
+                        "replicated fused update — steady-state step time, "
+                        "per-replica optimizer-state bytes, and analytic "
+                        "wire bytes per step (reduce-scatter+allgather vs "
+                        "allreduce). error_vs_unsharded (sharded vs the "
+                        "same flat update at N=1) must be ulp-level "
+                        "(asserted < 1e-5 by the CI smoke; LLVM FMA "
+                        "synthesis varies per partition count)")
+    p.add_argument("--zero1-steps", type=int, default=5,
+                   help="update steps per zero1 config (first = compile)")
     p.add_argument("--json-out", type=str, default="",
                    help="rank-0 appends one JSON result line to this file")
     return p.parse_args()
+
+
+def zero1_sweep(args, shapes):
+    """Sharded vs replicated weight update over the first N devices.
+
+    For each N: drives `optimizer.Updater` directly (the aggregated-update
+    path every trainer uses) with a fixed grad stream — once replicated
+    (`MXNET_ZERO1=0`, the PR 3 fused update), once sharded
+    (`MXNET_ZERO1=1`, `MXNET_ZERO1_NDEV=N`) — and reports:
+
+    * steady-state step time (post-compile median). CAVEAT on the virtual
+      CPU mesh: every "device" is a host thread and the update is tiny, so
+      per-step collective/broadcast orchestration dominates and the
+      sharded step reads SLOWER — the artifact's load-bearing numbers are
+      the state ratio and the byte math, exactly like BANDWIDTH_r05's
+      "absolute GB/s is NOT the ICI number" caveat,
+    * optimizer-state bytes: replicated total vs the MEASURED bytes
+      resident per replica under sharding (== 1/N of the padded flat
+      buckets — the ZeRO-1 memory claim, asserted by the CI smoke),
+    * analytic wire bytes per step: ring allreduce moves 2(N-1)/N·B_grad;
+      ZeRO-1 moves (N-1)/N·B_grad (reduce-scatter) + (N-1)/N·B_weight
+      (allgather of updated weights) — same total for B_grad==B_weight,
+      the win is memory and update FLOPs, not bytes,
+    * error_vs_unsharded: max |w_N - w_1| after the run, sharded vs the
+      SAME flat update unsharded — ulp-level (0 for most layouts; LLVM
+      FMA synthesis varies per partition count, so the CI smoke asserts
+      < 1e-5 rather than bitwise 0), and
+    * rel_drift_vs_replicated: drift vs the per-parameter replicated
+      program (FMA contraction differs across program structures;
+      denominator floored at 1e-6, so near-zero weights inflate it —
+      docs/faq/perf.md).
+    """
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt_mod
+
+    sizes = [int(x) for x in args.zero1.split(",") if x]
+    steps = max(2, args.zero1_steps)
+    opt_name = args.optimizer if args.optimizer not in (None, "None") \
+        else "sgd"
+    opt_kw = {"learning_rate": 0.05}
+    if opt_name == "sgd":
+        opt_kw["momentum"] = 0.9
+
+    grad_bytes = sum(float(np.prod(s)) * 4 for s in shapes)
+
+    def drive(zero1, ndev):
+        saved = {k: os.environ.get(k)
+                 for k in ("MXNET_ZERO1", "MXNET_ZERO1_NDEV",
+                           "MXNET_FUSED_STEP")}
+        os.environ["MXNET_ZERO1"] = "1" if zero1 else "0"
+        os.environ["MXNET_ZERO1_NDEV"] = str(ndev)
+        os.environ["MXNET_FUSED_STEP"] = "1"
+        try:
+            rng = np.random.RandomState(0)
+            ws = [mx.nd.array(rng.uniform(-1, 1, s).astype(np.float32))
+                  for s in shapes]
+            upd = opt_mod.get_updater(opt_mod.create(opt_name, **opt_kw))
+            grads = [[rng.uniform(-1, 1, s).astype(np.float32)
+                      for s in shapes] for _ in range(steps)]
+            times = []
+            for si in range(steps):
+                gs = [mx.nd.array(g) for g in grads[si]]
+                tic = time.time()
+                upd(list(range(len(ws))), gs, ws)
+                for w in ws:
+                    w.wait_to_read()
+                times.append(time.time() - tic)
+            steady = sorted(times[1:])[len(times[1:]) // 2]
+            if zero1:
+                ctx = upd._zero1
+                assert ctx is not None and not upd._zero1_failed, \
+                    "zero1 path did not engage"
+                state_bytes = ctx.state_nbytes_per_replica()
+            else:
+                import jax.tree_util as jtu
+
+                state_bytes = sum(
+                    int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                    for s in upd.states.values()
+                    for l in jtu.tree_leaves(s))
+            return [w.asnumpy() for w in ws], steady, state_bytes
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    w_rep, t_rep, bytes_rep = drive(False, 0)
+    w_base, _, _ = drive(True, 1)  # unsharded flat oracle
+    out = {}
+    for n in sizes:
+        if n > jax.device_count():
+            logging.info("zero1: skipping N=%d (only %d devices)", n,
+                         jax.device_count())
+            continue
+        w_n, t_n, bytes_n = drive(True, n)
+        err0 = max(float(np.abs(a - b).max())
+                   for a, b in zip(w_n, w_base))
+        drift = max(float((np.abs(a - b) /
+                           np.maximum(np.abs(b), 1e-6)).max())
+                    for a, b in zip(w_n, w_rep))
+        rec = {
+            "nshards": n,
+            "step_time_replicated_s": t_rep,
+            "step_time_zero1_s": t_n,
+            "state_bytes_replicated": bytes_rep,
+            "state_bytes_zero1_per_replica": bytes_n,
+            "state_ratio": bytes_n / max(bytes_rep, 1),
+            "wire_bytes_allreduce_per_step":
+                2 * (n - 1) / n * grad_bytes,
+            "wire_bytes_zero1_per_step":
+                (n - 1) / n * grad_bytes + (n - 1) / n * grad_bytes,
+            "error_vs_unsharded": err0,
+            "rel_drift_vs_replicated": drift,
+        }
+        out[str(n)] = rec
+        logging.info(
+            "zero1 N=%d: step %.4fs (replicated %.4fs), state/replica "
+            "%.0f B (replicated %.0f B, ratio %.3f), error_vs_unsharded "
+            "%g, rel_drift_vs_replicated %g", n, t_n, t_rep, bytes_n,
+            bytes_rep, rec["state_ratio"], err0, drift)
+    return out
 
 
 def get_shapes(network, image_shape, num_classes):
@@ -276,6 +413,10 @@ def run(args):
                     len(sched.buckets), per_iter, wire_bytes_s / 1e9, err)
             bucket_sweep[tname] = sweep
 
+    zero1_stats = {}
+    if args.zero1:
+        zero1_stats = zero1_sweep(args, shapes)
+
     if args.json_out and getattr(kv, "rank", 0) == 0:
         import json
 
@@ -284,7 +425,8 @@ def run(args):
                 "ndev_local": ndev, "total_MB": size_mb,
                 "avg_gb_per_sec_per_device": avg,
                 "error": float(res[-1].error) if res else None,
-                "tiers": tier_stats, "bucket_sweep": bucket_sweep}
+                "tiers": tier_stats, "bucket_sweep": bucket_sweep,
+                "zero1_sweep": zero1_stats}
         with open(args.json_out, "a") as f:
             f.write(json.dumps(line) + "\n")
     return res
