@@ -91,12 +91,50 @@ except Exception:  # noqa: BLE001 — cache is an optimisation, never fatal
 BASELINE_IMG_S = 298.51  # V100 fp32 b=32 training (BASELINE.md)
 
 
-def _write_telemetry_snapshot():
+# phase name -> deterministic trace id: stamped into the BENCH json AND
+# the telemetry sidecar, so a number cross-references the tracing dump
+# that produced it (the ids match the span trees when MXNET_TRACING=1)
+_PHASE_TRACE_IDS = {}
+
+
+def _phase_scope(name):
+    """One measurement phase as a root tracing span with a trace id
+    deterministic in (pid, phase). The id is recorded whether or not
+    tracing is on (stamping is free); the span itself is a no-op when
+    MXNET_TRACING is off, so the measured numbers are untouched."""
+    try:
+        from mxnet_tpu import tracing
+
+        tid = tracing.deterministic_trace_id("bench", os.getpid(), name)
+        _PHASE_TRACE_IDS[name] = tid
+        return tracing.span(f"bench.{name}", cat="bench", trace_id=tid)
+    except Exception:  # noqa: BLE001 — stamping must never sink the bench
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def _bench_stamp(backend=None, backend_err=None):
+    """The self-description block shared by the BENCH json and the
+    telemetry sidecar: resolved backend, probe verdict + provenance,
+    per-phase trace ids."""
+    stamp = {"backend": backend,
+             "probe": {k: v for k, v in dict(
+                 _probe_provenance,
+                 error=backend_err or (_probe_cache[1] if _probe_cache
+                                       else None)).items() if v is not None}}
+    if _PHASE_TRACE_IDS:
+        stamp["trace_ids"] = dict(_PHASE_TRACE_IDS)
+    return stamp
+
+
+def _write_telemetry_snapshot(stamp=None):
     """Sidecar for the BENCH json: a telemetry snapshot of the measured
     run (engine pushes, kvstore bytes/latency, prefetch starvation), so a
     perf round gets the breakdown for free. `BENCH_TELEMETRY_OUT` sets the
     path ('0' disables); default lands next to this script. Render it with
-    `tools/telemetry_report.py`."""
+    `tools/telemetry_report.py`. ``stamp`` (backend/probe/trace ids) is
+    merged in under ``"bench"`` so the sidecar is self-describing."""
     out = os.environ.get("BENCH_TELEMETRY_OUT")
     if out == "0":
         return None
@@ -106,7 +144,19 @@ def _write_telemetry_snapshot():
         from mxnet_tpu import telemetry
 
         if telemetry._registry:
-            return telemetry.dump(out)
+            path = telemetry.dump(out)
+            if path and stamp:
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                    doc["bench"] = stamp
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(doc, f, indent=2)
+                    os.replace(tmp, path)
+                except Exception:  # noqa: BLE001 — stamp is additive
+                    pass
+            return path
     except Exception:  # noqa: BLE001 — telemetry must never sink the bench
         pass
     return None
@@ -148,6 +198,12 @@ def _emit(payload):
 # must not re-pay the subprocess, and above all must not re-pay a TIMEOUT:
 # BENCH_r05 recorded "backend probe hung (> 900s)" burning 15 minutes
 _probe_cache = None
+# provenance of the verdict above, stamped into the BENCH json so a
+# CPU-fallback headline is self-describing: WHERE the verdict came from
+# (live subprocess probe vs a cached failure from an earlier process vs
+# BENCH_FORCE_CPU), which phase wedged, and when a cached verdict was
+# written — without digging through the run log (ISSUE 7)
+_probe_provenance = {}
 
 
 def _probe_timeout_s():
@@ -187,7 +243,7 @@ def _probe_disk_load():
     try:
         with open(path) as f:
             rec = json.load(f)
-        return (rec.get("backend"), rec.get("error"))
+        return (rec.get("backend"), rec.get("error"), rec)
     except Exception:  # noqa: BLE001 — a corrupt cache just re-probes
         return None
 
@@ -272,9 +328,10 @@ def _probe_backend():
     if _probe_cache is not None:
         return _probe_cache
 
-    def _cache(backend, err, phase=None, store=True):
+    def _cache(backend, err, phase=None, store=True, source=None):
         global _probe_cache
         _probe_cache = (backend, err)
+        _probe_provenance.update(source=source, phase=phase)
         # a BENCH_FORCE_CPU child never writes the disk cache: its cpu
         # verdict says nothing about the TPU backend, and storing it would
         # clobber the failure verdict the parent just paid the probe for
@@ -282,11 +339,17 @@ def _probe_backend():
             _probe_disk_store(backend, err, phase)
         return _probe_cache
 
+    if _FORCE_CPU:
+        _probe_provenance.update(source="force_cpu")
     if not _FORCE_CPU:
         disk = _probe_disk_load()
         if disk is not None and disk[1] is not None:
             # a cached FAILURE verdict skips straight to fallback
-            return _cache(disk[0], disk[1], store=False)
+            _probe_provenance.update(
+                cache_path=_probe_disk_cache_path(),
+                cached_at=disk[2].get("written_at"))
+            return _cache(disk[0], disk[1], phase=disk[2].get("phase"),
+                          store=False, source="disk_cached_failure")
         # no cached failure: pay the subprocess probe. A stored SUCCESS is
         # deliberately NOT trusted across processes — the backend can wedge
         # after the verdict was written, and the subprocess is the only
@@ -296,11 +359,13 @@ def _probe_backend():
         try:
             ok, err, phase = _run_probe_subprocess(timeout_s)
             if not ok:
-                return _cache(None, err, phase)
+                return _cache(None, err, phase,
+                              source="subprocess_probe")
         except Exception:  # noqa: BLE001
             return _cache(
                 None,
-                traceback.format_exc(limit=2).strip().splitlines()[-1])
+                traceback.format_exc(limit=2).strip().splitlines()[-1],
+                source="subprocess_probe")
 
     import jax
 
@@ -309,10 +374,11 @@ def _probe_backend():
         import jax.numpy as jnp
 
         jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
-        return _cache(backend, None)
+        return _cache(backend, None,
+                      source=_probe_provenance.get("source") or "in_process")
     except Exception:  # noqa: BLE001 — any backend failure falls back
         err = traceback.format_exc(limit=3).strip().splitlines()[-1]
-        return _cache(None, err)
+        return _cache(None, err, source="in_process")
 
 
 def _reexec_cpu(err):
@@ -810,8 +876,10 @@ def main():
             if not _FORCE_CPU and _reexec_cpu(backend_err):
                 return 0
             result["error"] = f"backend init failed: {backend_err}"
+            result.update(_bench_stamp(backend, backend_err))
             _emit(result)
             return 0
+        result.update(_bench_stamp(backend, backend_err))
         on_tpu = backend not in ("cpu",)
         # metrics breakdown of the measured run (sidecar json). The run is
         # measured WITH telemetry on (a handful of flag checks + clock
@@ -828,10 +896,12 @@ def main():
                 pass
         fetch_cost = _fetch_cost()
         result["fetch_cost_ms"] = round(fetch_cost * 1e3, 3)
-        raw_fetch, raw_disp, batch, size, iters, flops, raw_compile_s = \
-            _measure_raw(on_tpu, fetch_cost)
-        fw_fetch, fw_disp, fw_compile_s = _measure_framework(
-            on_tpu, fetch_cost, "float32", fused=True)
+        with _phase_scope("raw_fp32"):
+            raw_fetch, raw_disp, batch, size, iters, flops, raw_compile_s = \
+                _measure_raw(on_tpu, fetch_cost)
+        with _phase_scope("framework_fp32"):
+            fw_fetch, fw_disp, fw_compile_s = _measure_framework(
+                on_tpu, fetch_cost, "float32", fused=True)
         result.update(
             value=round(fw_fetch, 2),
             vs_baseline=round(fw_fetch / BASELINE_IMG_S, 3),
@@ -852,8 +922,9 @@ def main():
         # framework's fastest public path, so framework_vs_raw is defined on
         # it (basis recorded explicitly; the gluon ratio stays alongside).
         try:
-            mf_fetch, mf_disp, mf_compile_s = _measure_module(
-                on_tpu, fetch_cost, fused=True)
+            with _phase_scope("module_fused"):
+                mf_fetch, mf_disp, mf_compile_s = _measure_module(
+                    on_tpu, fetch_cost, fused=True)
             result["framework_module_fused"] = round(mf_fetch, 2)
             result["framework_module_fused_dispatch"] = round(mf_disp, 2)
             result["framework_module_compile_s"] = round(mf_compile_s, 2)
@@ -870,8 +941,9 @@ def main():
             # eager comparison in its OWN guard: its failure must not
             # contradict the already-recorded module_fused basis keys
             try:
-                me_fetch, me_disp, me_compile_s = _measure_module(
-                    on_tpu, fetch_cost, fused=False)
+                with _phase_scope("module_eager"):
+                    me_fetch, me_disp, me_compile_s = _measure_module(
+                        on_tpu, fetch_cost, fused=False)
                 result["framework_module_eager"] = round(me_fetch, 2)
                 result["framework_module_eager_compile_s"] = round(
                     me_compile_s, 2)
@@ -885,8 +957,9 @@ def main():
             # gluon eager (MXNET_FUSED_STEP=0) comparison point: the delta
             # to framework_fp32 is attributable to the fused optimizer
             # update (Updater._fused_call) alone
-            eg_fetch, eg_disp, eg_compile_s = _measure_framework(
-                on_tpu, fetch_cost, "float32", fused=False)
+            with _phase_scope("gluon_eager"):
+                eg_fetch, eg_disp, eg_compile_s = _measure_framework(
+                    on_tpu, fetch_cost, "float32", fused=False)
             result["framework_fp32_eager"] = round(eg_fetch, 2)
             result["framework_fp32_eager_dispatch"] = round(eg_disp, 2)
             result["framework_fp32_eager_compile_s"] = round(eg_compile_s, 2)
@@ -894,8 +967,9 @@ def main():
         except Exception:  # noqa: BLE001
             result["eager_error"] = traceback.format_exc(limit=3).strip().splitlines()[-1]
         try:
-            bf_fetch, bf_disp, _bf_compile_s = _measure_framework(
-                on_tpu, fetch_cost, "bfloat16")
+            with _phase_scope("framework_bf16"):
+                bf_fetch, bf_disp, _bf_compile_s = _measure_framework(
+                    on_tpu, fetch_cost, "bfloat16")
             result["framework_bf16"] = round(bf_fetch, 2)
             result["framework_bf16_dispatch"] = round(bf_disp, 2)
         except Exception:  # noqa: BLE001
@@ -905,7 +979,8 @@ def main():
             # micro-batcher, warm (post-warmup) vs cold compile separated;
             # lands in the BENCH json and — via the serving.* histograms —
             # in the BENCH_TELEMETRY.json sidecar
-            result["serving"] = _measure_serving(on_tpu)
+            with _phase_scope("serving"):
+                result["serving"] = _measure_serving(on_tpu)
         except Exception:  # noqa: BLE001
             result["serving_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
@@ -936,7 +1011,11 @@ def main():
             result["mfu_error"] = traceback.format_exc(limit=3).strip().splitlines()[-1]
     except Exception:  # noqa: BLE001 — a bench crash must still emit JSON
         result["error"] = traceback.format_exc(limit=5).strip().splitlines()[-1]
-    snap_path = _write_telemetry_snapshot()
+    # re-stamp: trace ids accumulated as phases ran, and the headline
+    # backend may have resolved after the first stamp
+    stamp = _bench_stamp(result.get("backend"))
+    result.update(stamp)
+    snap_path = _write_telemetry_snapshot(stamp=stamp)
     if snap_path:
         result["telemetry_snapshot"] = snap_path
     _emit(result)

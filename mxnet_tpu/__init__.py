@@ -19,7 +19,15 @@ if _os.environ.get("MXNET_DIST_PLATFORM"):
     import jax as _jax
 
     _jax.config.update("jax_platforms", _os.environ["MXNET_DIST_PLATFORM"])
-    if _os.environ["MXNET_DIST_PLATFORM"] == "cpu":
+    # gloo cross-process collectives need a jax.distributed client; only a
+    # launcher-spawned worker (rendezvous env present — our launcher's
+    # coordinator vars, DMLC, or mpirun's OMPI vars, exactly the branches
+    # launcher.initialize_from_env accepts) has one — a single-process run
+    # with the flag set cannot even init the backend
+    if _os.environ["MXNET_DIST_PLATFORM"] == "cpu" and (
+            _os.environ.get("MXNET_COORDINATOR")
+            or _os.environ.get("DMLC_PS_ROOT_URI")
+            or _os.environ.get("OMPI_COMM_WORLD_SIZE")):
         _jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 from .base import MXNetError
@@ -46,6 +54,8 @@ from .random import seed
 from . import engine
 from . import resilience
 from . import telemetry
+from . import tracing
+from . import memory
 from . import compile_cache
 from . import runtime
 

@@ -69,6 +69,10 @@ class Speedometer:
         self.tic = 0
         self.last_count = 0
         self.auto_reset = auto_reset
+        # slow-step flight recorder (MXNET_TRACING=1): per log interval,
+        # keep the worst step's span tree — "p99 got worse" comes with
+        # "and here is what that step did"
+        self.worst_step = None
 
     def __call__(self, param):
         count = param.nbatch
@@ -93,6 +97,22 @@ class Speedometer:
                     if p50_us is not None:
                         lat = "\tstep-p50: %.1f ms\tstep-p99: %.1f ms"
                         lat_args = (p50_us / 1e3, p99_us / 1e3)
+                from . import tracing
+
+                if tracing._enabled:
+                    # drain the flight recorder: this log interval's worst
+                    # step tree, kept for dumps/debuggers until the next
+                    # tick; the slowest PHASE is named inline in the log
+                    worst = tracing.flight_recorder.worst(reset=True)
+                    if worst is not None:
+                        self.worst_step = worst
+                        kids = worst.get("children") or []
+                        if kids:
+                            slow = max(kids, key=lambda c: c.get("dur") or 0)
+                            lat += "\tworst-step: %.1f ms (%s %.1f ms)"
+                            lat_args += ((worst.get("dur") or 0) / 1e3,
+                                         slow["name"],
+                                         (slow.get("dur") or 0) / 1e3)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

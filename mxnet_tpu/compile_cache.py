@@ -178,6 +178,9 @@ class CompileCache:
         self.compile_seconds = 0.0
         self._name_totals = _totals(name)
         self._entries = {}
+        # key -> {"avals": first-call abstract shapes, "memory": analysis}
+        # (shape/dtype skeletons only — never holds buffers alive)
+        self._entry_stats = {}
         self._lock = threading.Lock()
         with _caches_lock:
             _caches.add(self)
@@ -222,16 +225,90 @@ class CompileCache:
             self.misses += 1
             self._name_totals["misses"] += 1
             telemetry.counter("compile.cache_misses").inc()
-            fn = self._wrap_first_call(build(), persistent)
+            fn = self._wrap_first_call(build(), persistent, key)
             if self.maxsize is not None and len(self._entries) >= self.maxsize:
                 # drop the least-recently-used entry — executables are
                 # re-buildable, never precious
-                self._entries.pop(next(iter(self._entries)))
+                evicted = next(iter(self._entries))
+                self._entries.pop(evicted)
+                self._entry_stats.pop(evicted, None)
             self._entries[key] = fn
         _entries_gauge()
         return fn
 
-    def _wrap_first_call(self, fn, persistent=True):
+    def _record_avals(self, key, args, kwargs):
+        """Shape/dtype skeleton of the first call — enough to re-lower the
+        program for XLA memory analysis (`memory_stats`) without keeping a
+        single buffer alive."""
+        try:
+            import jax
+
+            def aval(x):
+                if hasattr(x, "shape") and hasattr(x, "dtype"):
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+                return x
+
+            self._entry_stats[key] = {
+                "avals": jax.tree_util.tree_map(aval, (tuple(args),
+                                                       dict(kwargs))),
+                "memory": None}
+        except Exception:  # noqa: BLE001 — stats are additive, never fatal
+            pass
+
+    def entry_memory(self, key):
+        """XLA compiled-memory analysis for one entry: {argument_bytes,
+        output_bytes, temp_bytes, peak_bytes} or None. Computed LAZILY via
+        an AOT `lower().compile()` pass over the recorded avals and
+        memoized (failures too); never runs on the step path. NOTE the
+        first computation can be a FULL recompile, not just a re-trace:
+        the AOT path bypasses jax's jit dispatch cache, and persistent=False
+        (donated) entries are deliberately kept out of the on-disk cache —
+        budget seconds per entry on the first scrape of a big cache."""
+        st = self._entry_stats.get(key)
+        if st is None:
+            return None
+        if st["memory"] is not None:
+            return st["memory"] or None  # False = memoized FAILED analysis
+        fn = self._entries.get(key)
+        target = getattr(fn, "_fn", fn)
+        if not hasattr(target, "lower"):
+            return None
+        try:
+            args, kwargs = st["avals"]
+            with donation_warnings_suppressed():
+                ma = target.lower(*args, **kwargs).compile().memory_analysis()
+            st["memory"] = {
+                "argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "temp_bytes": int(ma.temp_size_in_bytes),
+                "alias_bytes": int(ma.alias_size_in_bytes),
+                # resident working set while the program runs: inputs +
+                # outputs + temporaries, minus buffers aliased in place
+                # (donation) — the per-executable peak-HBM estimate
+                "peak_bytes": int(ma.argument_size_in_bytes
+                                  + ma.output_size_in_bytes
+                                  + ma.temp_size_in_bytes
+                                  - ma.alias_size_in_bytes)}
+        except Exception:  # noqa: BLE001 — analysis is best-effort
+            st["memory"] = False  # memoize the failure: the AOT lowering
+            return None           # is expensive and will not get better
+        return st["memory"]
+
+    def memory_stats(self, compute=False):
+        """Per-entry memory rows for this cache: entries whose analysis
+        has been computed (``compute=True`` forces the lazy analysis for
+        every entry first). Rows: {key, argument_bytes, ...}."""
+        rows = []
+        for key in list(self._entry_stats):
+            st = self._entry_stats.get(key)
+            if st is None:
+                continue
+            mem = self.entry_memory(key) if compute else st["memory"]
+            if mem:  # None = not computed, False = memoized failure
+                rows.append(dict(mem, key=repr(key)))
+        return rows
+
+    def _wrap_first_call(self, fn, persistent=True, key=None):
         cache = self
 
         class _Timed:
@@ -264,6 +341,8 @@ class CompileCache:
                     # cache pause + accounting intact (another caller can
                     # hit this shared entry after one caller's trace error)
                     self._first = False
+                    if key is not None:
+                        cache._record_avals(key, args, kwargs)
                     dt = time.perf_counter() - t0
                     cache.compile_seconds += dt
                     cache._name_totals["compile_seconds"] += dt

@@ -22,6 +22,7 @@ import threading
 import time
 
 from . import telemetry
+from . import tracing
 from .base import getenv
 
 __all__ = ["bulk", "engine_type", "push", "push_io", "wait_all", "path_var"]
@@ -112,6 +113,29 @@ def _instrumented(fn):
     return run
 
 
+def _traced(fn, name):
+    """Tracing wrap for one pushed task: capture the pushing thread's span
+    context, re-attach it on the engine worker, and draw the flow arrow —
+    an async checkpoint write lands under the step that pushed it in the
+    trace, on the worker's own timeline row."""
+    carrier = tracing.inject()
+    flow_id = None
+    if carrier is not None:
+        # flow start must sit inside an open slice on the pushing thread;
+        # carrier != None means one exists (inject() found an open span)
+        flow_id = tracing.new_flow_id()
+        tracing.flow_start(flow_id, name=name)
+
+    def run(*a, **kw):
+        with tracing.attach(carrier):
+            with tracing.span(name, cat="engine"):
+                if flow_id is not None:
+                    tracing.flow_end(flow_id, name=name)
+                return fn(*a, **kw)
+
+    return run
+
+
 def push(fn, *args, const_vars=(), mutable_vars=(), **kwargs):
     """Push host-side async work onto the native engine (falls back to
     inline execution when the native library is unavailable)."""
@@ -120,6 +144,8 @@ def push(fn, *args, const_vars=(), mutable_vars=(), **kwargs):
     if telemetry._enabled:
         telemetry.counter("engine.pushes").inc()
         fn = _instrumented(fn)
+    if tracing._enabled:
+        fn = _traced(fn, "engine.task")
     eng = lib.native_engine()
     if eng is not None:
         return eng.push(_guarded(fn), args, kwargs,

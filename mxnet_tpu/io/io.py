@@ -22,6 +22,7 @@ import queue as _queue
 import numpy as _np
 
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError
 
 
@@ -425,7 +426,11 @@ class PrefetchingIter(DataIter):
                     resilience.inject("prefetch", self._name)
                 except resilience.ThreadKilled:
                     return  # simulated silent thread death
-                item = self._fetch_one()
+                # the span puts the prefetch thread's fetch windows on its
+                # own trace row (the engine path gets this — plus consumer
+                # parenting — through engine.push's inject/attach)
+                with tracing.span("io.prefetch_fetch", cat="io"):
+                    item = self._fetch_one()
                 q.put(item)
                 if item is None or isinstance(item, Exception):
                     return

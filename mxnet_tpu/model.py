@@ -15,6 +15,7 @@ import time as _time
 from . import ndarray as nd
 from . import kvstore as kvs
 from . import telemetry
+from . import tracing
 from .base import MXNetError, getenv
 from .log import get_logger
 
@@ -165,12 +166,17 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     resume would fall back to."""
     tele = telemetry._enabled
     t0 = _time.perf_counter() if tele else 0.0
-    if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json", remove_amp_cast=remove_amp_cast)
-    save_dict = {f"arg:{k}": v.as_in_context(_cpu()) for k, v in arg_params.items()}
-    save_dict.update({f"aux:{k}": v.as_in_context(_cpu()) for k, v in aux_params.items()})
-    cur_path = _param_path(prefix, epoch)
-    nd.save(cur_path, save_dict)
+    with tracing.span("checkpoint.save", cat="io", prefix=prefix,
+                      epoch=epoch):
+        if symbol is not None:
+            symbol.save(f"{prefix}-symbol.json",
+                        remove_amp_cast=remove_amp_cast)
+        save_dict = {f"arg:{k}": v.as_in_context(_cpu())
+                     for k, v in arg_params.items()}
+        save_dict.update({f"aux:{k}": v.as_in_context(_cpu())
+                          for k, v in aux_params.items()})
+        cur_path = _param_path(prefix, epoch)
+        nd.save(cur_path, save_dict)
     if tele:
         # caller-visible cost (device fetch + dispatch); the async disk
         # write itself lands in checkpoint.write_us on the engine worker

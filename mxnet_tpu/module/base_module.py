@@ -14,6 +14,7 @@ import time
 import numpy as _np
 
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError
 from .. import metric as _metric
 from .. import ndarray as nd
@@ -229,35 +230,69 @@ class BaseModule:
                 # wall time actually goes (data wait / fwd-bwd dispatch /
                 # optimizer update / metric sync). The metric update fetches
                 # values, so it doubles as the device sync segment.
+                # tracing: the same boundaries become a span tree under one
+                # "step" root whose trace id is DETERMINISTIC in
+                # (epoch, step) — every dist worker labels the same step
+                # identically, so tools/trace_merge.py can join their
+                # dumps. Nested spans (grad_sync issue/drain, fused
+                # dispatch, zero1 phases) parent to the root through the
+                # context var; the finished tree feeds the slow-step
+                # flight recorder.
                 tele = telemetry._enabled
-                t0 = time.perf_counter() if tele else 0.0
-                # fused path: fwd+bwd+update as one XLA computation (its
-                # whole cost lands in the fwdbwd segment; update is 0)
-                fused = self.fused_step(data_batch)
-                if not fused:
-                    self.forward_backward(data_batch)
-                t_fb = time.perf_counter() if tele else 0.0
-                if not fused:
-                    self.update()
-                t_up = time.perf_counter() if tele else 0.0
-                if tele:
-                    telemetry.gauge("step.fused").set(1 if fused else 0)
-                if isinstance(data_batch, list):
-                    self.update_metric(eval_metric,
-                                       [db.label for db in data_batch],
-                                       pre_sliced=True)
-                else:
-                    self.update_metric(eval_metric, data_batch.label)
-                t_sync = time.perf_counter() if tele else 0.0
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
+                trc = tracing._enabled
+                timed = tele or trc
+                step_span = tracing.span(
+                    "step", cat="train",
+                    trace_id=(tracing.deterministic_trace_id(
+                        "fit", epoch, nbatch) if trc else None),
+                    epoch=epoch, step=nbatch)
+                with step_span:
+                    t0 = time.perf_counter() if timed else 0.0
+                    # fused path: fwd+bwd+update as one XLA computation
+                    # (its whole cost lands in the fwdbwd segment)
+                    fused = self.fused_step(data_batch)
+                    if not fused:
+                        self.forward_backward(data_batch)
+                    t_fb = time.perf_counter() if timed else 0.0
+                    if not fused:
+                        self.update()
+                    t_up = time.perf_counter() if timed else 0.0
+                    if tele:
+                        telemetry.gauge("step.fused").set(1 if fused else 0)
+                    if isinstance(data_batch, list):
+                        self.update_metric(eval_metric,
+                                           [db.label for db in data_batch],
+                                           pre_sliced=True)
+                    else:
+                        self.update_metric(eval_metric, data_batch.label)
+                    t_sync = time.perf_counter() if timed else 0.0
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    except StopIteration:
+                        end_of_batch = True
+                    t_data = time.perf_counter() if timed else 0.0
+                    if trc:
+                        # the phase children, reconstructed from the perf
+                        # marks (one wall-clock read anchors them all)
+                        end_us = tracing.now_us()
+
+                        def _seg(name, a, b):
+                            tracing.emit_span(
+                                name, end_us - (t_data - a) * 1e6,
+                                (b - a) * 1e6, cat="train",
+                                parent=step_span)
+
+                        _seg("step.fwdbwd", t0, t_fb)
+                        _seg("step.update", t_fb, t_up)
+                        _seg("step.sync", t_up, t_sync)
+                        _seg("step.data", t_sync, t_data)
+                        step_span.set(fused=fused)
+                if trc:
+                    tracing.flight_recorder.observe(step_span.tree())
                 step_stats = None
                 if tele:
-                    t_data = time.perf_counter()
                     total_h = telemetry.histogram("step.total_us")
                     for name, us in (("step.fwdbwd_us", (t_fb - t0) * 1e6),
                                      ("step.update_us", (t_up - t_fb) * 1e6),

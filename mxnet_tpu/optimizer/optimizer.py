@@ -1285,6 +1285,17 @@ class Updater:
         # dp-sharded flat optimizer state when MXNET_ZERO1=1
         self._zero1 = None
         self._zero1_failed = False
+        # memory census: the replicated per-parameter states (the sharded
+        # ones census through the Zero1Context's own provider). A live
+        # view — fused updates replace the state arrays every step.
+        from .. import memory
+        from jax import tree_util as _jtu
+
+        memory.register_provider(
+            "optimizer_state", self,
+            lambda s: [leaf for st in s.states.values()
+                       for leaf in _jtu.tree_leaves(st)
+                       if hasattr(leaf, "nbytes") or hasattr(leaf, "_data")])
 
     def ensure_states(self, indices, weights):
         """Create (or context-sync) the optimizer state for each index —

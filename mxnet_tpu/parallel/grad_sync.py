@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
+from .. import tracing
 from ..base import getenv, register_env
 
 __all__ = ["GradSync", "Bucket", "bucket_assign", "bucketing_enabled",
@@ -208,6 +209,13 @@ class GradSync:
         self._inflight = []  # (bucket, reduced NDArray, t_issue)
         self._t_issue0 = 0.0
         self._t_issue1 = 0.0
+        # memory census: the persistent flat reduce buffers are this
+        # scheduler's device residency (a LIVE view — buffers are replaced
+        # every step, so a snapshot weakref would die immediately)
+        from .. import memory
+
+        memory.register_provider("gradients", self,
+                                 lambda s: list(s._flat.values()))
 
     @property
     def buckets(self):
@@ -282,18 +290,29 @@ class GradSync:
 
             raise MXNetError("GradSync.issue() called twice without drain()")
         tele = telemetry._enabled
+        trc = tracing._enabled
         self._t_issue0 = _time.perf_counter()
-        for idx, bucket in enumerate(self._buckets):
-            flats = self._pack(bucket, grads)
-            t0 = _time.perf_counter()
-            reduced = self._kv.allreduce_flat(flats, priority=bucket.priority)
-            self._flat[idx] = reduced  # persistent flat buffer
-            self._inflight.append((bucket, reduced, t0))
-            if tele:
-                telemetry.counter("grad_sync.collectives").inc()
-                telemetry.counter("grad_sync.bytes").inc(bucket.nbytes)
-                telemetry.histogram("grad_sync.issue_us").record(
-                    (_time.perf_counter() - t0) * 1e6)
+        with tracing.span("grad_sync.issue", cat="comm",
+                          buckets=len(self._buckets)):
+            for idx, bucket in enumerate(self._buckets):
+                t_b = tracing.now_us() if trc else 0.0
+                flats = self._pack(bucket, grads)
+                t0 = _time.perf_counter()
+                reduced = self._kv.allreduce_flat(flats,
+                                                  priority=bucket.priority)
+                self._flat[idx] = reduced  # persistent flat buffer
+                self._inflight.append((bucket, reduced, t0))
+                if trc:
+                    tracing.emit_span("grad_sync.bucket_issue", t_b,
+                                      tracing.now_us() - t_b, cat="comm",
+                                      bucket=idx, nbytes=bucket.nbytes,
+                                      keys=len(bucket.keys),
+                                      priority=bucket.priority)
+                if tele:
+                    telemetry.counter("grad_sync.collectives").inc()
+                    telemetry.counter("grad_sync.bytes").inc(bucket.nbytes)
+                    telemetry.histogram("grad_sync.issue_us").record(
+                        (_time.perf_counter() - t0) * 1e6)
         self._t_issue1 = _time.perf_counter()
 
     def drain(self, grads, outs=None):
@@ -303,14 +322,26 @@ class GradSync:
         between the end of issue() and the end of drain(), the fraction
         NOT spent blocked on communication — comm hidden behind compute."""
         tele = telemetry._enabled
+        trc = tracing._enabled
         waited = 0.0
         try:
-            for bucket, reduced, _t0 in sorted(
-                    self._inflight, key=lambda x: -x[0].priority):
-                t0 = _time.perf_counter()
-                jax.block_until_ready(reduced._data)
-                waited += _time.perf_counter() - t0
-                self._scatter(bucket, reduced._data, grads, outs)
+            with tracing.span("grad_sync.drain", cat="comm",
+                              buckets=len(self._inflight)):
+                for bucket, reduced, _t0 in sorted(
+                        self._inflight, key=lambda x: -x[0].priority):
+                    t_b = tracing.now_us() if trc else 0.0
+                    t0 = _time.perf_counter()
+                    jax.block_until_ready(reduced._data)
+                    blocked = _time.perf_counter() - t0
+                    waited += blocked
+                    self._scatter(bucket, reduced._data, grads, outs)
+                    if trc:
+                        tracing.emit_span(
+                            "grad_sync.bucket_drain", t_b,
+                            tracing.now_us() - t_b, cat="comm",
+                            nbytes=bucket.nbytes, keys=len(bucket.keys),
+                            priority=bucket.priority,
+                            blocked_us=int(blocked * 1e6))
         finally:
             # a failed collective (dead worker mid-allreduce) must not wedge
             # the scheduler: clear in-flight work so the caller's next

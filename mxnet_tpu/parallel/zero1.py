@@ -54,6 +54,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import telemetry
+from .. import tracing
 from ..base import getenv, register_env
 from . import mesh as mesh_mod
 from .collectives import sharding_constraint
@@ -174,6 +175,17 @@ class Zero1Context:
         self._indices = ()
         if telemetry._enabled:
             telemetry.gauge("zero1.shards").set(self.nshards)
+        # memory census: the sharded flat state IS the optimizer-state
+        # residency claim (1/N per device) — a live view, because the
+        # donated buffers are replaced every step
+        from .. import memory
+        from jax import tree_util as _jtu
+
+        memory.register_provider(
+            "optimizer_state", self,
+            lambda s: [leaf for st in (s.flat_states or ())
+                       for leaf in _jtu.tree_leaves(st)
+                       if hasattr(leaf, "nbytes")])
 
     # -- identity ------------------------------------------------------------
 
@@ -204,6 +216,11 @@ class Zero1Context:
                optimizer._fused_static_key(), tuple(indices))
         if self._sig == sig and self.flat_states is not None:
             return
+        with tracing.span("zero1.ensure", cat="train", shards=self.nshards,
+                          params=len(indices)):
+            self._ensure(optimizer, updater, indices, weights, entries, sig)
+
+    def _ensure(self, optimizer, updater, indices, weights, entries, sig):
         if self.dirty and self.flat_states is not None and \
                 updater is not None:
             # the parameter set changed mid-run (sig mismatch with live

@@ -18,11 +18,13 @@ import time
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "Task", "Frame", "Event", "Counter", "Marker", "scope",
-           "record_op", "aggregate_stats", "dumps_aggregate", "dropped_events"]
+           "record_op", "aggregate_stats", "dumps_aggregate",
+           "dropped_events", "peek_json", "peek_doc"]
 
 _config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
 _events = []
 _dropped = 0  # events discarded once _events hit max_events
+_unmirrored = 0  # drops not yet flushed into the telemetry counter
 _MAX_EVENTS_DEFAULT = 1 << 20
 _lock = threading.Lock()
 _running = False
@@ -73,7 +75,7 @@ def resume(profile_process="worker"):
 
 
 def _emit(name, ph, cat="host", ts=None, args=None, dur=None):
-    global _dropped
+    global _dropped, _unmirrored
     if not _running:
         return
     ev = {"name": name, "ph": ph, "cat": cat, "pid": os.getpid(),
@@ -84,9 +86,13 @@ def _emit(name, ph, cat="host", ts=None, args=None, dur=None):
         ev["dur"] = dur
     with _lock:
         # bounded buffer: a profiler left running for a long job must not
-        # eat the heap — overflow is counted, never silent
+        # eat the heap — overflow is counted, never silent. Only the
+        # count moves here: once the buffer is full the drop path IS the
+        # steady state, so it must not take the telemetry registry lock
+        # per event — _mirror_drops() flushes the total at capture time
         if len(_events) >= _config.get("max_events", _MAX_EVENTS_DEFAULT):
             _dropped += 1
+            _unmirrored += 1
             return
         _events.append(ev)
 
@@ -169,23 +175,53 @@ def _reset_events():
     _dropped = 0
 
 
+def _mirror_drops():
+    """Flush accumulated drop counts into the monotonic
+    ``profiler.dropped_events`` telemetry counter — called at capture
+    time (NOT per dropped event) so silent event loss still shows in
+    every telemetry dump without the drop path taking the registry lock."""
+    global _unmirrored
+    with _lock:
+        n = _unmirrored
+        _unmirrored = 0
+    if n:
+        try:
+            from . import telemetry
+
+            telemetry.counter("profiler.dropped_events").inc(n)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _capture(reset=False):
     """Snapshot (events, dropped); ``reset`` clears the buffer in the SAME
     critical section, so an event emitted concurrently is either in this
     capture or in the next one — never silently dropped between two lock
-    takes."""
+    takes. Span-tracing events (`mxnet_tpu.tracing`) merge here so one
+    trace file carries host scopes, op dispatch AND request/step span
+    trees; on reset the tracing buffer is drained with the same
+    exactly-once contract."""
     with _lock:
         events = list(_events)
         dropped = _dropped
         if reset:
             _reset_events()
+    _mirror_drops()
+    try:
+        from . import tracing
+
+        t_events, t_dropped = tracing.take_events(reset=reset)
+        events = events + t_events
+        dropped += t_dropped
+    except Exception:  # noqa: BLE001 — the merge is additive
+        pass
     return events, dropped
 
 
-def _render_trace(events, dropped):
-    """Chrome-trace JSON with the telemetry registry's counter events
-    merged in (same timeline as the host scopes and the XLA trace) and the
-    dropped-event count in otherData."""
+def _render_doc(events, dropped):
+    """The chrome-trace document (dict) with the telemetry registry's
+    counter events merged in (same timeline as the host scopes and the
+    XLA trace) and the dropped-event count in otherData."""
     try:  # telemetry merge is additive — never break a dump
         from . import telemetry
 
@@ -194,13 +230,36 @@ def _render_trace(events, dropped):
     except Exception:  # noqa: BLE001
         pass
     doc = {"traceEvents": events}
+    other = {}
     if dropped:
-        doc["otherData"] = {"dropped_events": dropped}
-    return json.dumps(doc, indent=2)
+        other["dropped_events"] = dropped
+    # dist identity for tools/trace_merge.py: which worker wrote this dump
+    wid = os.environ.get("MXNET_PROCESS_ID", os.environ.get("DMLC_WORKER_ID"))
+    if wid is not None:
+        other["worker"] = wid
+    if other:
+        doc["otherData"] = other
+    return doc
+
+
+def _render_trace(events, dropped):
+    return json.dumps(_render_doc(events, dropped), indent=2)
 
 
 def _trace_json(reset=False):
     return _render_trace(*_capture(reset))
+
+
+def peek_doc():
+    """The current buffer (host scopes + tracing spans + telemetry
+    counters) as a chrome-trace dict WITHOUT consuming it — the telemetry
+    HTTP endpoint's /trace read (serialize once, no parse-back)."""
+    return _render_doc(*_capture(reset=False))
+
+
+def peek_json():
+    """:func:`peek_doc`, serialized."""
+    return json.dumps(peek_doc(), indent=2)
 
 
 def dumps(reset=False, sort_by="total", ascending=False):
@@ -211,6 +270,7 @@ def dumps(reset=False, sort_by="total", ascending=False):
             evs = list(_events)
             if reset:
                 _reset_events()
+        _mirror_drops()
         return dumps_aggregate(sort_by, ascending, events=evs)
     return _trace_json(reset=reset)
 

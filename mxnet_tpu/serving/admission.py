@@ -78,7 +78,8 @@ class Request:
     """
 
     __slots__ = ("arrays", "rows", "future", "deadline", "enqueued_at",
-                 "parent", "offset", "total_rows", "parts")
+                 "parent", "offset", "total_rows", "parts", "span",
+                 "traced_queue", "flow_ended")
 
     def __init__(self, arrays, rows, future, deadline=None):
         self.arrays = arrays
@@ -90,6 +91,13 @@ class Request:
         self.offset = 0             # row offset within the original request
         self.total_rows = self.rows  # original size (pieces keep parent's)
         self.parts = None           # on the original: delivered pieces
+        self.span = None            # tracing root span (MXNET_TRACING=1)
+        self.traced_queue = False   # queue span emitted for THIS piece (a
+        #                             deadline-survivor re-run must not
+        #                             emit it a second time)
+        self.flow_ended = False     # flow arrow landed (checked/set on the
+        #                             ORIGIN: one arrow per request, however
+        #                             many pieces or re-runs it takes)
 
     @property
     def origin(self):

@@ -37,6 +37,7 @@ from concurrent.futures import Future
 
 from .. import ndarray as nd
 from .. import telemetry
+from .. import tracing
 from ..base import getenv, register_env
 from ..log import get_logger
 from ..resilience import retry_call
@@ -159,7 +160,29 @@ class DynamicBatcher:
 
     def _submit_one(self, arrays, rows, deadline):
         fut = Future()
-        self._admission.put(Request(arrays, rows, fut, deadline=deadline))
+        req = Request(arrays, rows, fut, deadline=deadline)
+        if tracing._enabled:
+            # root span of this request's trace — finished by the thread
+            # that resolves the future (worker, assisting caller, or this
+            # thread on synchronous rejection)
+            req.span = tracing.begin("serving.request", cat="serving",
+                                     rows=rows)
+            sub = req.span.child("serving.admission")
+            # flow arrow from this submit slice to the batch that will
+            # compute the request (flow_end in _run_batch). Emitted BEFORE
+            # put(): once put() releases the request, the worker can emit
+            # the flow_end first and the arrow's end would precede its
+            # start; a dangling start on a rejected put is harmless
+            tracing.flow_start(req.span.span_id, name="serving.request")
+            try:
+                self._admission.put(req)
+            except Exception as e:
+                sub.set(error=repr(e)).finish()
+                req.span.set(error=repr(e)).finish()
+                raise
+            sub.finish()
+        else:
+            self._admission.put(req)
         if telemetry._enabled:
             telemetry.counter("serving.requests").inc()
         return fut
@@ -197,6 +220,8 @@ class DynamicBatcher:
                 telemetry.counter(
                     "serving.timeouts" if timeout else "serving.errors").inc()
             orig.future.set_exception(exc)
+            if orig.span is not None:
+                orig.span.set(error=repr(exc), timeout=timeout).finish()
 
     def _deliver(self, req, sliced, done_ts):
         """Hand a computed piece its rows; a split request resolves once
@@ -207,6 +232,8 @@ class DynamicBatcher:
         with self._result_lock:
             if orig.future.done():
                 return
+            t0r = (tracing.now_us()
+                   if tracing._enabled and orig.span is not None else None)
             if req.offset == 0 and req.rows == orig.total_rows:
                 orig.future.set_result(self._predictor._wrap_outputs(sliced))
             else:
@@ -221,6 +248,13 @@ class DynamicBatcher:
                           for k in range(len(sliced))]
                 orig.parts = None
                 orig.future.set_result(self._predictor._wrap_outputs(merged))
+            if t0r is not None:
+                # the request resolved on THIS thread: close its span tree
+                # (queue + execute spans were emitted by the batch runner)
+                tracing.emit_span("serving.reassembly", t0r,
+                                  tracing.now_us() - t0r, cat="serving",
+                                  parent=orig.span, rows=orig.total_rows)
+                orig.span.finish()
             if telemetry._enabled:
                 telemetry.histogram("serving.e2e_us").record(
                     (done_ts - orig.enqueued_at) * 1e6)
@@ -244,53 +278,93 @@ class DynamicBatcher:
                     (now - r.enqueued_at) * 1e6)
         rows = sum(r.rows for r in live)
         bucket = self._predictor.bucket_for(rows)
-        feeds = []
-        for i in range(len(self._predictor.data_names)):
-            parts = [r.arrays[i] for r in live]
-            feeds.append(parts[0] if len(parts) == 1
-                         else nd.concatenate(parts, axis=0))
-        earliest = min((r.deadline for r in live if r.deadline is not None),
-                       default=None)
+        trc = tracing._enabled
+        with tracing.span("serving.batch", cat="serving", rows=rows,
+                          bucket=bucket, reason=reason):
+            if trc:
+                # per-request queue spans (submit -> this pop) + the flow
+                # arrow landing in this batch's slice
+                t_pop = tracing.now_us()
+                for r in live:
+                    sp = r.origin.span
+                    if sp is None:
+                        continue
+                    if not r.traced_queue:
+                        r.traced_queue = True
+                        tracing.emit_span("serving.queue", sp.t0,
+                                          t_pop - sp.t0, cat="serving",
+                                          parent=sp, offset=r.offset,
+                                          rows=r.rows)
+                    if not r.origin.flow_ended:
+                        # one arrow per REQUEST: split pieces share the
+                        # origin's flow id, so only the first batch a
+                        # request lands in terminates the flow
+                        r.origin.flow_ended = True
+                        tracing.flow_end(sp.span_id, name="serving.request")
+            feeds = []
+            for i in range(len(self._predictor.data_names)):
+                parts = [r.arrays[i] for r in live]
+                feeds.append(parts[0] if len(parts) == 1
+                             else nd.concatenate(parts, axis=0))
+            earliest = min((r.deadline for r in live
+                            if r.deadline is not None), default=None)
 
-        def attempt():
-            # a retry must never run past the batch's earliest deadline —
-            # DeadlineExceededError is not in retry_on, so raising it here
-            # ends the retry loop immediately
-            if earliest is not None and time.monotonic() >= earliest:
-                raise DeadlineExceededError(
-                    "deadline passed before a (re)try could run")
-            return self._predictor._run(bucket, feeds)
+            def attempt():
+                # a retry must never run past the batch's earliest
+                # deadline — DeadlineExceededError is not in retry_on, so
+                # raising it here ends the retry loop immediately
+                if earliest is not None and time.monotonic() >= earliest:
+                    raise DeadlineExceededError(
+                        "deadline passed before a (re)try could run")
+                return self._predictor._run(bucket, feeds)
 
-        try:
-            outs = retry_call(attempt, desc=f"serving forward bucket={bucket}",
-                              retries=self._retries, backoff=self._backoff_s,
-                              retry_on=self._predictor.retry_on)
-        except DeadlineExceededError as e:
-            now = time.monotonic()
-            expired, rest = [], []
+            t_exec0 = tracing.now_us() if trc else 0.0
+            try:
+                outs = retry_call(attempt,
+                                  desc=f"serving forward bucket={bucket}",
+                                  retries=self._retries,
+                                  backoff=self._backoff_s,
+                                  retry_on=self._predictor.retry_on)
+            except DeadlineExceededError as e:
+                now = time.monotonic()
+                expired, rest = [], []
+                for r in live:
+                    (expired if r.deadline is not None and now >= r.deadline
+                     else rest).append(r)
+                for r in expired:
+                    self._fail(r, e, timeout=True)
+                if rest:
+                    # survivors still have deadline budget: re-run without
+                    # the expired requests (their rows no longer pad the
+                    # batch)
+                    self._run_batch(rest, reason)
+                return
+            except Exception as e:  # noqa: BLE001 — fail batch, keep serving
+                for r in live:
+                    self._fail(r, e)
+                return
+            if trc:
+                # each request's view of the shared compute window: one
+                # execute child per request makes every request tree
+                # complete (admission -> queue -> execute -> reassembly)
+                # without cross-referencing the batch span
+                t_exec1 = tracing.now_us()
+                for r in live:
+                    sp = r.origin.span
+                    if sp is not None:
+                        tracing.emit_span("serving.execute", t_exec0,
+                                          t_exec1 - t_exec0, cat="serving",
+                                          parent=sp, bucket=bucket,
+                                          batch_rows=rows)
+            if tele:
+                telemetry.counter("serving.batches").inc()
+                telemetry.counter("serving.batch_rows").inc(rows)
+                telemetry.counter("serving.batch_slots").inc(bucket)
+                telemetry.counter(f"serving.flush_{reason}").inc()
+                telemetry.histogram("serving.batch_occupancy").record(rows)
+            off = 0
+            done_ts = time.monotonic()
             for r in live:
-                (expired if r.deadline is not None and now >= r.deadline
-                 else rest).append(r)
-            for r in expired:
-                self._fail(r, e, timeout=True)
-            if rest:
-                # survivors still have deadline budget: re-run without the
-                # expired requests (their rows no longer pad the batch)
-                self._run_batch(rest, reason)
-            return
-        except Exception as e:  # noqa: BLE001 — fail the batch, keep serving
-            for r in live:
-                self._fail(r, e)
-            return
-        if tele:
-            telemetry.counter("serving.batches").inc()
-            telemetry.counter("serving.batch_rows").inc(rows)
-            telemetry.counter("serving.batch_slots").inc(bucket)
-            telemetry.counter(f"serving.flush_{reason}").inc()
-            telemetry.histogram("serving.batch_occupancy").record(rows)
-        off = 0
-        done_ts = time.monotonic()
-        for r in live:
-            sliced = [o[off:off + r.rows] for o in outs]
-            off += r.rows
-            self._deliver(r, sliced, done_ts)
+                sliced = [o[off:off + r.rows] for o in outs]
+                off += r.rows
+                self._deliver(r, sliced, done_ts)

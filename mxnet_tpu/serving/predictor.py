@@ -37,8 +37,10 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import memory
 from .. import ndarray as nd
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError, getenv, register_env
 from ..compile_cache import CompileCache
 from ..io.io import DataDesc, pad_arrays
@@ -163,6 +165,11 @@ class Predictor:
         self._cache = CompileCache("serving")
         self._execs = {}
         self._lock = threading.RLock()
+        # memory census: the bound parameters are the serving side's
+        # weight residency (SHARED across bucket executors — the census
+        # dedupes by buffer, so N buckets still count one copy)
+        memory.track("weights", list(self._arg_params.values())
+                     + list(self._aux_params.values()))
 
     # -- construction conveniences ------------------------------------------
 
@@ -285,13 +292,17 @@ class Predictor:
         import jax
 
         exec_ = self._bind_bucket(bucket)
-        padded, _ = pad_arrays(list(arrays), bucket)
+        with tracing.span("serving.pad", cat="serving", bucket=bucket):
+            padded, _ = pad_arrays(list(arrays), bucket)
         feed = dict(zip(self._data_names, padded))
         tele = telemetry._enabled
         t0 = time.perf_counter() if tele else 0.0
-        with self._lock:
+        with self._lock, tracing.span("serving.forward", cat="serving",
+                                      bucket=bucket):
             outs = list(exec_.forward(is_train=False, **feed))
             jax.block_until_ready([o._data for o in outs])
+        # in-flight batch residency: weak refs, swept as batches retire
+        memory.track_transient("serving_batches", padded + outs)
         if tele:
             telemetry.histogram("serving.compute_us").record(
                 (time.perf_counter() - t0) * 1e6)

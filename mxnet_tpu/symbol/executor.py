@@ -20,6 +20,7 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from .. import tracing
 from ..base import MXNetError
 from ..compile_cache import CompileCache
 from ..ops import registry as _reg
@@ -145,6 +146,20 @@ class Executor:
         # up as compile.cache_misses instead of silently re-specializing.
         # Bounded: churn that escapes padding caps memory too (oldest out)
         self._cache = CompileCache("executor", maxsize=64)
+
+        # memory census (live views — _data is reassigned every step):
+        # weights are the args something backprops into, gradients their
+        # bound cotangent buffers. Buffer-level dedup in the census makes
+        # double-registration (several executors binding shared weights)
+        # count once.
+        from .. import memory
+
+        memory.register_provider(
+            "weights", self,
+            lambda s: [a for n, a in s.arg_dict.items()
+                       if s._grad_req.get(n, "null") != "null"])
+        memory.register_provider("gradients", self,
+                                 lambda s: list(s.grad_dict.values()))
 
     # -- helpers -------------------------------------------------------------
 
@@ -442,7 +457,10 @@ class Executor:
             call_args = [jax.tree_util.tree_map(put, a) if i != 4 else a
                          for i, a in enumerate(call_args)]
         try:
-            outputs, new_ws, new_ss, aux_new = fn(*call_args)
+            with tracing.span("fused.dispatch", cat="train",
+                              params=len(names),
+                              zero1=zero1 is not None):
+                outputs, new_ws, new_ss, aux_new = fn(*call_args)
         except Exception as e:
             donated = [w._data for w in weights]
             if zero1 is not None:

@@ -64,8 +64,9 @@ from .log import get_logger
 __all__ = ["Counter", "Gauge", "Histogram",
            "counter", "gauge", "histogram", "get",
            "enabled", "enable", "disable", "reset",
-           "snapshot", "dumps", "dump", "dumps_table",
-           "trace_counter_events", "start_log_thread", "stop_log_thread"]
+           "snapshot", "dumps", "dump", "dumps_table", "prom_text",
+           "trace_counter_events", "start_log_thread", "stop_log_thread",
+           "start_http_server", "stop_http_server"]
 
 register_env("MXNET_TELEMETRY", False, "enable the runtime metrics registry")
 register_env("MXNET_TELEMETRY_DUMP", "",
@@ -74,6 +75,15 @@ register_env("MXNET_TELEMETRY_LOG_INTERVAL_S", 0.0,
              "log a telemetry summary every N seconds (0 = off)")
 register_env("MXNET_TELEMETRY_RESERVOIR", 1024,
              "histogram reservoir size (quantile accuracy vs. memory)")
+register_env("MXNET_TELEMETRY_HTTP_PORT", 0,
+             "serve /metrics (Prometheus text), /trace (chrome trace + "
+             "worst-step span tree) and /memory (device-buffer census) on "
+             "this port from a background thread (0 = off)")
+register_env("MXNET_TELEMETRY_HTTP_HOST", "127.0.0.1",
+             "bind address for the telemetry HTTP endpoint — loopback by "
+             "default; traces carry request args and file paths, so expose "
+             "on other interfaces (e.g. 0.0.0.0 for a Prometheus scrape "
+             "from another host) deliberately")
 
 # THE gate. Call sites read `telemetry._enabled` (one attribute fetch)
 # before doing any telemetry work, including taking timestamps.
@@ -430,6 +440,151 @@ def dumps_table(snap=None, sort_by="total"):
 
 
 # ---------------------------------------------------------------------------
+# Prometheus text export + the /metrics HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(name):
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    n = "".join(out)
+    return n if n[:1].isalpha() or n[:1] == "_" else "_" + n
+
+
+def prom_text(refresh_memory=True):
+    """The registry in Prometheus text exposition format (what the HTTP
+    ``/metrics`` endpoint serves, scrapeable by any Prometheus-compatible
+    collector). Counters/gauges/derived map 1:1 (names prefixed
+    ``mxnet_``, dots to underscores); histograms render as summaries
+    (p50/p95/p99 quantile series + ``_sum``/``_count``).
+    ``refresh_memory`` runs a device-buffer census first so ``memory.*``
+    gauges are live, not last-read."""
+    if refresh_memory:
+        try:
+            from . import memory
+
+            memory.update_gauges()
+        except Exception:  # noqa: BLE001 — census must not break a scrape
+            pass
+    snap = snapshot()
+    lines = []
+
+    def emit(name, kind, value):
+        n = "mxnet_" + _prom_name(name)
+        lines.append(f"# TYPE {n} {kind}")
+        lines.append(f"{n} {value}")
+
+    for name, v in sorted(snap["counters"].items()):
+        emit(name, "counter", v)
+    for name, v in sorted(snap["gauges"].items()):
+        emit(name, "gauge", v)
+    for name, v in sorted(snap["derived"].items()):
+        emit(name, "gauge", v)
+    for name, h in sorted(snap["histograms"].items()):
+        n = "mxnet_" + _prom_name(name)
+        lines.append(f"# TYPE {n} summary")
+        if h["count"]:
+            for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                lines.append(f'{n}{{quantile="{q}"}} {h[key]}')
+        lines.append(f"{n}_sum {h['sum']}")
+        lines.append(f"{n}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+_http_server = None
+_http_thread = None
+
+
+def start_http_server(port=None, host=None):
+    """Start the background observability endpoint (idempotent; opt-in via
+    ``MXNET_TELEMETRY_HTTP_PORT`` or an explicit port; binds
+    ``MXNET_TELEMETRY_HTTP_HOST``, loopback by default). Serves:
+
+    * ``/metrics`` — :func:`prom_text` (Prometheus scrape format);
+    * ``/trace``  — the current chrome-trace buffer (host spans + span
+      tracing + telemetry counters, NOT reset by the read) plus the
+      flight recorder's worst-step span tree;
+    * ``/memory`` — the live device-buffer census
+      (:func:`mxnet_tpu.memory.census`) + per-executable XLA memory
+      analysis where computed.
+
+    Returns the server (its ``.server_address[1]`` is the bound port —
+    pass port 0 for an ephemeral one in tests), or None when off."""
+    global _http_server, _http_thread
+    if _http_server is not None:
+        return _http_server
+    if port is None:
+        port = int(getenv("MXNET_TELEMETRY_HTTP_PORT"))
+        if not port:
+            return None
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet: not a user-facing web server
+            pass
+
+        def _send(self, body, ctype):
+            data = body.encode() if isinstance(body, str) else body
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            try:
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(prom_text(), "text/plain; version=0.0.4")
+                elif path == "/trace":
+                    from . import profiler, tracing
+
+                    doc = profiler.peek_doc()
+                    worst = tracing.flight_recorder.worst()
+                    if worst is not None:
+                        doc.setdefault("otherData", {})["worst_step"] = worst
+                    # compact: a near-cap buffer is hundreds of MB
+                    # pretty-printed, and this is a machine-read endpoint
+                    self._send(json.dumps(doc), "application/json")
+                elif path == "/memory":
+                    from . import memory
+
+                    doc = memory.census()
+                    doc["executables"] = memory.executable_stats()
+                    self._send(json.dumps(doc, indent=2), "application/json")
+                else:
+                    self.send_error(404, "try /metrics, /trace or /memory")
+            except Exception as e:  # noqa: BLE001 — a scrape must not crash
+                try:
+                    self.send_error(500, repr(e))
+                except Exception:
+                    pass
+
+    host = host or getenv("MXNET_TELEMETRY_HTTP_HOST")
+    _http_server = ThreadingHTTPServer((host, int(port)), Handler)
+    _http_thread = threading.Thread(target=_http_server.serve_forever,
+                                    daemon=True,
+                                    name="mxnet_tpu.telemetry.http")
+    _http_thread.start()
+    _logger().info("telemetry HTTP endpoint on %s:%d "
+                   "(/metrics, /trace, /memory)", host,
+                   _http_server.server_address[1])
+    return _http_server
+
+
+def stop_http_server():
+    global _http_server, _http_thread
+    if _http_server is not None:
+        _http_server.shutdown()
+        _http_server.server_close()
+        _http_server = None
+    if _http_thread is not None:
+        _http_thread.join(timeout=1.0)
+        _http_thread = None
+
+
+# ---------------------------------------------------------------------------
 # Periodic log summaries
 # ---------------------------------------------------------------------------
 
@@ -484,3 +639,9 @@ def _dump_at_exit():
 
 if _enabled:
     start_log_thread()
+
+if int(getenv("MXNET_TELEMETRY_HTTP_PORT") or 0):
+    try:  # opt-in endpoint; a busy port must not break import
+        start_http_server()
+    except Exception as _e:  # noqa: BLE001
+        _logger().error("telemetry HTTP endpoint failed to start: %r", _e)

@@ -52,6 +52,14 @@ def main(argv=None):
         line += ("\n  (a hit ratio well below 1 at steady state means "
                  "recompile churn — docs/faq/perf.md)\n")
         sys.stdout.write(line)
+    dropped = counters.get("profiler.dropped_events", 0)
+    t_dropped = counters.get("tracing.dropped_events", 0)
+    if dropped or t_dropped:
+        sys.stdout.write(
+            f"\nWARNING: event loss — profiler dropped {dropped}, tracing "
+            f"dropped {t_dropped} events (buffer overflow); traces from "
+            "this process are INCOMPLETE. Raise profiler max_events / "
+            "MXNET_TRACING_MAX_EVENTS or dump more often.\n")
     req = counters.get("serving.requests", 0)
     if req:
         hists = snap.get("histograms", {})

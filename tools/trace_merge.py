@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Merge per-worker chrome-trace dumps from a dist run into one trace.
+
+Usage::
+
+    python tools/trace_merge.py -o merged.json worker0.json worker1.json ...
+    python tools/trace_merge.py --report merged.json   # connectivity audit
+
+Each input is a ``profiler.dump()`` file from one worker of a dist run
+(``MXNET_TRACING=1``): span events carry ``trace_id``/``span_id``/
+``parent_id`` in ``args``, and training-step trace ids are DETERMINISTIC
+in ``(tag, epoch, step)`` (``tracing.deterministic_trace_id``) — every
+worker labels the same logical step with the same id without any
+cross-process exchange. That shared id is the join key here.
+
+Merging does two things:
+
+* **clock-skew normalization** — worker wall clocks disagree (NTP drift,
+  container start offsets). For every worker beyond the first, the skew
+  estimate is the MEDIAN over shared trace ids of (reference root start −
+  worker root start) for same-named root spans: barrier-synced steps
+  start near-simultaneously on every worker, so the median difference IS
+  the clock offset, robust to a few straggler steps. All of the worker's
+  timestamps are shifted by it.
+* **process separation** — each worker's events keep their own ``pid``
+  lane, renamed ``worker:<id>`` via chrome-trace process_name metadata,
+  so one timeline shows every worker's span tree for the same step
+  stacked under the same trace id.
+
+``--report`` prints the per-trace connectivity audit (also in the merged
+file's ``otherData.traces``): span count per trace id, workers that
+contributed, and orphan spans (a ``parent_id`` naming no merged span) —
+the CI dist smoke asserts every step trace is connected and orphan-free.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+
+__all__ = ["merge", "audit"]
+
+
+def _spans(doc):
+    """Complete events carrying span identity, from one trace doc."""
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") == "X" and "trace_id" in (ev.get("args") or {}):
+            yield ev
+
+
+def _roots_by_trace(doc):
+    """trace_id -> (name, earliest root-span start) for skew estimation.
+    Roots only (no parent_id): the step/request span every worker opens
+    at the barrier-synced moment."""
+    out = {}
+    for ev in _spans(doc):
+        a = ev["args"]
+        if a.get("parent_id"):
+            continue
+        key = a["trace_id"]
+        cur = out.get(key)
+        if cur is None or ev["ts"] < cur[1]:
+            out[key] = (ev["name"], ev["ts"])
+    return out
+
+
+def estimate_skew(ref_doc, doc):
+    """Microseconds to ADD to ``doc``'s timestamps to align its clock
+    with ``ref_doc``'s, from the median start-time difference of
+    same-named root spans sharing a trace id. None when the docs share
+    no trace id (disjoint runs — nothing to align on)."""
+    ref_roots = _roots_by_trace(ref_doc)
+    deltas = []
+    for tid, (name, ts) in _roots_by_trace(doc).items():
+        ref = ref_roots.get(tid)
+        if ref is not None and ref[0] == name:
+            deltas.append(ref[1] - ts)
+    if not deltas:
+        return None
+    return statistics.median(deltas)
+
+
+def _worker_label(doc, idx):
+    wid = (doc.get("otherData") or {}).get("worker")
+    return f"worker:{wid if wid is not None else idx}"
+
+
+def merge(docs):
+    """Merge parsed trace docs (first = clock reference). Returns one
+    chrome-trace doc: skew-shifted events, per-worker process_name
+    metadata, and the connectivity audit under ``otherData.traces``."""
+    events = []
+    skews = []
+    for idx, doc in enumerate(docs):
+        skew = 0.0 if idx == 0 else (estimate_skew(docs[0], doc) or 0.0)
+        skews.append(skew)
+        label = _worker_label(doc, idx)
+        pids = set()
+        for ev in doc.get("traceEvents", ()):
+            ev = dict(ev)
+            # chrome-trace pids collide across hosts — namespace them
+            pid = ev.get("pid", 0)
+            pids.add(pid)
+            ev["pid"] = f"{idx}:{pid}"
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + skew
+            events.append(ev)
+        for pid in pids:
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": f"{idx}:{pid}",
+                           "args": {"name": label}})
+    merged = {"traceEvents": events,
+              "otherData": {
+                  "workers": [_worker_label(d, i)
+                              for i, d in enumerate(docs)],
+                  "skew_us": skews}}
+    merged["otherData"]["traces"] = audit(merged)
+    return merged
+
+
+def audit(doc):
+    """Per-trace connectivity: ``{trace_id: {"name", "spans", "workers",
+    "orphans"}}``. An orphan is a span whose ``parent_id`` matches no
+    span in the SAME trace id — a broken handoff (inject without attach,
+    a root finished before its children were emitted)."""
+    by_trace = {}
+    for ev in _spans(doc):
+        a = ev["args"]
+        t = by_trace.setdefault(a["trace_id"],
+                                {"ids": set(), "events": [], "pids": set()})
+        t["ids"].add(a["span_id"])
+        t["events"].append(ev)
+        t["pids"].add(str(ev.get("pid")))
+    out = {}
+    for tid, t in sorted(by_trace.items()):
+        orphans = [ev["name"] for ev in t["events"]
+                   if ev["args"].get("parent_id")
+                   and ev["args"]["parent_id"] not in t["ids"]]
+        roots = [ev["name"] for ev in t["events"]
+                 if not ev["args"].get("parent_id")]
+        out[tid] = {"name": roots[0] if roots else None,
+                    "spans": len(t["events"]),
+                    "workers": len(t["pids"]),
+                    "orphans": orphans}
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="+",
+                    help="per-worker profiler.dump() JSON files (first is "
+                         "the clock reference), or ONE merged file with "
+                         "--report")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the merged chrome trace here")
+    ap.add_argument("--report", action="store_true",
+                    help="print the per-trace connectivity audit")
+    args = ap.parse_args(argv)
+
+    docs = []
+    for path in args.inputs:
+        with open(path) as f:
+            docs.append(json.load(f))
+    merged = docs[0] if len(docs) == 1 and args.report else merge(docs)
+
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(merged, f, indent=2)
+    rep = merged.get("otherData", {}).get("traces") or audit(merged)
+    broken = {t: v for t, v in rep.items() if v["orphans"]}
+    if args.report or broken:
+        for tid, v in sorted(rep.items()):
+            line = (f"{tid}  {v['name'] or '?':<18} spans={v['spans']:<4} "
+                    f"workers={v['workers']}")
+            if v["orphans"]:
+                line += f"  ORPHANS: {', '.join(v['orphans'][:5])}"
+            sys.stdout.write(line + "\n")
+        sys.stdout.write(f"{len(rep)} traces, {len(broken)} with orphans\n")
+    if args.output:
+        sys.stdout.write(f"merged {len(args.inputs)} dumps -> "
+                         f"{args.output}\n")
+    return 1 if broken else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
