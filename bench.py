@@ -729,6 +729,13 @@ def _measure_module(on_tpu, fetch_cost, fused=True):
     return img_s_fetch, img_s_disp, compile_s
 
 
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an ascending-sorted list (shared by the
+    serving and generation probes so their p50/p99 are comparable)."""
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(round(q / 100.0 * (len(sorted_vals) - 1))))]
+
+
 def _measure_serving(on_tpu):
     """serving_throughput probe: closed-loop clients firing ragged-size
     requests at a `serving.DynamicBatcher` over a small MLP Predictor —
@@ -807,11 +814,6 @@ def _measure_serving(on_tpu):
         wall = closed_loop(srv.predict, record=True)
 
     all_lat = sorted(x for per in lat for x in per)
-
-    def pct(q):
-        return all_lat[min(len(all_lat) - 1,
-                           int(round(q / 100.0 * (len(all_lat) - 1))))]
-
     total = n_clients * per_client
     # the comparison point: the same clients hammering the lock-shared
     # Predictor directly (no queue, no coalescing). With sub-ms CPU
@@ -823,13 +825,122 @@ def _measure_serving(on_tpu):
         "requests": total,
         "clients": n_clients,
         "req_per_s": round(total / wall, 1),
-        "p50_ms": round(pct(50) * 1e3, 3),
-        "p99_ms": round(pct(99) * 1e3, 3),
+        "p50_ms": round(_pct(all_lat, 50) * 1e3, 3),
+        "p99_ms": round(_pct(all_lat, 99) * 1e3, 3),
         "direct_req_per_s": round(total / direct_wall, 1),
         "cold_compile_s": round(warm["seconds"], 3),
         "warmup_compiles": warm["compiles"],
         "steady_state_compiles": pred.cache.misses - misses_warm,
         "buckets": list(buckets),
+    }
+
+
+def _measure_generation(on_tpu):
+    """generation_throughput probe: concurrent ragged streaming sessions
+    through the continuous-batching `serving.generation.GenerationEngine`
+    over a small TransformerLM — tokens/s, time-to-first-token p50/p99,
+    and the O(1) claim measured directly: per-token decode latency
+    FLATNESS (median inter-token gap late in a long generation over the
+    median early — a fixed-shape slab decode must hold this near 1.0,
+    where an O(T) re-forward path grows linearly). Cold compile seconds
+    (warmup) are separated from warm steady state, and the probe asserts
+    the 'generation' compile cache stayed cold-free afterwards
+    (`steady_state_compiles` must be 0 — nonzero means admission or
+    eviction churned a shape, the regression continuous batching exists
+    to prevent)."""
+    import threading
+
+    import numpy as np
+
+    import jax
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu import serving
+    from mxnet_tpu.models import TransformerLM, TransformerLMConfig
+    from mxnet_tpu.serving.generation import GenerationEngine
+
+    mesh = par.create_mesh(devices=jax.devices()[:1], dp=1)
+    cfg = TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, d_ff=128, n_layers=2,
+        max_len=128, dtype="bfloat16" if on_tpu else "float32")
+    lm = TransformerLM(cfg, mesh)
+    params = lm.init_params(jax.random.PRNGKey(0))
+    slots, buckets = 8, (8, 16, 32)
+    # with-block: a dead client or flatness failure must still close the
+    # engine (scheduler thread, KV slab + its census provider) or it
+    # pollutes the later bench phases sharing this process
+    with GenerationEngine(lm, params, max_slots=slots, max_len=cfg.max_len,
+                          buckets=buckets) as eng:
+        warm = serving.warmup(eng)  # cold phase: prefill ladder + decode
+        misses_warm = eng.cache.misses
+
+        n_clients = 4
+        per_client = int(os.environ.get(
+            "BENCH_GENERATION_SESSIONS", "12" if on_tpu else "6"))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, cfg.vocab_size, int(l)).astype(np.int32)
+                   for l in rng.randint(3, 24, size=64)]
+        lock = threading.Lock()
+        ttfts, tokens_done, errors = [], [0], []
+
+        def client(k):
+            try:
+                for i in range(per_client):
+                    p = prompts[(k * per_client + i) % len(prompts)]
+                    t0 = time.perf_counter()
+                    stream = eng.submit(p, max_new_tokens=16)
+                    first = next(stream)
+                    dt = time.perf_counter() - t0
+                    toks = [first] + list(stream)
+                    with lock:
+                        ttfts.append(dt)
+                        tokens_done[0] += len(toks)
+            except Exception as e:  # noqa: BLE001 — re-raised below: a dead
+                # client must become a generation_error entry, not silently-
+                # partial tokens/s numbers
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_clients)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+
+        # O(1) flatness: one long stream, inter-token gap late vs early
+        gaps, t_prev = [], time.perf_counter()
+        for _ in eng.submit(prompts[0][:4], max_new_tokens=96):
+            now = time.perf_counter()
+            gaps.append(now - t_prev)
+            t_prev = now
+        third = max(len(gaps) // 3, 1)
+        early = sorted(gaps[1:1 + third])
+        late = sorted(gaps[-third:])
+        flatness = late[len(late) // 2] / max(early[len(early) // 2], 1e-9)
+
+        steady = eng.cache.misses - misses_warm
+        slab_mb = eng.kv_slab_bytes() / 2 ** 20
+    assert steady == 0, f"steady-state generation compiles: {steady}"
+    ttfts.sort()
+    return {
+        "metric": "generation_throughput",
+        "sessions": n_clients * per_client,
+        "clients": n_clients,
+        "tokens": tokens_done[0],
+        "tokens_per_s": round(tokens_done[0] / wall, 1),
+        "ttft_p50_ms": round(_pct(ttfts, 50) * 1e3, 3),
+        "ttft_p99_ms": round(_pct(ttfts, 99) * 1e3, 3),
+        "per_token_latency_flatness": round(flatness, 3),
+        "cold_compile_s": round(warm["seconds"], 3),
+        "warmup_compiles": warm["compiles"],
+        "steady_state_compiles": steady,
+        "slots": slots,
+        "buckets": list(buckets),
+        "max_len": cfg.max_len,
+        "kv_slab_mb": round(slab_mb, 2),
     }
 
 
@@ -983,6 +1094,15 @@ def main():
                 result["serving"] = _measure_serving(on_tpu)
         except Exception:  # noqa: BLE001
             result["serving_error"] = \
+                traceback.format_exc(limit=3).strip().splitlines()[-1]
+        try:
+            # the generation plane: tokens/s + TTFT + per-token latency
+            # flatness through the continuous-batching engine, cold
+            # (prefill ladder + decode compiles) separated from warm
+            with _phase_scope("generation"):
+                result["generation"] = _measure_generation(on_tpu)
+        except Exception:  # noqa: BLE001
+            result["generation_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
         try:
             import jax

@@ -45,6 +45,7 @@ unit() {
       --ignore=tests/python/unittest/test_fused_step.py \
       --ignore=tests/python/unittest/test_grad_sync.py \
       --ignore=tests/python/unittest/test_serving.py \
+      --ignore=tests/python/unittest/test_generation.py \
       --ignore=tests/python/unittest/test_zero1.py \
       --ignore=tests/python/unittest/test_tracing.py
   # resilience gate, run standalone (not twice) so a fault-injection
@@ -75,6 +76,13 @@ unit() {
   # batching, admission or warmup regression fails HERE, attributed
   log "serving suite (predictor parity, micro-batching, admission control, warmup compile pinning)"
   python -m pytest tests/python/unittest/test_serving.py -q
+  # generation gate, standalone: these tests spin engine scheduler
+  # threads, flip the telemetry registry and pin EXACT generation
+  # compile-cache miss counts (continuous batching must never recompile
+  # mid-stream) plus continuous-vs-sequential BIT-EXACT token parity — a
+  # scheduler, KV-slab or compile-discipline regression fails HERE
+  log "generation suite (slot KV-cache sessions, continuous batching parity, streaming deadlines, router)"
+  python -m pytest tests/python/unittest/test_generation.py -q
   # zero1 gate, standalone: these tests flip MXNET_ZERO1/MXNET_ZERO1_NDEV
   # and pin sharding invariance, 1/N state allocation, checkpoint
   # round-trips and exact compile-cache miss counts — a sharded-update

@@ -8,9 +8,11 @@ them. This module is the truth plane:
 
 * **categories** — every long-lived device buffer the framework owns is
   registered under one of ``weights`` / ``optimizer_state`` /
-  ``gradients`` / ``serving_batches``; everything else live on the
-  backend (feeds in flight, temporaries the GC has not collected) shows
-  up as ``other``. Registration is by WEAK reference — a provider
+  ``gradients`` / ``serving_batches`` / ``kv_cache`` (the generation
+  engines' preallocated KV slabs — registered as live-view providers
+  because the slab arrays are REPLACED by every donated decode step);
+  everything else live on the backend (feeds in flight, temporaries the
+  GC has not collected) shows up as ``other``. Registration is by WEAK reference — a provider
   (executor, updater, ZeRO-1 context, predictor) that dies drops out of
   the census automatically, and tracking never extends a buffer's
   lifetime.
@@ -41,7 +43,8 @@ from . import telemetry
 __all__ = ["CATEGORIES", "track", "track_transient", "register_provider",
            "census", "update_gauges", "executable_stats", "clear"]
 
-CATEGORIES = ("weights", "optimizer_state", "gradients", "serving_batches")
+CATEGORIES = ("weights", "optimizer_state", "gradients", "serving_batches",
+              "kv_cache")
 
 _lock = threading.Lock()
 # category -> list of weakref.ref to NDArray / jax array (long-lived)

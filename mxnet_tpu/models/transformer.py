@@ -318,6 +318,181 @@ class TransformerLM:
             return logits, aux_total
         return logits
 
+    # -- incremental decoding (serving/generation) ---------------------------
+    #
+    # The O(1)-per-token cache discipline of arXiv:2603.09555: one
+    # preallocated KV slab of FIXED shape holds every live session's keys
+    # and values, `prefill` fills a slot's rows [0, L) from the prompt in
+    # one full-length pass, and `decode_step` extends every live slot by
+    # exactly one token — a dynamic_update_slice write plus attention over
+    # the (masked) slab row, never a recompile, never O(T) recomputation.
+    # Both are pure functions of (params, cache, ...) so the serving engine
+    # can jit them once per shape with the cache buffers donated.
+
+    def init_cache(self, max_slots, max_len=None):
+        """Allocate the slot-based KV slab: two arrays (keys, values) of
+        shape ``[max_slots, n_layers, n_heads, max_len, head_dim]`` in the
+        compute dtype, zeroed, replicated on the model's mesh. Slot
+        contents are garbage until a `prefill` claims the slot; reads are
+        always masked by the slot's current length, so stale rows from a
+        previous occupant are never attended."""
+        c = self.cfg
+        max_len = c.max_len if max_len is None else int(max_len)
+        if max_len > c.max_len:
+            raise ValueError(f"cache max_len {max_len} exceeds the model's "
+                             f"positional range {c.max_len}")
+        hd = c.d_model // c.n_heads
+        shape = (int(max_slots), c.n_layers, c.n_heads, max_len, hd)
+        repl = NamedSharding(self.mesh, P())
+        dt = jnp.dtype(c.dtype)
+        return (jax.device_put(jnp.zeros(shape, dt), repl),
+                jax.device_put(jnp.zeros(shape, dt), repl))
+
+    def _head(self, params):
+        return (params["embed"].T if self.cfg.tie_embeddings
+                else params["lm_head"])
+
+    def prefill(self, params, cache_k, cache_v, tokens, length, slot):
+        """Full-prompt forward for ONE session, writing its K/V into slot
+        ``slot`` rows ``[0, Lb)`` of the slab and returning the logits at
+        the last REAL token (position ``length - 1``) — the distribution
+        the first generated token is sampled from.
+
+        tokens : int32 [Lb]   prompt padded (with anything) up to the
+                              compile bucket; padded positions produce
+                              garbage K/V that the length mask keeps
+                              unread forever.
+        length : int32 scalar real prompt length (1 <= length <= Lb)
+        slot   : int32 scalar slab row to fill (traced — one executable
+                              serves every slot)
+
+        Returns ``(logits [V] fp32, cache_k, cache_v)``. Pure; jit with
+        the two cache operands donated.
+        """
+        c = self.cfg
+        dt = jnp.dtype(c.dtype)
+        Lb = tokens.shape[0]
+        hd = c.d_model // c.n_heads
+        scale = 1.0 / np.sqrt(hd)
+        h = jnp.take(params["embed"], tokens, axis=0).astype(dt)     # [Lb,D]
+        h = h + params["pos_embed"][:Lb].astype(dt)
+        # additive causal mask, large-negative (not -inf: a fully-masked
+        # row must softmax to harmless garbage, not NaN)
+        ar = jnp.arange(Lb)
+        causal = jnp.where(ar[:, None] >= ar[None, :], 0.0, -1e9)   # [Lb,Lb]
+        for i in range(c.n_layers):
+            ln1 = self._ln(h, params[f"l{i}.ln1_scale"],
+                           params[f"l{i}.ln1_bias"])
+            qkv = ln1 @ params[f"l{i}.wqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(Lb, c.n_heads, hd)
+            k = k.reshape(Lb, c.n_heads, hd)
+            v = v.reshape(Lb, c.n_heads, hd)
+            # slab write: [1, 1, H, Lb, hd] block at (slot, layer, 0, 0, 0)
+            cache_k = lax.dynamic_update_slice(
+                cache_k, k.transpose(1, 0, 2)[None, None].astype(cache_k.dtype),
+                (slot, i, 0, 0, 0))
+            cache_v = lax.dynamic_update_slice(
+                cache_v, v.transpose(1, 0, 2)[None, None].astype(cache_v.dtype),
+                (slot, i, 0, 0, 0))
+            s = jnp.einsum("qhd,khd->hqk", q, k,
+                           preferred_element_type=jnp.float32) * scale
+            p = jax.nn.softmax(s + causal[None], axis=-1).astype(dt)
+            attn = jnp.einsum("hqk,khd->qhd", p, v).reshape(Lb, c.d_model)
+            h = h + attn @ params[f"l{i}.wo"]
+            ln2 = self._ln(h, params[f"l{i}.ln2_scale"],
+                           params[f"l{i}.ln2_bias"])
+            if self._is_moe(i):
+                # batch-1 grouped dispatch; note: capacity is computed at
+                # the BUCKET length, so under heavy routing imbalance a
+                # bucket-padded prefill can keep tokens a shorter forward
+                # would have dropped (decode_step always keeps: C=1, L=1)
+                ff, _ = self._moe_ffn(i, params, ln2[None])
+                h = h + ff[0]
+            else:
+                ff = jax.nn.gelu(ln2 @ params[f"l{i}.w1"]
+                                 + params[f"l{i}.b1"].astype(dt))
+                h = h + ff @ params[f"l{i}.w2"] + params[f"l{i}.b2"].astype(dt)
+        h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
+        last = lax.dynamic_slice_in_dim(h, length - 1, 1, axis=0)    # [1,D]
+        logits = (last @ self._head(params).astype(dt)).astype(jnp.float32)
+        return logits[0], cache_k, cache_v
+
+    def decode_step(self, params, cache_k, cache_v, tokens, positions):
+        """One fused incremental step over the WHOLE slot slab: each slot
+        consumes one token, writes its K/V at ``positions[s]`` and attends
+        over rows ``[0, positions[s]]`` — O(1) work per token in generated
+        length, every slot in one XLA program.
+
+        tokens    : int32 [S] the token extending each slot (dead slots:
+                    anything — their output is discarded by the engine)
+        positions : int32 [S] the index each token occupies (== the slot's
+                    current length; dead slots: 0 — their garbage write
+                    lands in a row the length mask hides from any future
+                    occupant, because a new session's prefill rewrites
+                    [0, Lb) first)
+
+        Returns ``(logits [S, V] fp32, cache_k, cache_v)``. Pure; jit with
+        the cache operands donated. One executable serves every admission/
+        eviction pattern — continuous batching never recompiles.
+        """
+        c = self.cfg
+        dt = jnp.dtype(c.dtype)
+        S = tokens.shape[0]
+        L = cache_k.shape[3]
+        hd = c.d_model // c.n_heads
+        scale = 1.0 / np.sqrt(hd)
+        h = jnp.take(params["embed"], tokens, axis=0).astype(dt)      # [S,D]
+        h = h + jnp.take(params["pos_embed"], positions, axis=0).astype(dt)
+        # per-slot length mask over the slab row: attend j <= positions[s]
+        # (<=: the token just written attends to itself). Large-negative,
+        # not -inf — a dead slot masks everything and must produce finite
+        # garbage, not NaN.
+        mask = jnp.where(jnp.arange(L)[None, None, :]
+                         <= positions[:, None, None], 0.0, -1e9)    # [S,1,L]
+        for i in range(c.n_layers):
+            ln1 = self._ln(h, params[f"l{i}.ln1_scale"],
+                           params[f"l{i}.ln1_bias"])
+            qkv = ln1 @ params[f"l{i}.wqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(S, c.n_heads, hd)
+            k = k.reshape(S, c.n_heads, hd)
+            v = v.reshape(S, c.n_heads, hd)
+
+            def write(slab, new):
+                # per-slot dynamic_update_slice at that slot's position:
+                # [H, 1, hd] block into the slot's [H, L, hd] layer page
+                return jax.vmap(lambda page, u, p: lax.dynamic_update_slice(
+                    page, u, (0, p, 0)))(
+                        slab[:, i], new[:, :, None, :].astype(slab.dtype),
+                        positions)
+
+            ck_i = write(cache_k, k)                           # [S,H,L,hd]
+            cv_i = write(cache_v, v)
+            cache_k = cache_k.at[:, i].set(ck_i)
+            cache_v = cache_v.at[:, i].set(cv_i)
+            s = jnp.einsum("shd,shld->shl", q, ck_i.astype(dt),
+                           preferred_element_type=jnp.float32) * scale
+            p = jax.nn.softmax(s + mask, axis=-1).astype(dt)
+            attn = jnp.einsum("shl,shld->shd", p,
+                              cv_i.astype(dt)).reshape(S, c.d_model)
+            h = h + attn @ params[f"l{i}.wo"]
+            ln2 = self._ln(h, params[f"l{i}.ln2_scale"],
+                           params[f"l{i}.ln2_bias"])
+            if self._is_moe(i):
+                # [S, 1, D]: every slot is its own routing group of one
+                # token with capacity 1, so a decoded token is ALWAYS
+                # routed (never capacity-dropped, unlike training forward)
+                ff, _ = self._moe_ffn(i, params, ln2[:, None, :])
+                h = h + ff[:, 0]
+            else:
+                ff = jax.nn.gelu(ln2 @ params[f"l{i}.w1"]
+                                 + params[f"l{i}.b1"].astype(dt))
+                h = h + ff @ params[f"l{i}.w2"] + params[f"l{i}.b2"].astype(dt)
+        h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
+        logits = (h @ self._head(params).astype(dt)).astype(jnp.float32)
+        return logits, cache_k, cache_v
+
     # -- training -----------------------------------------------------------
 
     def loss(self, params, tokens, targets):

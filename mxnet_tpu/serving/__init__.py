@@ -20,7 +20,13 @@ thread-safe server:
   retried with ``resilience.retry_call`` semantics but never past a
   deadline;
 * :func:`warmup` — compile-ahead of every bucket so steady-state traffic
-  never pays a compile (exact count pinned by test);
+  never pays a compile (exact count pinned by test); also warms
+  generation engines (prefill ladder + decode);
+* :mod:`generation` — continuous-batching autoregressive serving: a
+  slot-based KV-cache session store with a token-level scheduler
+  (:class:`GenerationEngine`), streaming sessions
+  (:class:`GenerationStream`) and an occupancy-aware replica router
+  (:class:`GenerationRouter`);
 * telemetry — ``serving.*`` metrics: queue-depth gauge, batch-occupancy
   histogram, time-in-queue / compute / end-to-end latency p50-p95-p99,
   timeout + rejected counters, and the derived
@@ -38,9 +44,12 @@ Quick start::
 from .admission import (AdmissionQueue, DeadlineExceededError, QueueFullError,
                         Request, ServerClosedError, ServingError)
 from .batcher import DynamicBatcher
+from .generation import GenerationEngine, GenerationRouter, GenerationStream
 from .predictor import Predictor, bucket_ladder
 from .warmup import warmup
+from . import generation
 
 __all__ = ["Predictor", "DynamicBatcher", "AdmissionQueue", "Request",
            "ServingError", "QueueFullError", "DeadlineExceededError",
-           "ServerClosedError", "bucket_ladder", "warmup"]
+           "ServerClosedError", "bucket_ladder", "warmup", "generation",
+           "GenerationEngine", "GenerationRouter", "GenerationStream"]

@@ -79,13 +79,14 @@ class Request:
 
     __slots__ = ("arrays", "rows", "future", "deadline", "enqueued_at",
                  "parent", "offset", "total_rows", "parts", "span",
-                 "traced_queue", "flow_ended")
+                 "traced_queue", "flow_ended", "payload")
 
-    def __init__(self, arrays, rows, future, deadline=None):
+    def __init__(self, arrays, rows, future, deadline=None, payload=None):
         self.arrays = arrays
         self.rows = int(rows)
         self.future = future
         self.deadline = deadline
+        self.payload = payload      # owner-defined (a generation session)
         self.enqueued_at = time.monotonic()
         self.parent = None          # set on split-off head pieces
         self.offset = 0             # row offset within the original request
@@ -107,11 +108,17 @@ class Request:
 
 
 class AdmissionQueue:
-    """Bounded FIFO of :class:`Request` with the batch-flush wait logic."""
+    """Bounded FIFO of :class:`Request` with the batch-flush wait logic.
 
-    def __init__(self, max_depth=None):
+    ``metric_prefix`` names the telemetry series this queue publishes
+    (``<prefix>.queue_depth`` gauge, ``<prefix>.rejected`` counter) — the
+    batcher keeps the historical ``serving.*`` names, the generation
+    engine's intake reports as ``serving.generation.*``."""
+
+    def __init__(self, max_depth=None, metric_prefix="serving"):
         self._max_depth = int(getenv("MXNET_SERVING_MAX_QUEUE")
                               if max_depth is None else max_depth)
+        self._prefix = metric_prefix
         if self._max_depth < 1:
             raise MXNetError("serving queue depth must be >= 1, got "
                              f"{self._max_depth}")
@@ -147,7 +154,7 @@ class AdmissionQueue:
                     "serving queue is closed; no new requests accepted")
             if len(self._q) >= self._max_depth:
                 if telemetry._enabled:
-                    telemetry.counter("serving.rejected").inc()
+                    telemetry.counter(f"{self._prefix}.rejected").inc()
                 raise QueueFullError(
                     f"serving queue full ({len(self._q)} >= "
                     f"{self._max_depth} requests); shed load or raise "
@@ -155,7 +162,8 @@ class AdmissionQueue:
             self._q.append(req)
             self._rows += req.rows
             if telemetry._enabled:
-                telemetry.gauge("serving.queue_depth").set(len(self._q))
+                telemetry.gauge(f"{self._prefix}.queue_depth").set(
+                    len(self._q))
             if not self.assist_active:
                 self._cond.notify()
 
@@ -238,8 +246,29 @@ class AdmissionQueue:
                 self._rows -= k
                 rows += k
         if telemetry._enabled:
-            telemetry.gauge("serving.queue_depth").set(len(self._q))
+            telemetry.gauge(f"{self._prefix}.queue_depth").set(len(self._q))
         return out
+
+    def expire(self, now=None):
+        """Remove and return every queued request whose deadline has
+        passed (skipping already-resolved futures). The generation
+        engine sweeps this once per scheduler tick so a stream that will
+        never fit a slot in time fails with
+        :class:`DeadlineExceededError` NOW instead of wedging its
+        iterator until a slot frees up; the caller fails the returned
+        requests' futures/streams itself."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            expired = [r for r in self._q
+                       if r.deadline is not None and now >= r.deadline
+                       and not r.origin.future.done()]
+            for r in expired:
+                self._q.remove(r)
+                self._rows -= r.rows
+            if expired and telemetry._enabled:
+                telemetry.gauge(f"{self._prefix}.queue_depth").set(
+                    len(self._q))
+        return expired
 
     @staticmethod
     def _split(req, k):

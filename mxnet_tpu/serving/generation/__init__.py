@@ -1,0 +1,34 @@
+"""mxnet_tpu.serving.generation — continuous-batching autoregressive serving.
+
+PR 5's serving layer batches STATELESS one-shot requests; this subsystem
+serves token-by-token generation, the millions-of-users workload:
+
+* :class:`GenerationEngine` — a slot-based KV-cache session store (one
+  preallocated slab, fixed shapes, admission/eviction = a slot-index
+  write) driven by a token-level continuous scheduler: each tick runs ONE
+  fused ``decode_step`` over every live session, evicts finished/EOS/
+  deadline-expired sequences and admits queued prefills into the freed
+  slots mid-stream — O(1) per token, zero steady-state compiles
+  (arXiv:2603.09555's compile-once cache discipline through
+  ``CompileCache("generation")``);
+* :class:`GenerationStream` — ``submit() → iterator of tokens`` with
+  caller-runs assist, plus ``result()`` for collectors; failures
+  (deadline, engine error) raise in-band instead of wedging the iterator;
+* :class:`GenerationRouter` — spreads sessions across N engine replicas
+  by live-slot occupancy with queue-full failover.
+
+Quick start::
+
+    lm = TransformerLM(cfg, mesh)
+    eng = generation.GenerationEngine(lm, params, max_slots=16)
+    serving.warmup(eng)                      # pin prefill+decode compiles
+    stream = eng.submit(prompt_ids, max_new_tokens=64, timeout=2.0)
+    for tok in stream:                       # tokens as they decode
+        ...
+"""
+from .engine import GenerationEngine, prefill_ladder
+from .router import GenerationRouter
+from .session import GenerationStream
+
+__all__ = ["GenerationEngine", "GenerationRouter", "GenerationStream",
+           "prefill_ladder"]

@@ -1,0 +1,658 @@
+"""GenerationEngine — token-level continuous batching over a KV slot slab.
+
+PR 5's :class:`~mxnet_tpu.serving.batcher.DynamicBatcher` schedules at
+REQUEST granularity: a batch forms, computes once, and every member leaves
+together. Autoregressive generation breaks that shape — sessions are
+hundreds of sequential single-token steps of wildly different counts, so
+request-level batching would hold every finished sequence hostage to the
+longest one (and re-running the full forward per token would cost O(T) per
+token, O(T²) per sequence). This engine is the token-level scheduler:
+
+* **slot-based session store** — a preallocated KV slab
+  ``[max_slots, layers, heads, max_len, head_dim]``
+  (:meth:`TransformerLM.init_cache`) whose shape NEVER changes: admitting
+  a session is a prefill write into a free slot index, evicting is
+  clearing host-side metadata — continuous batching without a recompile,
+  ever (the arXiv:2603.09555 compile-once O(1)-cache discipline).
+* **continuous scheduling** — every engine tick runs ONE fused
+  ``decode_step`` over the whole slab (all live sessions advance one
+  token together), evicts finished/EOS/deadline-expired sessions, and
+  admits queued prefills into the freed slots mid-stream. The intake is
+  PR 5's :class:`~mxnet_tpu.serving.admission.AdmissionQueue`
+  (``QueueFullError`` backpressure, ``ServerClosedError`` after close,
+  per-session deadlines swept per tick via ``expire()``), prompts pad up
+  a prefill-length bucket ladder, and a blocking stream iterator assists
+  caller-runs style.
+* **compile discipline** — one ``CompileCache("generation")`` entry per
+  prefill bucket plus exactly ONE decode executable, all with the slab
+  buffers donated (``persistent=False``: donated programs stay out of the
+  on-disk XLA cache, the PR 3 aliasing rule). ``serving.warmup`` pins the
+  exact count ahead of traffic; steady state compiles nothing.
+
+Telemetry rides ``serving.generation.*`` (live-slot gauge, tokens/s,
+TTFT/tick histograms, per-reason eviction counters, derived
+``slot_fill_ratio``); tracing builds one span tree per session (root →
+queued → prefill → decode ticks → evict); the slab registers under the
+``kv_cache`` memory-census category.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ... import memory
+from ... import telemetry
+from ... import tracing
+from ...base import MXNetError, getenv, register_env
+from ...compile_cache import CompileCache
+from ...log import get_logger
+from ..admission import AdmissionQueue, DeadlineExceededError, Request
+from .session import GenerationStream
+
+__all__ = ["GenerationEngine", "prefill_ladder"]
+
+register_env("MXNET_GENERATION_SLOTS", 8,
+             "KV-slab slot count per generation engine: the max number of "
+             "concurrently-decoding sessions (one fused decode_step covers "
+             "the whole slab each tick)")
+register_env("MXNET_GENERATION_MAX_LEN", 256,
+             "KV-slab sequence capacity per slot (prompt + generated "
+             "tokens); bounds per-slot HBM at "
+             "2*layers*heads*max_len*head_dim*dtype bytes")
+register_env("MXNET_GENERATION_PREFILL_BUCKETS", "",
+             "prefill-length bucket ladder (comma-separated ints, each a "
+             "compiled prefill program); empty = powers of two from 8 up "
+             "to MXNET_GENERATION_MAX_LEN")
+register_env("MXNET_GENERATION_TICK_BUDGET_MS", 10.0,
+             "max milliseconds one scheduler tick spends admitting queued "
+             "prefills before the fused decode runs again (>= 1 admission "
+             "per tick when slots are free, so queues always drain)")
+
+
+def prefill_ladder(buckets, max_len):
+    """Normalize a prefill bucket spec (None ->
+    ``MXNET_GENERATION_PREFILL_BUCKETS``; empty -> powers of two up to
+    ``max_len``) into an ascending tuple capped at ``max_len`` —
+    spec parsing/validation shared with the predictor's
+    :func:`~mxnet_tpu.serving.predictor.bucket_ladder`."""
+    from ..predictor import bucket_ladder
+
+    if buckets is None:
+        buckets = getenv("MXNET_GENERATION_PREFILL_BUCKETS")
+    if not (buckets.strip() if isinstance(buckets, str) else buckets):
+        b, buckets = 8, []
+        while b < max_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_len)
+    out = bucket_ladder(buckets, env_var="MXNET_GENERATION_PREFILL_BUCKETS")
+    return tuple(sorted({min(int(b), int(max_len)) for b in out}))
+
+
+class _Session:
+    """Engine-side state of one admitted (or queued) generation."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline", "stream",
+                 "span", "slot", "generated")
+
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline, stream):
+        self.prompt = prompt            # np.int32 [n]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.stream = stream
+        self.span = None                # tracing root (MXNET_TRACING=1)
+        self.slot = None
+        self.generated = 0
+
+
+class GenerationEngine:
+    """Continuous-batching autoregressive server over one model replica.
+
+    Parameters
+    ----------
+    model : TransformerLM
+        Functional model providing ``init_cache`` / ``prefill`` /
+        ``decode_step`` (pure, jit-able, cache-donating).
+    params : dict[str, jax.Array]
+        The model's parameters (``init_params`` placement).
+    max_slots / max_len / buckets / tick_budget_ms :
+        Overrides of the ``MXNET_GENERATION_*`` knobs.
+    max_queue : int, optional
+        Intake bound (default ``MXNET_SERVING_MAX_QUEUE``).
+    eos_id : int, optional
+        Default end-of-sequence token for sessions that don't pass one.
+    start : bool
+        Spin the scheduler worker thread (tests drive ticks manually with
+        ``False``).
+    """
+
+    def __init__(self, model, params, max_slots=None, max_len=None,
+                 buckets=None, max_queue=None, tick_budget_ms=None,
+                 eos_id=None, start=True):
+        self._model = model
+        self._params = params
+        self._slots = int(getenv("MXNET_GENERATION_SLOTS")
+                          if max_slots is None else max_slots)
+        self._max_len = int(getenv("MXNET_GENERATION_MAX_LEN")
+                            if max_len is None else max_len)
+        self._max_len = min(self._max_len, model.cfg.max_len)
+        if self._slots < 1:
+            raise MXNetError(f"need >= 1 slot, got {self._slots}")
+        self._buckets = prefill_ladder(buckets, self._max_len)
+        budget_ms = (getenv("MXNET_GENERATION_TICK_BUDGET_MS")
+                     if tick_budget_ms is None else tick_budget_ms)
+        self._tick_budget_s = float(budget_ms) / 1e3
+        self._eos_id = eos_id
+        self._logger = get_logger("mxnet_tpu.serving.generation")
+
+        self._cache = CompileCache("generation")
+        self._ck, self._cv = model.init_cache(self._slots, self._max_len)
+        # host-side slot metadata — only the tick loop (under _tick_lock)
+        # mutates these
+        self._sessions = [None] * self._slots
+        self._lengths = np.zeros(self._slots, np.int32)
+        self._last_tok = np.zeros(self._slots, np.int32)
+        self._live = 0
+
+        self._queue = AdmissionQueue(max_queue,
+                                     metric_prefix="serving.generation")
+        self._tick_lock = threading.Lock()
+        self._work = threading.Condition()
+        self._closed = False
+        self._tokens_window = 0
+        self._rate_t0 = time.monotonic()
+        self.sessions_submitted = 0   # per-replica intake (router balance)
+
+        # the slab is device state the engine REPLACES every tick, so the
+        # census needs a live view, not a snapshot weakref
+        memory.register_provider("kv_cache", self,
+                                 lambda e: [e._ck, e._cv])
+
+        self._worker = None
+        if start:
+            self._worker = threading.Thread(
+                target=self._loop, daemon=True,
+                name="mxnet_tpu.serving.generation.engine")
+            self._worker.start()
+
+    # -- properties ----------------------------------------------------------
+
+    @property
+    def max_slots(self):
+        return self._slots
+
+    @property
+    def max_len(self):
+        return self._max_len
+
+    @property
+    def prefill_buckets(self):
+        return self._buckets
+
+    @property
+    def cache(self):
+        """The engine's ``"generation"`` :class:`CompileCache` — ``.misses``
+        is the exact number of programs compiled so far."""
+        return self._cache
+
+    @property
+    def live_slots(self):
+        return self._live
+
+    @property
+    def queue_depth(self):
+        return len(self._queue)
+
+    @property
+    def load(self):
+        """Occupancy the router balances on: (live + queued) / slots."""
+        return (self._live + len(self._queue)) / float(self._slots)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def kv_slab_bytes(self):
+        """Total device bytes the KV slab pins (both key and value
+        arrays) — the number ``docs/faq/perf.md`` "Sizing the KV slab"
+        budgets."""
+        return int(self._ck.nbytes) + int(self._cv.nbytes)
+
+    def bucket_for(self, n):
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return None
+
+    # -- client API ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=64, eos_id=None, timeout=None):
+        """Admit one prompt; returns a :class:`GenerationStream`
+        immediately. ``timeout`` (seconds) is the SESSION deadline —
+        checked every scheduler tick, in queue and mid-generation; expiry
+        evicts the slot and fails the stream with
+        :class:`DeadlineExceededError`. Raises ``QueueFullError`` /
+        ``ServerClosedError`` synchronously (backpressure is a signal,
+        not a stall)."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise MXNetError("empty prompt")
+        if prompt.size > self._buckets[-1]:
+            raise MXNetError(
+                f"prompt of {prompt.size} tokens exceeds the largest "
+                f"prefill bucket {self._buckets[-1]}")
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if prompt.size + int(max_new_tokens) > self._max_len:
+            raise MXNetError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the slab capacity "
+                f"{self._max_len} (MXNET_GENERATION_MAX_LEN)")
+        deadline = (time.monotonic() + float(timeout)
+                    if timeout is not None else None)
+        stream = GenerationStream(self, prompt.size, max_new_tokens,
+                                  deadline)
+        sess = _Session(prompt, max_new_tokens,
+                        self._eos_id if eos_id is None else eos_id,
+                        deadline, stream)
+        if tracing._enabled:
+            sess.span = tracing.begin("generation.session", cat="generation",
+                                      prompt_tokens=int(prompt.size),
+                                      max_new_tokens=int(max_new_tokens))
+        req = Request([prompt], 1, stream._future, deadline=deadline,
+                      payload=sess)
+        try:
+            self._queue.put(req)
+        except Exception as e:
+            if sess.span is not None:
+                sess.span.set(error=repr(e)).finish()
+            raise
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.sessions").inc()
+        with self._work:
+            # under the condition lock: concurrent submitters would lose
+            # increments of a bare +=
+            self.sessions_submitted += 1
+            self._work.notify_all()
+        return stream
+
+    def generate(self, prompt, **kwargs):
+        """Blocking convenience: submit and collect the full token list
+        (the iterator's caller-runs assist drives ticks inline when the
+        worker is idle)."""
+        return list(self.submit(prompt, **kwargs))
+
+    def warm(self, buckets=None):
+        """Compile-ahead every generation executable: one prefill program
+        per bucket plus THE decode program, counted exactly
+        (``cache.misses`` delta). Prefill warms write garbage into a FREE
+        slot (skipped, with a log, for buckets that cannot get one on an
+        already-full slab — they were compiled by real traffic anyway) and
+        the decode warm runs only while no session is live, so warming a
+        serving engine never perturbs a session. Returns
+        ``{"buckets", "compiles", "seconds", "cache_entries"}``."""
+        import jax.numpy as jnp
+
+        buckets = (self._buckets if buckets is None
+                   else tuple(sorted({int(b) for b in buckets})))
+        t0 = time.perf_counter()
+        misses0 = self._cache.misses
+        with self._tick_lock:
+            free = next((i for i, s in enumerate(self._sessions)
+                         if s is None), None)
+            for b in buckets:
+                if b not in self._buckets:
+                    raise MXNetError(f"bucket {b} not in ladder "
+                                     f"{self._buckets}")
+                if free is None:
+                    self._logger.warning(
+                        "generation warmup: slab full, skipping prefill "
+                        "warm for bucket %d", b)
+                    continue
+                fn = self._prefill_fn(b)
+                _, self._ck, self._cv = fn(
+                    self._params, self._ck, self._cv,
+                    jnp.zeros((b,), jnp.int32), jnp.asarray(1, jnp.int32),
+                    jnp.asarray(free, jnp.int32))
+            if self._live == 0:
+                fn = self._decode_fn()
+                _, self._ck, self._cv = fn(
+                    self._params, self._ck, self._cv,
+                    jnp.asarray(self._last_tok), jnp.asarray(self._lengths))
+        compiles = self._cache.misses - misses0
+        seconds = time.perf_counter() - t0
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.warmup_compiles").inc(
+                compiles)
+        self._logger.info(
+            "generation warmup: %d bucket(s) + decode -> %d compile(s) in "
+            "%.2fs (cache %r holds %d executables)", len(buckets), compiles,
+            seconds, self._cache.name, len(self._cache))
+        return {"buckets": list(buckets), "compiles": compiles,
+                "seconds": seconds, "cache_entries": len(self._cache)}
+
+    def close(self, timeout=None):
+        """Graceful drain: stop admission (``ServerClosedError`` for new
+        submits), keep ticking until every admitted AND queued session
+        completes, join the worker. Idempotent."""
+        self._queue.close()
+        self._closed = True
+        with self._work:
+            self._work.notify_all()
+        if self._worker is not None and self._worker.is_alive():
+            self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    def stats(self):
+        return {"cache": self._cache.snapshot(),
+                "buckets": list(self._buckets),
+                "slots": self._slots, "live": self._live,
+                "queued": len(self._queue),
+                "sessions": self.sessions_submitted,
+                "max_len": self._max_len,
+                "kv_slab_bytes": self.kv_slab_bytes()}
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _prefill_fn(self, bucket):
+        """The bucket's prefill executable: prompt forward + slab write +
+        greedy next token, slab buffers donated."""
+        model, cache = self._model, self._cache
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            def fn(params, ck, cv, toks, length, slot):
+                logits, ck, cv = model.prefill(params, ck, cv, toks,
+                                               length, slot)
+                return jnp.argmax(logits).astype(jnp.int32), ck, cv
+
+            return jax.jit(fn, donate_argnums=(1, 2))
+
+        key = ("prefill", bucket, self._slots, self._max_len)
+        return cache.get_or_build(key, build, persistent=False)
+
+    def _decode_fn(self):
+        """THE decode executable — one fused step over the whole slab,
+        greedy sampling inside, slab buffers donated. Its key never
+        changes, so continuous admission/eviction is hit-only."""
+        model, cache = self._model, self._cache
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            def fn(params, ck, cv, tokens, positions):
+                logits, ck, cv = model.decode_step(params, ck, cv, tokens,
+                                                   positions)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), ck, cv
+
+            return jax.jit(fn, donate_argnums=(1, 2))
+
+        key = ("decode", self._slots, self._max_len)
+        return cache.get_or_build(key, build, persistent=False)
+
+    # -- scheduler -----------------------------------------------------------
+
+    def _has_work(self):
+        return self._live > 0 or len(self._queue) > 0
+
+    def _loop(self):
+        while True:
+            with self._work:
+                while not self._closed and not self._has_work():
+                    self._work.wait()
+                if self._closed and not self._has_work():
+                    return
+            self._tick_once()
+
+    def _assist_once(self):
+        """Caller-runs assist (stream iterators call this while waiting):
+        run one tick inline if the tick lock is free. Returns True when a
+        tick ran (or there was nothing to do), False when the worker (or
+        another assistant) holds the lock — the caller should briefly
+        park instead of spinning."""
+        if not self._tick_lock.acquire(blocking=False):
+            return False
+        try:
+            if self._has_work():
+                self._tick()
+            return True
+        finally:
+            self._tick_lock.release()
+
+    def _tick_once(self):
+        with self._tick_lock:
+            if self._has_work():
+                self._tick()
+
+    def _tick(self):
+        """One scheduler tick (tick lock held): sweep deadlines, admit
+        prefills into free slots, run ONE fused decode over the slab,
+        evict finished sessions. A tick never raises — an executable
+        failure fails the live sessions (never-strand, the batcher's
+        guard) and reallocates the possibly-donated slab."""
+        tele = telemetry._enabled
+        t0 = time.perf_counter()
+        try:
+            now = time.monotonic()
+            for req in self._queue.expire(now):
+                self._fail_queued(req.payload, now)
+            for slot, sess in enumerate(self._sessions):
+                if (sess is not None and sess.deadline is not None
+                        and now >= sess.deadline):
+                    self._evict(slot, "deadline", DeadlineExceededError(
+                        f"session deadline passed after {sess.generated} "
+                        "generated token(s)"))
+            self._admit()
+            self._decode()
+        except Exception as e:  # noqa: BLE001 — never-strand + keep serving
+            self._logger.error("generation tick failed: %r", e)
+            for slot, sess in enumerate(self._sessions):
+                if sess is not None:
+                    self._evict(slot, "error", e)
+            # the failed executable may have consumed the donated slab
+            self._ck, self._cv = self._model.init_cache(self._slots,
+                                                        self._max_len)
+        if tele:
+            dt = time.perf_counter() - t0
+            telemetry.counter("serving.generation.ticks").inc()
+            telemetry.histogram("serving.generation.tick_us").record(dt * 1e6)
+            telemetry.gauge("serving.generation.live_slots").set(self._live)
+            now = time.monotonic()
+            if not self._has_work():
+                # going idle: an un-reset gauge would report the last
+                # active window's rate forever (the parked scheduler
+                # never recomputes it)
+                telemetry.gauge("serving.generation.tokens_per_s").set(0.0)
+                self._tokens_window = 0
+                self._rate_t0 = now
+            elif now - self._rate_t0 >= 0.5:
+                telemetry.gauge("serving.generation.tokens_per_s").set(
+                    self._tokens_window / (now - self._rate_t0))
+                self._tokens_window = 0
+                self._rate_t0 = now
+
+    def _admit(self):
+        """Move queued sessions into free slots (prefill), oldest first,
+        until the slab is full, the queue is empty, or the tick budget is
+        spent — at least one admission per tick when a slot is free, so
+        backlog always drains even under a tiny budget."""
+        import jax.numpy as jnp
+
+        free = [i for i, s in enumerate(self._sessions) if s is None]
+        if not free:
+            return
+        t0 = time.perf_counter()
+        tele = telemetry._enabled
+        while free:
+            batch, _ = self._queue.get_batch_nowait(1)
+            if not batch:
+                return
+            sess = batch[0].payload
+            now = time.monotonic()
+            if sess.deadline is not None and now >= sess.deadline:
+                self._fail_queued(sess, now)
+                continue
+            slot = free.pop(0)
+            n = int(sess.prompt.size)
+            bucket = self.bucket_for(n)
+            padded = np.zeros(bucket, np.int32)
+            padded[:n] = sess.prompt
+            t_pf = time.perf_counter()
+            trc = tracing._enabled and sess.span is not None
+            if trc:
+                # queue-wait child reconstructed from the submit instant
+                tracing.emit_span("generation.queued", sess.span.t0,
+                                  tracing.now_us() - sess.span.t0,
+                                  cat="generation", parent=sess.span)
+                t_pf_us = tracing.now_us()
+            fn = self._prefill_fn(bucket)
+            try:
+                tok, self._ck, self._cv = fn(
+                    self._params, self._ck, self._cv, jnp.asarray(padded),
+                    jnp.asarray(n, jnp.int32), jnp.asarray(slot, jnp.int32))
+            except Exception as e:
+                # the popped session is in neither the queue nor a slot —
+                # the tick handler only evicts ADMITTED sessions, so fail
+                # its stream here or it is stranded forever (never-strand,
+                # the batcher's guard); re-raise for the slab reallocation
+                if tele:
+                    telemetry.counter("serving.generation.evictions").inc()
+                    telemetry.counter("serving.generation.evict_error").inc()
+                sess.stream._fail(e)
+                if sess.span is not None:
+                    sess.span.set(error=repr(e), reason="error").finish()
+                raise
+            tok = int(tok)
+            if trc:
+                tracing.emit_span("generation.prefill", t_pf_us,
+                                  tracing.now_us() - t_pf_us,
+                                  cat="generation", parent=sess.span,
+                                  bucket=bucket, slot=slot)
+            sess.slot = slot
+            self._sessions[slot] = sess
+            self._lengths[slot] = n
+            self._last_tok[slot] = tok
+            self._live += 1
+            self._deliver(sess, tok, first=True)
+            if tele:
+                telemetry.counter("serving.generation.prefills").inc()
+                telemetry.histogram("serving.generation.prefill_us").record(
+                    (time.perf_counter() - t_pf) * 1e6)
+            # the prompt's last token may already end the session; a slot
+            # freed that way goes straight back on the free list so a
+            # burst of first-token-EOS sessions drains within the tick
+            self._maybe_finish(slot)
+            if self._sessions[slot] is None:
+                free.append(slot)
+            if time.perf_counter() - t0 > self._tick_budget_s:
+                return
+
+    def _decode(self):
+        """ONE fused decode step over the whole slab; every live session
+        advances one token. Dead slots ride along as masked garbage —
+        that fixed shape is exactly what makes mid-stream admit/evict
+        free."""
+        import jax.numpy as jnp
+
+        if self._live == 0:
+            return
+        fn = self._decode_fn()
+        toks, self._ck, self._cv = fn(
+            self._params, self._ck, self._cv,
+            jnp.asarray(self._last_tok), jnp.asarray(self._lengths))
+        toks = np.asarray(toks)
+        trc = tracing._enabled
+        if trc:
+            t_us = tracing.now_us()
+        live = 0
+        for slot, sess in enumerate(self._sessions):
+            if sess is None:
+                continue
+            live += 1
+            # the token we fed now occupies position lengths[slot]
+            self._lengths[slot] += 1
+            tok = int(toks[slot])
+            self._last_tok[slot] = tok
+            if trc and sess.span is not None:
+                tracing.emit_span("generation.decode_tick", t_us, 0.0,
+                                  cat="generation", parent=sess.span,
+                                  position=int(self._lengths[slot]))
+            self._deliver(sess, tok)
+            self._maybe_finish(slot)
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.decode_tokens").inc(live)
+            telemetry.counter("serving.generation.tick_slots").inc(
+                self._slots)
+
+    # -- delivery / eviction -------------------------------------------------
+
+    def _deliver(self, sess, tok, first=False):
+        sess.generated += 1
+        sess.stream._push(tok)
+        self._tokens_window += 1
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.tokens").inc()
+            if first:
+                telemetry.histogram("serving.generation.ttft_us").record(
+                    (time.monotonic() - sess.stream.submitted_at) * 1e6)
+
+    def _maybe_finish(self, slot):
+        """Evict the slot if its session just reached a terminal state."""
+        sess = self._sessions[slot]
+        if sess.eos_id is not None and self._last_tok[slot] == sess.eos_id:
+            self._evict(slot, "eos")
+        elif sess.generated >= sess.max_new_tokens:
+            self._evict(slot, "finished")
+        elif self._lengths[slot] + 1 > self._max_len:
+            # no room to write the next token's K/V — the slab, not the
+            # request, is the binding constraint here
+            self._evict(slot, "max_len")
+
+    def _evict(self, slot, reason, exc=None):
+        """Free the slot: host metadata only — the KV rows stay as masked
+        garbage until the next occupant's prefill rewrites them."""
+        sess = self._sessions[slot]
+        self._sessions[slot] = None
+        self._lengths[slot] = 0
+        self._last_tok[slot] = 0
+        self._live -= 1
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.evictions").inc()
+            telemetry.counter(f"serving.generation.evict_{reason}").inc()
+        if exc is not None:
+            sess.stream._fail(exc)
+        else:
+            sess.stream._finish()
+        if sess.span is not None:
+            t_us = tracing.now_us()
+            tracing.emit_span("generation.evict", t_us, 0.0,
+                              cat="generation", parent=sess.span,
+                              reason=reason)
+            sess.span.set(reason=reason, tokens=sess.generated,
+                          **({"error": repr(exc)} if exc is not None else {}))
+            sess.span.finish()
+
+    def _fail_queued(self, sess, now):
+        """Deadline death while still queued: no slot to free, just the
+        stream to unblock (and the span tree to close)."""
+        exc = DeadlineExceededError(
+            f"session waited {now - sess.stream.submitted_at:.3f}s in "
+            "queue, past its deadline")
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.evict_deadline").inc()
+            telemetry.counter("serving.generation.evictions").inc()
+        sess.stream._fail(exc)
+        if sess.span is not None:
+            sess.span.set(error=repr(exc), reason="deadline").finish()

@@ -1,0 +1,95 @@
+"""GenerationRouter — spread sessions across engine replicas by occupancy.
+
+One :class:`~mxnet_tpu.serving.generation.engine.GenerationEngine` is one
+model replica with one KV slab; scale-out is N of them behind this router.
+Placement is LOAD-AWARE, not round-robin: each submit goes to the replica
+with the lowest ``(live slots + queued sessions) / max_slots`` — queued
+sessions count so that a burst doesn't pile onto one replica before its
+prefills land — with a rotating tie-break so equal-load replicas (an idle
+fleet) still share evenly. A replica rejecting with ``QueueFullError``
+fails over to the next-least-loaded one; only when EVERY replica is full
+does the caller see backpressure.
+"""
+from __future__ import annotations
+
+import itertools
+
+from ... import telemetry
+from ...base import MXNetError
+from ..admission import QueueFullError
+
+__all__ = ["GenerationRouter"]
+
+
+class GenerationRouter:
+    """Occupancy-balancing front end over N generation engines."""
+
+    def __init__(self, engines):
+        engines = list(engines)
+        if not engines:
+            raise MXNetError("GenerationRouter needs >= 1 engine")
+        self._engines = engines
+        self._rr = itertools.count()
+
+    @property
+    def engines(self):
+        return list(self._engines)
+
+    def loads(self):
+        """Per-replica occupancy, the placement signal."""
+        return [e.load for e in self._engines]
+
+    def submit(self, prompt, **kwargs):
+        """Place one session on the least-loaded replica (rotating
+        tie-break); fail over across replicas on ``QueueFullError`` and
+        re-raise it only when every replica is saturated."""
+        n = len(self._engines)
+        k = next(self._rr)
+        order = sorted(range(n),
+                       key=lambda i: (self._engines[(i + k) % n].load, i))
+        last_exc = None
+        for i in order:
+            eng = self._engines[(i + k) % n]
+            try:
+                stream = eng.submit(prompt, **kwargs)
+            except QueueFullError as e:
+                last_exc = e
+                continue
+            if telemetry._enabled:
+                telemetry.counter("serving.generation.routed").inc()
+            return stream
+        raise last_exc if last_exc is not None else QueueFullError(
+            "every generation replica is saturated")
+
+    def generate(self, prompt, **kwargs):
+        """Blocking convenience: route, then collect the full token list."""
+        return list(self.submit(prompt, **kwargs))
+
+    def warm(self, buckets=None):
+        """Warm every replica (each compiles its own executables); sums
+        the compile counts — ``serving.warmup`` reports through this."""
+        out = {"buckets": None, "compiles": 0, "seconds": 0.0,
+               "cache_entries": 0}
+        for e in self._engines:
+            w = e.warm(buckets)
+            out["buckets"] = w["buckets"]
+            out["compiles"] += w["compiles"]
+            out["seconds"] += w["seconds"]
+            out["cache_entries"] += w["cache_entries"]
+        return out
+
+    def close(self, timeout=None):
+        for e in self._engines:
+            e.close(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    def stats(self):
+        return {"replicas": len(self._engines),
+                "loads": self.loads(),
+                "engines": [e.stats() for e in self._engines]}

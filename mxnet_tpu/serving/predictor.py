@@ -53,20 +53,21 @@ register_env("MXNET_SERVING_BUCKETS", "1,2,4,8,16,32",
              "that fits, so steady traffic reuses len(buckets) executables")
 
 
-def bucket_ladder(buckets=None):
-    """Normalize a bucket spec (None -> ``MXNET_SERVING_BUCKETS``, a
+def bucket_ladder(buckets=None, env_var="MXNET_SERVING_BUCKETS"):
+    """Normalize a bucket spec (None -> the ``env_var`` knob, a
     comma-separated string, or any int iterable) into an ascending,
-    deduplicated tuple of positive batch sizes."""
+    deduplicated tuple of positive sizes. ``env_var`` names the knob in
+    error messages — the generation plane's ``prefill_ladder`` parses its
+    ``MXNET_GENERATION_PREFILL_BUCKETS`` through here too."""
     if buckets is None:
-        buckets = getenv("MXNET_SERVING_BUCKETS")
+        buckets = getenv(env_var)
     if isinstance(buckets, str):
         try:
             buckets = [int(tok) for tok in buckets.replace(" ", "").split(",")
                        if tok]
         except ValueError:
             raise MXNetError(
-                f"MXNET_SERVING_BUCKETS must be comma-separated ints, got "
-                f"{buckets!r}")
+                f"{env_var} must be comma-separated ints, got {buckets!r}")
     out = tuple(sorted({int(b) for b in buckets}))
     if not out or out[0] < 1:
         raise MXNetError(f"serving buckets must be positive ints, got {out}")

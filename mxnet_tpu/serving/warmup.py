@@ -21,14 +21,27 @@ __all__ = ["warmup"]
 
 
 def warmup(target, buckets=None):
-    """Compile every bucket executable of ``target`` (a ``Predictor`` or a
-    ``DynamicBatcher``) ahead of traffic.
+    """Compile every executable of ``target`` ahead of traffic.
+
+    ``target`` is a ``Predictor`` or ``DynamicBatcher`` (one forward
+    program per batch bucket), or a ``GenerationEngine`` /
+    ``GenerationRouter`` (one prefill program per prompt-length bucket
+    plus THE decode program, per replica).
 
     Returns ``{"buckets", "compiles", "seconds", "cache_entries"}`` —
     ``compiles`` is the exact number of new programs built (cache-miss
-    delta), so a second call reports 0. ``serving.warmup_compiles`` rides
-    the telemetry registry when enabled.
+    delta), so a second call reports 0. ``serving.warmup_compiles`` /
+    ``serving.generation.warmup_compiles`` ride the telemetry registry
+    when enabled.
     """
+    if hasattr(target, "prefill_buckets") or (
+            hasattr(target, "engines")
+            and any(hasattr(e, "prefill_buckets")
+                    for e in getattr(target, "engines", []))):
+        # generation plane: the engine/router owns the exact-count warm
+        # (prefill ladder + decode, free-slot safe) — see
+        # GenerationEngine.warm
+        return target.warm(buckets)
     pred = getattr(target, "predictor", target)
     buckets = (pred.buckets if buckets is None
                else tuple(sorted({int(b) for b in buckets})))

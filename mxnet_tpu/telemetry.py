@@ -330,6 +330,13 @@ def snapshot():
         # ladder or flush window is wasting compute on padding
         # (docs/faq/perf.md "Sizing serving buckets")
         out["derived"]["serving.batch_fill_ratio"] = rows / slots
+    dtok = out["counters"].get("serving.generation.decode_tokens", 0)
+    cap = out["counters"].get("serving.generation.tick_slots", 0)
+    if cap > 0:
+        # live sessions per slab slot per decode tick — low fill means the
+        # KV slab is oversized for the arrival rate (padding compute on
+        # dead slots; docs/faq/perf.md "Sizing the KV slab")
+        out["derived"]["serving.generation.slot_fill_ratio"] = dtok / cap
     return out
 
 

@@ -79,6 +79,32 @@ def main(argv=None):
                  "ladder or flush window, docs/faq/perf.md \"Sizing serving "
                  "buckets\")\n")
         sys.stdout.write(line)
+    sess = counters.get("serving.generation.sessions", 0)
+    if sess:
+        gauges = snap.get("gauges", {})
+        hists = snap.get("histograms", {})
+        derived = snap.get("derived", {})
+        toks = counters.get("serving.generation.tokens", 0)
+        line = (f"\ngeneration: {sess} sessions, {toks} tokens"
+                f" (live slots {gauges.get('serving.generation.live_slots', 0)},"
+                f" queued {gauges.get('serving.generation.queue_depth', 0)})")
+        tps = gauges.get("serving.generation.tokens_per_s")
+        if tps:
+            line += f"; {tps:.1f} tok/s"
+        ttft = hists.get("serving.generation.ttft_us") or {}
+        if ttft.get("count"):
+            line += (f"; TTFT p50 {ttft['p50'] / 1e3:.2f} ms"
+                     f" / p99 {ttft['p99'] / 1e3:.2f} ms")
+        line += (f"; evictions {counters.get('serving.generation.evictions', 0)}"
+                 f" (deadline {counters.get('serving.generation.evict_deadline', 0)}),"
+                 f" rejected {counters.get('serving.generation.rejected', 0)}")
+        fill = derived.get("serving.generation.slot_fill_ratio")
+        if fill is not None:
+            line += f", slot fill {fill:.3f}"
+        line += ("\n  (low slot fill = the KV slab outruns arrivals - "
+                 "shrink MXNET_GENERATION_SLOTS or add replicas, "
+                 "docs/faq/perf.md \"Sizing the KV slab\")\n")
+        sys.stdout.write(line)
     ts = snap.get("ts")
     if ts is not None:
         import datetime
