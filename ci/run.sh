@@ -47,7 +47,9 @@ unit() {
       --ignore=tests/python/unittest/test_serving.py \
       --ignore=tests/python/unittest/test_generation.py \
       --ignore=tests/python/unittest/test_zero1.py \
-      --ignore=tests/python/unittest/test_tracing.py
+      --ignore=tests/python/unittest/test_tracing.py \
+      --ignore=tests/python/unittest/test_pipeline.py \
+      --ignore=tests/python/unittest/test_elastic.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
   # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
@@ -97,6 +99,20 @@ unit() {
   # trace per step (both workers joined, zero orphans)
   log "tracing suite (span trees, memory census, prom/HTTP export, 2-proc dist trace merge)"
   python -m pytest tests/python/unittest/test_tracing.py -q
+  # pipeline gate, standalone: these tests flip MXNET_PIPELINE_* and pin
+  # pipelined-vs-unpipelined parity (incl. uneven micro-batches whose pad
+  # rows must contribute ZERO gradient), exact CompileCache("pipeline")
+  # miss counts, bubble-ratio math and every fallback trigger — a
+  # schedule, partition or masking regression fails HERE, attributed
+  log "pipeline suite (GPipe parity, stage balance, compile pinning, fallbacks)"
+  python -m pytest tests/python/unittest/test_pipeline.py -q
+  # elastic gate, standalone: these tests spin heartbeat/guard threads and
+  # the slow case runs 2 REAL workers (tools/launch.py --restart-policy
+  # shrink), SIGKILLs one mid-epoch and asserts detection-within-grace,
+  # shrink 2->1, re-exec and checkpoint-resume convergence — a lease,
+  # guard or rendezvous regression fails HERE, attributed
+  log "elastic suite (heartbeat leases, guarded collectives, kill->shrink->resume smoke)"
+  python -m pytest tests/python/unittest/test_elastic.py -q
 }
 
 train() {
@@ -153,6 +169,28 @@ print("zero1 smoke OK:", {n: (r["state_ratio"], r["error_vs_unsharded"])
                           for n, r in sweep.items()})
 PY
   rm -f /tmp/ci_zero1_bw.jsonl
+
+  log "pipeline GPipe smoke (8 virtual devices, measure.py --pp)"
+  # pipeline regressions fail fast without TPUs: the sweep must complete
+  # with whole-run parity vs the unpipelined fused step (< 1e-5 asserted)
+  # and the measured bubble ratio must equal the (S-1)/(M+S-1) analytic
+  env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      timeout 600 python tools/bandwidth/measure.py \
+      --network mobilenet0.25 --image-shape 3,32,32 --num-classes 10 \
+      --ndev 8 --kv-store device --num-batches 1 --test-results 0 \
+      --pp 2,4 --json-out /tmp/ci_pp_bw.jsonl
+  python - <<'PY'
+import json
+rec = json.loads(open("/tmp/ci_pp_bw.jsonl").read().strip().splitlines()[-1])
+sweep = rec["pipeline_sweep"]
+assert set(sweep) == {"2", "4"}, sweep
+for s, r in sweep.items():
+    assert r["error_vs_unpipelined"] < 1e-5, (s, r)
+    assert abs(r["bubble_ratio"] - r["bubble_ratio_analytic"]) < 1e-9, (s, r)
+print("pipeline smoke OK:", {s: (r["bubble_ratio"], r["error_vs_unpipelined"])
+                             for s, r in sweep.items()})
+PY
+  rm -f /tmp/ci_pp_bw.jsonl
 
   log "bench smoke (CPU, reduced steps)"
   # fresh compile cache: XLA:CPU AOT entries are machine-feature-pinned,

@@ -72,6 +72,8 @@ class Module(BaseModule):
         self._grad_sync = None  # bucketed gradient-sync scheduler (lazy)
         self._zero1 = None  # ZeRO-1 sharded-update context (MXNET_ZERO1=1)
         self._zero1_failed = False  # zero1 trace failed — stay replicated
+        self._pipeline = None  # GPipe schedule ctx (MXNET_PIPELINE_STAGES)
+        self._pipeline_failed = False  # plan/trace failed — stay unpipelined
 
     # -- properties ----------------------------------------------------------
 
@@ -421,16 +423,57 @@ class Module(BaseModule):
             return False
         feed = self._make_feed(data_batch)
         self._exec.set_args(**feed)
+        pl = None
+        if not self._pipeline_failed:
+            from ..parallel.pipeline import (PipelineContext,
+                                             PipelineFallback,
+                                             pipeline_enabled)
+
+            if pipeline_enabled():
+                if self._pipeline is None or \
+                        not self._pipeline.matches(self._exec):
+                    try:
+                        self._pipeline = PipelineContext.build(
+                            self._symbol, self._exec, self._data_names,
+                            self._label_names)
+                    except Exception as e:  # noqa: BLE001 — a plan
+                        # failure is PipelineFallback, but bad env (e.g.
+                        # a malformed MXNET_MESH_SHAPE the unpipelined
+                        # step never consults) raises plain errors and
+                        # must take the same graceful fallback
+                        self._pipeline = None
+                        self._pipeline_failed = True
+                        self.logger.warning(
+                            "pipeline schedule unavailable (%s); using "
+                            "the unpipelined fused step",
+                            e if isinstance(e, PipelineFallback)
+                            else repr(e))
+                pl = self._pipeline
+            elif self._pipeline is not None:
+                self._pipeline = None  # gate flipped off between fits
         z1 = None
         if not self._zero1_failed:
             from ..parallel.zero1 import zero1_enabled
 
             if zero1_enabled():
+                if self._zero1 is not None and pl is not None and \
+                        self._zero1.mesh is not pl.mesh:
+                    # a pipeline context appeared (or was rebuilt) after
+                    # this ctx was created on another mesh — the update
+                    # must shard over the SAME mesh as the schedule.
+                    # Gather the live shards first (they are the only
+                    # copy), then rebuild on the pipeline's mesh below.
+                    self._zero1.export_to_updater(self._updater)
+                    self._zero1 = None
                 if self._zero1 is None:
                     from ..parallel.zero1 import Zero1Context
 
                     try:
-                        self._zero1 = Zero1Context()
+                        # under a pipeline schedule the update shards over
+                        # the SAME mesh (its pp axis is the shard group) —
+                        # two meshes in one program would conflict
+                        self._zero1 = Zero1Context(
+                            mesh=pl.mesh if pl is not None else None)
                     except Exception as e:  # noqa: BLE001 — bad mesh/env
                         # (e.g. MXNET_ZERO1_NDEV > device count): same
                         # graceful fallback as the Updater path
@@ -473,10 +516,25 @@ class Module(BaseModule):
             self._exec.fused_step(self._optimizer, self._updater,
                                   self._param_names,
                                   grad_sync_fn=gs_fn, grad_sync_key=gs_key,
-                                  zero1=z1)
+                                  zero1=z1, pipeline=pl)
         except MXNetError:
             raise  # donation failure / graph error the eager path shares
         except Exception as e:
+            # blame order when both are active: drop ZeRO-1 FIRST (the
+            # pre-existing fallback precedence) and retry with the
+            # pipeline still on — a zero1-side trace failure must not
+            # cost the pipeline too; if the schedule was the real culprit
+            # the retried step fails again and lands in the branch below
+            if pl is not None and z1 is None:
+                # the schedule failed to trace/compile with buffers intact
+                # (counts already restored): retry THIS step unpipelined
+                # (still fused) and stay unpipelined from now on
+                self._pipeline_failed = True
+                self._pipeline = None
+                self.logger.warning(
+                    "pipelined fused step failed to build (%r); falling "
+                    "back to the unpipelined fused step", e)
+                return self.fused_step(data_batch)
             if z1 is not None:
                 # the ZeRO-1 trace failed with buffers intact: retry THIS
                 # step on the replicated fused path (still fused), and stay

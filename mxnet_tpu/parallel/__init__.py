@@ -16,7 +16,7 @@ Axes convention (any subset may be present, size 1 axes are free):
 """
 from .mesh import (
     MeshSpec, create_mesh, default_mesh, current_mesh, use_mesh, local_mesh,
-    dp_mesh, mesh_from_env, axis_size, has_axis,
+    dp_mesh, pp_mesh, mesh_from_env, axis_size, has_axis,
     AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_PP, AXIS_EP,
 )
 from .collectives import (
@@ -35,7 +35,9 @@ from .partition import (
     pad_to_shards,
 )
 from .ring_attention import ring_attention, ring_self_attention
-from .pipeline import pipeline_step
+from .pipeline import (pipeline_step, partition_stages, PipelineContext,
+                       PipelineFallback, pipeline_enabled)
+from .elastic import ElasticRuntime, elastic_enabled
 from .launcher import initialize_from_env
 
 __all__ = [
@@ -51,9 +53,11 @@ __all__ = [
     "ShardedTrainer", "shard_batch", "replicate",
     "PartitionRules", "infer_param_sharding", "replicated", "flat_shard",
     "pad_to_shards",
-    "dp_mesh", "mesh_from_env", "axis_size", "has_axis",
+    "dp_mesh", "pp_mesh", "mesh_from_env", "axis_size", "has_axis",
     "sharding_constraint",
     "ring_attention", "ring_self_attention",
-    "pipeline_step",
+    "pipeline_step", "partition_stages", "PipelineContext",
+    "PipelineFallback", "pipeline_enabled",
+    "ElasticRuntime", "elastic_enabled",
     "initialize_from_env",
 ]

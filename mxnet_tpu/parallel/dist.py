@@ -110,6 +110,12 @@ def init_process_group(coordinator=None, num_processes=None, process_id=None):
             num_processes, num_processes)
         raise
     _initialized = True
+    # arm the elastic heartbeat lease (no-op unless MXNET_ELASTIC=1 with a
+    # shared lease dir and real peers): from here on a dead worker raises
+    # WorkerLostError inside collectives instead of parking the fleet
+    from . import elastic
+
+    elastic.ensure_started()
 
 
 def _env_coordinator():
@@ -210,6 +216,18 @@ def _collective_telemetry(name, buf, t0):
         (_time.perf_counter() - t0) * 1e6)
 
 
+def _guarded(fn, desc):
+    """Dispatch one cross-process collective under the elastic lease guard
+    when the runtime is armed (the guard thread also blocks on the result,
+    so a wedge surfaces as WorkerLostError instead of a later silent
+    hang); plain dispatch otherwise."""
+    from . import elastic
+
+    if elastic.active():
+        return elastic.guard(lambda: jax.block_until_ready(fn()), desc=desc)
+    return fn()
+
+
 def _allreduce_sum(buf):
     """Sum ``buf`` over all worker processes; replicated result (one
     AllReduce on the wire)."""
@@ -218,7 +236,7 @@ def _allreduce_sum(buf):
     tele = telemetry._enabled  # cached across the call (mid-call enable)
     t0 = _time.perf_counter() if tele else 0.0
     stack = _make_global_stack(buf)
-    out = _sum_over_devices_fn()(stack)
+    out = _guarded(lambda: _sum_over_devices_fn()(stack), "allreduce")
     if tele:
         _collective_telemetry("allreduce", buf, t0)
     return out.addressable_data(0)
@@ -230,7 +248,7 @@ def _allgather(buf, fill=0):
     tele = telemetry._enabled  # cached across the call (mid-call enable)
     t0 = _time.perf_counter() if tele else 0.0
     stack = _make_global_stack(buf, fill=fill)
-    out = _gather_fn()(stack)
+    out = _guarded(lambda: _gather_fn()(stack), "allgather")
     if tele:
         _collective_telemetry("allgather", buf, t0)
     return out.addressable_data(0)
@@ -433,7 +451,7 @@ class KVStoreDistTPUSync(KVStoreBase):
             telemetry.counter("dist.push_collectives").inc()
         stack = _make_global_stack(bucket)  # fill=0 words dequantize to 0
         fn = _dequant_sum_fn(tuple(segments), float(self._gc.threshold), "float32")
-        outs = fn(stack)
+        outs = _guarded(lambda: fn(stack), "compressed_push")
         for k, a, o in zip(keys, arrs, outs):
             p = o.addressable_data(0).astype(a.dtype)
             pend = self._pending.get(k)
@@ -696,16 +714,25 @@ class KVStoreDistTPUSync(KVStoreBase):
         self._gc.set_params(compression_params)
 
     def barrier(self):
-        """Fleet sync point, with straggler diagnostics: a barrier that
-        takes longer than `MXNET_BARRIER_WARN_S` logs which rank noticed
-        and how long it stalled — the first symptom of a dead or wedged
-        worker in a multi-host run is everyone else silently parked here."""
+        """Fleet sync point, with straggler diagnostics. Under the elastic
+        runtime (`MXNET_ELASTIC=1`) the straggler warning is promoted to a
+        STRUCTURED timeout: the barrier runs under the heartbeat-lease
+        guard, so a dead or wedged worker raises `WorkerLostError` within
+        `MXNET_ELASTIC_GRACE_S` and the survivor can shrink+resume. On the
+        non-elastic path a barrier slower than `MXNET_BARRIER_WARN_S`
+        keeps the original behavior — log which rank noticed and how long
+        it stalled, and keep waiting — because without a rendezvous to
+        shrink through, aborting is strictly worse than diagnosing."""
         from ..base import getenv
         from ..log import get_logger
+        from . import elastic
 
         warn_s = float(getenv("MXNET_BARRIER_WARN_S"))
         t0 = _time.monotonic()
-        coll.barrier(self.mesh)
+        if elastic.active():
+            elastic.guard(lambda: coll.barrier(self.mesh), desc="barrier")
+        else:
+            coll.barrier(self.mesh)
         elapsed = _time.monotonic() - t0
         if telemetry._enabled:
             # straggler wait: time THIS rank sat parked at the sync point —

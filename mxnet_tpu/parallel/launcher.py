@@ -23,23 +23,32 @@ def initialize_from_env():
         ps-lite names, minus servers+scheduler)
       * OMPI_COMM_WORLD_* (mpirun)
     """
+    from . import elastic
     from .dist import init_process_group
 
-    if os.environ.get("MXNET_COORDINATOR"):
-        init_process_group(
-            coordinator=os.environ["MXNET_COORDINATOR"],
-            num_processes=int(os.environ.get("MXNET_NUM_PROCESSES", "1")),
-            process_id=int(os.environ.get("MXNET_PROCESS_ID", "0")),
-        )
-        return True
-    if os.environ.get("DMLC_PS_ROOT_URI"):
-        init_process_group()
-        return True
-    if os.environ.get("OMPI_COMM_WORLD_SIZE"):
-        init_process_group(
-            coordinator=os.environ.get("MXNET_COORDINATOR", "127.0.0.1:9091"),
-            num_processes=int(os.environ["OMPI_COMM_WORLD_SIZE"]),
-            process_id=int(os.environ["OMPI_COMM_WORLD_RANK"]),
-        )
-        return True
-    return False
+    try:
+        if os.environ.get("MXNET_COORDINATOR"):
+            init_process_group(
+                coordinator=os.environ["MXNET_COORDINATOR"],
+                num_processes=int(os.environ.get("MXNET_NUM_PROCESSES", "1")),
+                process_id=int(os.environ.get("MXNET_PROCESS_ID", "0")),
+            )
+            return True
+        if os.environ.get("DMLC_PS_ROOT_URI"):
+            init_process_group()
+            return True
+        if os.environ.get("OMPI_COMM_WORLD_SIZE"):
+            init_process_group(
+                coordinator=os.environ.get("MXNET_COORDINATOR", "127.0.0.1:9091"),
+                num_processes=int(os.environ["OMPI_COMM_WORLD_SIZE"]),
+                process_id=int(os.environ["OMPI_COMM_WORLD_RANK"]),
+            )
+            return True
+        return False
+    finally:
+        # arm the elastic heartbeat lease on EVERY outcome (no-op unless
+        # MXNET_ELASTIC=1 with a shared dir and peers): a shrunk-to-one
+        # resumed worker takes the `return False` path above but must
+        # still be a clean no-op here, and scripts that call this without
+        # a coordinator still get the detector when the launcher armed it
+        elastic.ensure_started()

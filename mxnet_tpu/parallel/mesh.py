@@ -95,6 +95,17 @@ def dp_mesh(ndev=None, devices=None):
     return create_mesh(devices=devices, dp=-1)
 
 
+def pp_mesh(nstages, devices=None):
+    """1-D pipeline mesh over the first ``nstages`` devices — one pipeline
+    stage per device (`parallel/pipeline.py`'s default shard group when no
+    ambient mesh carries a 'pp' axis)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if nstages > len(devices):
+        raise ValueError(f"pp_mesh(nstages={nstages}) but only "
+                         f"{len(devices)} devices are available")
+    return create_mesh(devices=devices[:nstages], pp=-1)
+
+
 def mesh_from_env():
     """Mesh described by ``MXNET_MESH_SHAPE`` ('dp=4,tp=2'), or None.
     A fully-fixed shape smaller than the host's device count takes the

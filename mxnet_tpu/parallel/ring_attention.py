@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import default_mesh
+from . import mesh as mesh_mod
+from .mesh import AXIS_SP, default_mesh
 
 
 def _block_attn(q, k, v, bias=None, scale=None):
@@ -123,7 +124,7 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None,
         # -1e30 makes fully-masked blocks drop out with weight exp(-1e30-m)=0
         return jnp.where(mask, 0.0, -1e30)[None, None]         # [1,1,Lq,Lk]
 
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    from .collectives import ring_shift
 
     block = _hop_fn(scale)
 
@@ -131,8 +132,10 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None,
 
     def body(i, carry):
         o, m, l, k, v = carry
-        k = lax.ppermute(k, axis_name, perm)
-        v = lax.ppermute(v, axis_name, perm)
+        # one ICI hop: the shared ring primitive (collectives.ring_shift),
+        # not a privately-built permutation table
+        k = ring_shift(k, axis_name, axis_size)
+        v = ring_shift(v, axis_name, axis_size)
         kv_idx = (my_idx - i - 1) % axis_size
         o2, m2, l2 = block(q, k, v, bias_for(kv_idx))
         o, m, l = _combine(o, m, l, o2, m2, l2)
@@ -142,22 +145,27 @@ def ring_attention(q, k, v, axis_name, axis_size, causal=False, scale=None,
     return (o / _bhql_to_bqhl(l).astype(o.dtype)).astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
+def ring_self_attention(q, k, v, mesh=None, axis_name=AXIS_SP, causal=False,
                         scale=None):
     """Sharded entry point: q/k/v are GLOBAL [B, L, H, D] arrays (or numpy);
     the sequence dim is sharded over `axis_name` and ring attention runs as
-    one jitted SPMD program."""
+    one jitted SPMD program.
+
+    Mesh resolution goes through the shared substrate (`mesh.default_mesh`
+    honors `use_mesh` and `MXNET_MESH_SHAPE`, so e.g. 'dp=2,sp=4' composes
+    the same way zero1/pipeline resolve their axes); the degenerate-axis
+    check uses `mesh.axis_size` — absent axis == size 1 == replicated."""
     from .collectives import shard_map
 
     mesh = mesh or default_mesh()
-    if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
-        # no sequence axis — plain attention
+    n = mesh_mod.axis_size(mesh, axis_name)
+    if n == 1:
+        # no (or size-1) sequence axis — plain attention
         qj = jnp.asarray(q)
         o, m, l = _block_attn(qj, jnp.asarray(k), jnp.asarray(v),
                               _full_causal_bias(q.shape[1], k.shape[1]) if causal else None,
                               scale)
         return (o / _bhql_to_bqhl(l).astype(o.dtype)).astype(qj.dtype)
-    n = mesh.shape[axis_name]
 
     fn = _sharded_ring_fn(mesh, axis_name, n, causal, scale)
     spec = NamedSharding(mesh, P(None, axis_name))

@@ -46,9 +46,10 @@ import time
 from .base import MXNetError, getenv, register_env
 from .log import get_logger
 
-__all__ = ["CorruptCheckpointError", "ThreadKilled", "FaultRule",
-           "retry_call", "wrap_retry", "open_checked", "inject",
-           "fault_scope", "reset_fault_counters", "durable_replace"]
+__all__ = ["CorruptCheckpointError", "ThreadKilled", "WorkerLostError",
+           "FaultRule", "retry_call", "wrap_retry", "open_checked",
+           "inject", "fault_scope", "reset_fault_counters",
+           "durable_replace"]
 
 
 def durable_replace(tmp, dst):
@@ -84,6 +85,24 @@ class CorruptCheckpointError(MXNetError):
 
 class ThreadKilled(Exception):
     """Injected 'thread dies silently' fault (``error=KILL``)."""
+
+
+class WorkerLostError(MXNetError):
+    """A peer worker's heartbeat lease expired while this rank sat in (or
+    failed out of) a collective — the structured form of the dist-barrier
+    straggler stall. Raised by `parallel.elastic.ElasticRuntime.guard`
+    instead of blocking forever; carries the lost ranks so the shrink
+    rendezvous knows the surviving membership."""
+
+    def __init__(self, desc, lost_ranks, cause=None):
+        self.desc = desc
+        self.lost_ranks = tuple(sorted(lost_ranks))
+        self.cause = cause
+        msg = (f"worker(s) {list(self.lost_ranks)} lost during {desc} "
+               f"(heartbeat lease expired)")
+        if cause is not None:
+            msg += f"; collective error: {cause!r}"
+        super().__init__(msg)
 
 
 def _logger():

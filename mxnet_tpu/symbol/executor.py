@@ -28,6 +28,41 @@ from ..ops import registry as _reg
 __all__ = ["Executor"]
 
 
+def _dispatch_node(node, env, key, train, nidx, gate=None):
+    """Evaluate ONE non-variable node into ``env``: registry lookup,
+    reserved-attr filtering, ``__opt_in__`` keyword binding, per-node RNG
+    fold (``nidx`` — the node's GLOBAL topo index, so any walk over a node
+    subset sees the same keys as the whole-graph walk), multi-output
+    unpack. The single home of the op-dispatch convention — shared by the
+    whole-graph walk below and `parallel.pipeline`'s per-stage walk.
+    ``gate``: optional transform applied to every tensor input (the
+    pipeline's pad-row mask on loss nodes)."""
+    op = _reg.get_op(node.op)
+    attrs = {k: v for k, v in node.attrs.items()
+             if not k.startswith("__")}
+    if op.needs_mode:
+        attrs["_train"] = train
+    f = _reg.bound_fn(node.op, **attrs)
+    ins = [env[(id(c), oi)] for c, oi in node.inputs]
+    if gate is not None:
+        ins = [gate(x) for x in ins]
+    # optional tensor inputs recorded by _apply_op bind by keyword
+    opt_in = node.attrs.get("__opt_in__") or ""
+    kw_ins = {}
+    if opt_in:
+        names = opt_in.split(",")
+        n_pos = len(ins) - len(names)
+        kw_ins = dict(zip(names, ins[n_pos:]))
+        ins = ins[:n_pos]
+    if op.needs_rng:
+        out = f(jax.random.fold_in(key, nidx), *ins, **kw_ins)
+    else:
+        out = f(*ins, **kw_ins)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for i, o in enumerate(outs):
+        env[(id(node), i)] = o
+
+
 def _graph_fn(sym, arg_names, aux_names, train):
     """Build the pure function of a Symbol graph:
     fn(key, args_tuple, auxs_tuple) -> (outputs_tuple, aux_updates_tuple)."""
@@ -66,28 +101,7 @@ def _graph_fn(sym, arg_names, aux_names, train):
         for nidx, node in enumerate(nodes):
             if node.is_variable:
                 continue
-            op = _reg.get_op(node.op)
-            attrs = {k: v for k, v in node.attrs.items()
-                     if not k.startswith("__")}
-            if op.needs_mode:
-                attrs["_train"] = train
-            f = _reg.bound_fn(node.op, **attrs)
-            ins = [env[(id(c), oi)] for c, oi in node.inputs]
-            # optional tensor inputs recorded by _apply_op bind by keyword
-            opt_in = node.attrs.get("__opt_in__") or ""
-            kw_ins = {}
-            if opt_in:
-                names = opt_in.split(",")
-                n_pos = len(ins) - len(names)
-                kw_ins = dict(zip(names, ins[n_pos:]))
-                ins = ins[:n_pos]
-            if op.needs_rng:
-                out = f(jax.random.fold_in(key, nidx), *ins, **kw_ins)
-            else:
-                out = f(*ins, **kw_ins)
-            outs = out if isinstance(out, (tuple, list)) else (out,)
-            for i, o in enumerate(outs):
-                env[(id(node), i)] = o
+            _dispatch_node(node, env, key, train, nidx)
         outputs = tuple(env[(id(n), oi)] for n, oi in sym._outputs)
         aux_new = []
         for node in nodes:
@@ -309,7 +323,8 @@ class Executor:
                 tgt._data = tgt._data + g.astype(tgt.dtype)
 
     def fused_step(self, optimizer, updater, param_names,
-                   grad_sync_fn=None, grad_sync_key=None, zero1=None):
+                   grad_sync_fn=None, grad_sync_key=None, zero1=None,
+                   pipeline=None):
         """ONE training step — forward, backward (ones cotangents, the
         `backward(out_grads=None)` convention), gradient rescale/clip and
         the optimizer update for every parameter — as a single jitted XLA
@@ -349,6 +364,16 @@ class Executor:
         params and state (state lives SHARDED in the context, not in
         ``updater.states``), and the updated shards are allgathered back —
         still one donated-buffer XLA computation per signature.
+
+        ``pipeline`` (a ``parallel.pipeline.PipelineContext``, from Module
+        when `MXNET_PIPELINE_STAGES>=2`) swaps the plain graph function
+        for the GPipe micro-batch schedule over the 'pp' mesh axis: the
+        vjp below then differentiates THROUGH the scan/ppermute schedule
+        (the reverse pipeline flow), micro-batch gradients accumulate
+        inside the trace, and the grad-sync / ZeRO-1 / optimizer tail
+        composes unchanged. Pipelined executables compile under the named
+        CompileCache("pipeline") so accounting stays pinned per
+        (symbol, shapes, stages, microbatches) key.
         """
         from .. import random as _random
         from ..ndarray import NDArray
@@ -393,10 +418,12 @@ class Executor:
                tuple((a.shape, a.dtype) for a in auxs),
                state_sig,
                optimizer._fused_static_key(),
-               grad_sync_key)
+               grad_sync_key,
+               pipeline.key() if pipeline is not None else None)
 
         def build():
-            base = self._fn(True)
+            base = pipeline.wrap(self) if pipeline is not None \
+                else self._fn(True)
             arg_pos = {n: i for i, n in enumerate(self._arg_names)}
             param_pos = [arg_pos[n] for n in names]
             other_pos = [arg_pos[n] for n in other_names]
@@ -442,9 +469,11 @@ class Executor:
 
         # persistent=False: donated programs must stay OUT of the on-disk
         # XLA cache (deserialized aliasing corrupts the heap — see
-        # CompileCache.get_or_build)
-        fn = self._cache.get_or_build(("fused_step", sig), build,
-                                      persistent=False)
+        # CompileCache.get_or_build). Pipelined steps compile under the
+        # named "pipeline" cache so per-config accounting is assertable.
+        cache = pipeline.cache if pipeline is not None else self._cache
+        fn = cache.get_or_build(("fused_step", sig), build,
+                                persistent=False)
         call_args = [key, params, others, auxs, states_arg,
                      jnp.asarray(lrs, jnp.float32),
                      jnp.asarray(wds, jnp.float32),
@@ -456,10 +485,17 @@ class Executor:
             put = zero1.put_replicated
             call_args = [jax.tree_util.tree_map(put, a) if i != 4 else a
                          for i, a in enumerate(call_args)]
+        elif pipeline is not None:
+            # same replication discipline onto the pp mesh: donated
+            # buffers must already live replicated on the mesh or the
+            # donation silently degrades to a copy
+            put = pipeline.put_replicated
+            call_args = [jax.tree_util.tree_map(put, a) for a in call_args]
         try:
             with tracing.span("fused.dispatch", cat="train",
                               params=len(names),
-                              zero1=zero1 is not None):
+                              zero1=zero1 is not None,
+                              pipeline=pipeline is not None):
                 outputs, new_ws, new_ss, aux_new = fn(*call_args)
         except Exception as e:
             donated = [w._data for w in weights]
@@ -496,6 +532,8 @@ class Executor:
             self.aux_dict[n]._data = a
         self._vjp = None  # grads were consumed inside the step
         self.outputs = [NDArray(o) for o in outputs]
+        if pipeline is not None:
+            pipeline.record_step()
         return self.outputs
 
     def copy_params_from(self, arg_params, aux_params=None,

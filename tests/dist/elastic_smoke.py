@@ -1,0 +1,126 @@
+"""Two-process kill -> shrink -> resume smoke — run under the launcher:
+
+    python tools/launch.py -n 2 --restart-policy shrink \
+        --env MXNET_ELASTIC_GRACE_S=5 --env ELASTIC_SMOKE_DIR=/tmp/es \
+        python tests/dist/elastic_smoke.py
+
+Both workers run a dist `fit` over a learnable synthetic set, saving a
+checkpoint every epoch (rank 0 writes; the prefix is shared). Worker 1
+SIGKILLs itself mid-epoch at ELASTIC_SMOKE_KILL_EPOCH. Worker 0's next
+collective then raises `WorkerLostError` within `MXNET_ELASTIC_GRACE_S`
+(no hung barrier — the acceptance criterion), runs the shrink rendezvous
+(2 -> 1, generation 0 -> 1), re-execs into the single-worker group, and
+this script's resume path reloads the latest good checkpoint via
+`model.load_checkpoint`'s corrupt-epoch fallback and continues `fit` from
+that epoch to completion. The final loss must reach the same
+convergence bar an uninterrupted single-worker run reaches — proof the
+shrunk run kept learning rather than restarting from scratch.
+"""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import model as model_mod
+from mxnet_tpu.parallel import elastic
+from mxnet_tpu.resilience import WorkerLostError
+
+NUM_EPOCH = int(os.environ.get("ELASTIC_SMOKE_EPOCHS", "8"))
+KILL_EPOCH = int(os.environ.get("ELASTIC_SMOKE_KILL_EPOCH", "2"))
+KILL_RANK = int(os.environ.get("ELASTIC_SMOKE_KILL_RANK", "1"))
+LOSS_BAR = float(os.environ.get("ELASTIC_SMOKE_LOSS_BAR", "0.25"))
+OUT_DIR = os.environ.get("ELASTIC_SMOKE_DIR", "/tmp/elastic_smoke")
+PREFIX = os.path.join(OUT_DIR, "ckpt")
+
+os.makedirs(OUT_DIR, exist_ok=True)
+
+kv = mx.kv.create("dist_sync")
+rank, world = kv.rank, kv.num_workers
+gen = elastic.generation()
+print(f"worker {rank}/{world} up (generation {gen}, pid {os.getpid()})",
+      flush=True)
+
+# learnable synthetic set, identical on every worker (SPMD steps)
+rng = np.random.RandomState(3)
+X = rng.uniform(-1, 1, (160, 10)).astype(np.float32)
+W_TRUE = rng.uniform(-1, 1, (10, 2)).astype(np.float32)
+Y = np.argmax(X @ W_TRUE, axis=1).astype(np.float32)
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+mod = mx.mod.Module(net, context=mx.cpu())
+it = mx.io.NDArrayIter(X, Y, batch_size=16, shuffle=False)
+
+begin_epoch = 0
+arg_p = aux_p = None
+if gen > 0:
+    # resumed survivor: latest good checkpoint (corrupt-epoch fallback —
+    # a save torn by the kill falls back to the previous epoch)
+    _, arg_p, aux_p, loaded = model_mod.load_checkpoint(
+        PREFIX, return_epoch=True)
+    begin_epoch = loaded + 1
+    assert world == 1, f"generation {gen} expected world 1, got {world}"
+    print(f"worker {rank}: resumed generation {gen} from epoch {loaded} "
+          f"-> begin_epoch {begin_epoch}", flush=True)
+
+
+def on_epoch_end(epoch, sym, arg, aux):
+    if rank == 0:
+        model_mod.save_checkpoint(PREFIX, epoch, sym, arg, aux)
+
+
+killed_at = time.monotonic()
+
+
+def maybe_kill(param):
+    # mid-epoch SIGKILL: after a few batches of the kill epoch
+    if (gen == 0 and rank == KILL_RANK and param.epoch == KILL_EPOCH
+            and param.nbatch == 3):
+        print(f"worker {rank}: SIGKILL self at epoch {param.epoch} "
+              f"batch {param.nbatch}", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+metric = mx.metric.create("ce")
+try:
+    mod.fit(it, eval_metric=metric, kvstore=kv,
+            num_epoch=NUM_EPOCH, begin_epoch=begin_epoch,
+            arg_params=arg_p, aux_params=aux_p,
+            allow_missing=arg_p is None,
+            initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2),
+            optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.25), ("momentum", 0.9)),
+            batch_end_callback=maybe_kill,
+            epoch_end_callback=on_epoch_end)
+except WorkerLostError as e:
+    detect_s = time.monotonic() - killed_at
+    grace = float(os.environ.get("MXNET_ELASTIC_GRACE_S", "10"))
+    print(f"worker {rank}: {e} (detected, epoch loop aborted; grace "
+          f"{grace:.0f}s)", flush=True)
+    # shrink rendezvous + re-exec into the surviving group; the resumed
+    # image takes the `gen > 0` path above and continues from the latest
+    # good checkpoint
+    elastic.shrink_and_exec()
+    raise AssertionError("exec_resume returned")  # pragma: no cover
+
+# finished all epochs (either never killed, or the resumed generation)
+final_loss = metric.get_name_value()[0][1]
+assert begin_epoch > 0 or gen == 0
+print(f"worker {rank}: final loss {final_loss:.4f} after epoch "
+      f"{NUM_EPOCH - 1} (generation {gen})", flush=True)
+assert final_loss < LOSS_BAR, \
+    f"post-resume loss {final_loss} did not reach the {LOSS_BAR} bar"
+if gen > 0:
+    print("ELASTIC SMOKE PASSED: shrink + checkpoint resume converged "
+          f"(loss {final_loss:.4f} < {LOSS_BAR})", flush=True)
+else:
+    print("ELASTIC SMOKE PASSED (uninterrupted run)", flush=True)

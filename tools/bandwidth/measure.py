@@ -82,6 +82,18 @@ def parse_args():
                         "synthesis varies per partition count)")
     p.add_argument("--zero1-steps", type=int, default=5,
                    help="update steps per zero1 config (first = compile)")
+    p.add_argument("--pp", type=str, default="",
+                   help="comma-separated pipeline stage counts (e.g. "
+                        "'2,4'): benchmark the GPipe micro-batch fused "
+                        "step (MXNET_PIPELINE_STAGES, parallel/pipeline.py)"
+                        " vs the unpipelined fused step on an MLP — "
+                        "steady-state step time, measured bubble ratio "
+                        "(S-1)/(M+S-1), and error_vs_unpipelined (must be "
+                        "< 1e-5; asserted by the CI smoke)")
+    p.add_argument("--pp-microbatches", type=int, default=8,
+                   help="micro-batches per pipelined step (M)")
+    p.add_argument("--pp-steps", type=int, default=6,
+                   help="train steps per pipeline config (first = compile)")
     p.add_argument("--json-out", type=str, default="",
                    help="rank-0 appends one JSON result line to this file")
     return p.parse_args()
@@ -208,6 +220,105 @@ def zero1_sweep(args, shapes):
             "%.0f B (replicated %.0f B, ratio %.3f), error_vs_unsharded "
             "%g, rel_drift_vs_replicated %g", n, t_n, t_rep, bytes_n,
             bytes_rep, rec["state_ratio"], err0, drift)
+    return out
+
+
+def pipeline_sweep(args):
+    """Pipelined vs unpipelined fused train step on a deep MLP.
+
+    For each stage count S: runs `Module.fit` with
+    `MXNET_PIPELINE_STAGES=S` / `MXNET_PIPELINE_MICROBATCHES=M` and
+    reports steady-state per-step wall time (post-compile median), the
+    measured bubble ratio (S-1)/(M+S-1) from the planned schedule, and
+    `error_vs_unpipelined` — the max |w_pp - w_plain| after the run
+    against the SAME fit unpipelined. CAVEAT (the MULTICHIP_r06 /
+    BANDWIDTH_r05 precedent): on the virtual CPU mesh every "device" is a
+    host thread, so per-tick orchestration dominates and the pipelined
+    step reads SLOWER — the load-bearing numbers are the bubble math and
+    the parity, not absolute step time.
+    """
+    import jax
+    import mxnet_tpu as mx
+
+    sizes = [int(x) for x in args.pp.split(",") if x]
+    M = int(args.pp_microbatches)
+    steps = max(2, args.pp_steps)
+    batch = 64
+    dim, depth, hidden = 32, 6, 128
+
+    def mlp():
+        n = mx.sym.Variable("data")
+        for i in range(depth):
+            n = mx.sym.FullyConnected(n, num_hidden=hidden, name=f"pp_fc{i}")
+            n = mx.sym.Activation(n, act_type="relu")
+        n = mx.sym.FullyConnected(n, num_hidden=10, name="pp_out")
+        return mx.sym.SoftmaxOutput(n, name="softmax")
+
+    def drive(stages):
+        saved = {k: os.environ.get(k)
+                 for k in ("MXNET_PIPELINE_STAGES",
+                           "MXNET_PIPELINE_MICROBATCHES",
+                           "MXNET_FUSED_STEP")}
+        os.environ["MXNET_PIPELINE_STAGES"] = str(stages)
+        os.environ["MXNET_PIPELINE_MICROBATCHES"] = str(M)
+        os.environ["MXNET_FUSED_STEP"] = "1"
+        try:
+            mx.random.seed(11)
+            rng = np.random.RandomState(0)
+            X = rng.uniform(-1, 1, (batch * steps, dim)).astype(np.float32)
+            Y = rng.randint(0, 10, (batch * steps,)).astype(np.float32)
+            it = mx.io.NDArrayIter(X, Y, batch_size=batch, shuffle=False)
+            m = mx.mod.Module(mlp(), context=mx.Context("cpu"))
+            times = []
+
+            def timecb(param):
+                times.append(time.time())
+
+            m.fit(it, num_epoch=1, optimizer="sgd",
+                  optimizer_params=(("learning_rate", 0.05),),
+                  initializer=mx.init.Xavier(rnd_type="gaussian",
+                                             magnitude=2),
+                  batch_end_callback=timecb)
+            if stages:
+                assert m._pipeline is not None and not m._pipeline_failed, \
+                    "pipeline path did not engage"
+            deltas = sorted(b - a for a, b in zip(times[1:], times[2:]))
+            steady = deltas[len(deltas) // 2] if deltas else 0.0
+            bubble = m._pipeline.bubble_ratio if stages else 0.0
+            arg_p, _ = m.get_params()
+            return ({k: v.asnumpy() for k, v in arg_p.items()},
+                    steady, bubble)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    w_ref, t_ref, _ = drive(0)
+    out = {}
+    for s in sizes:
+        if s > jax.device_count():
+            logging.info("pp: skipping S=%d (only %d devices)", s,
+                         jax.device_count())
+            continue
+        w_s, t_s, bubble = drive(s)
+        err = max(float(np.abs(w_s[k] - w_ref[k]).max() /
+                        max(np.abs(w_ref[k]).max(), 1e-8)) for k in w_ref)
+        rec = {
+            "stages": s,
+            "microbatches": M,
+            "step_time_unpipelined_s": t_ref,
+            "step_time_pipeline_s": t_s,
+            "bubble_ratio": bubble,
+            "bubble_ratio_analytic": (s - 1) / (M + s - 1),
+            "error_vs_unpipelined": err,
+        }
+        out[str(s)] = rec
+        logging.info(
+            "pp S=%d M=%d: step %.4fs (unpipelined %.4fs), bubble %.3f "
+            "(analytic %.3f), error_vs_unpipelined %g", s, M, t_s, t_ref,
+            bubble, rec["bubble_ratio_analytic"], err)
     return out
 
 
@@ -417,6 +528,10 @@ def run(args):
     if args.zero1:
         zero1_stats = zero1_sweep(args, shapes)
 
+    pp_stats = {}
+    if args.pp:
+        pp_stats = pipeline_sweep(args)
+
     if args.json_out and getattr(kv, "rank", 0) == 0:
         import json
 
@@ -426,7 +541,7 @@ def run(args):
                 "avg_gb_per_sec_per_device": avg,
                 "error": float(res[-1].error) if res else None,
                 "tiers": tier_stats, "bucket_sweep": bucket_sweep,
-                "zero1_sweep": zero1_stats}
+                "zero1_sweep": zero1_stats, "pipeline_sweep": pp_stats}
         with open(args.json_out, "a") as f:
             f.write(json.dumps(line) + "\n")
     return res

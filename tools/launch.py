@@ -40,15 +40,38 @@ def _stream(proc, rank, out):
         out.flush()
 
 
-def launch(num_workers, command, extra_env=None, platform="cpu", timeout=None):
+def launch(num_workers, command, extra_env=None, platform="cpu", timeout=None,
+           restart_policy="none"):
     """Spawn ``num_workers`` copies of ``command``; returns max exit code.
 
-    Workers rendezvous on a fresh local port. On the first non-zero exit the
-    rest are killed (the reference's local tracker waits for all and hangs on
-    partial failure; failing fast is strictly better for CI)."""
+    Workers rendezvous on a fresh local port. ``restart_policy`` decides
+    what a dying worker means:
+
+    * ``none`` (default, the original contract): on the first non-zero
+      exit the rest are killed (the reference's local tracker waits for
+      all and hangs on partial failure; failing fast is strictly better
+      for CI).
+    * ``shrink``: the elastic contract (`mxnet_tpu/parallel/elastic.py`).
+      Every worker gets `MXNET_ELASTIC=1` plus a shared
+      `MXNET_ELASTIC_DIR` lease directory; a worker killed by a SIGNAL
+      (negative exit — the preemption/kill case) does NOT bring the fleet
+      down: survivors detect the lost lease, run the shrink rendezvous,
+      re-exec into the smaller group (same pids, so they stay tracked
+      here) and finish the job. A POSITIVE non-zero exit is still a bug
+      and still fails fast. Overall rc is 0 only if at least one worker
+      finished cleanly and none failed with a positive code.
+    """
     port = _free_port()
     procs = []
     threads = []
+    elastic_env = {}
+    if restart_policy == "shrink":
+        import tempfile
+
+        elastic_env = {
+            "MXNET_ELASTIC": "1",
+            "MXNET_ELASTIC_DIR": tempfile.mkdtemp(prefix="mxnet_elastic_"),
+        }
     for rank in range(num_workers):
         env = dict(os.environ)
         if platform == "cpu":
@@ -56,6 +79,7 @@ def launch(num_workers, command, extra_env=None, platform="cpu", timeout=None):
             # register() runs at interpreter start and can block every
             # child when the relay is half-wedged (accepting, not answering)
             env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update(elastic_env)
         env.update(extra_env or {})
         env.update({
             "MXNET_COORDINATOR": f"127.0.0.1:{port}",
@@ -82,6 +106,7 @@ def launch(num_workers, command, extra_env=None, platform="cpu", timeout=None):
         import time
         deadline = (time.monotonic() + timeout) if timeout else None
         live = list(procs)
+        codes = []
         while live:
             # poll ALL workers: a failure in any rank must kill the rest even
             # while earlier ranks sit blocked inside a collective
@@ -90,7 +115,12 @@ def launch(num_workers, command, extra_env=None, platform="cpu", timeout=None):
                 if code is None:
                     continue
                 live.remove(p)
+                codes.append(code)
                 if code != 0:
+                    if restart_policy == "shrink" and code < 0:
+                        # signal death under the elastic policy: survivors
+                        # shrink and carry the job — keep waiting for them
+                        continue
                     rc = code
                     live = []
                     break
@@ -99,6 +129,9 @@ def launch(num_workers, command, extra_env=None, platform="cpu", timeout=None):
                 break
             if live:
                 time.sleep(0.2)
+        if restart_policy == "shrink" and rc == 0 and codes and \
+                not any(c == 0 for c in codes):
+            rc = 1  # every worker died by signal; nobody finished the job
     finally:
         for p in procs:
             if p.poll() is None:
@@ -110,6 +143,13 @@ def launch(num_workers, command, extra_env=None, platform="cpu", timeout=None):
                 p.kill()
         for t in threads:
             t.join(timeout=5)
+        if elastic_env:
+            # every worker (including re-exec'd survivors) is gone now;
+            # the lease/rendezvous dir must not accumulate across runs
+            import shutil
+
+            shutil.rmtree(elastic_env["MXNET_ELASTIC_DIR"],
+                          ignore_errors=True)
     return rc
 
 
@@ -131,6 +171,14 @@ def main(argv=None):
                              "multi-process correctness runs)")
     parser.add_argument("--timeout", type=float, default=None,
                         help="per-worker wall-clock limit in seconds")
+    parser.add_argument("--restart-policy", type=str, default="none",
+                        choices=["none", "shrink"],
+                        help="what a dying worker means: 'none' kills the "
+                             "fleet (CI fail-fast); 'shrink' arms the "
+                             "elastic runtime (MXNET_ELASTIC + shared "
+                             "lease dir) so survivors shrink the "
+                             "rendezvous and resume from the latest "
+                             "checkpoint instead of hanging")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to launch")
     args = parser.parse_args(argv)
@@ -138,7 +186,8 @@ def main(argv=None):
         parser.error("no command given")
     extra = dict(kv.split("=", 1) for kv in args.env)
     rc = launch(args.num_workers, args.command, extra_env=extra,
-                platform=args.platform, timeout=args.timeout)
+                platform=args.platform, timeout=args.timeout,
+                restart_policy=args.restart_policy)
     return rc
 
 

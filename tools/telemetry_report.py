@@ -105,6 +105,36 @@ def main(argv=None):
                  "shrink MXNET_GENERATION_SLOTS or add replicas, "
                  "docs/faq/perf.md \"Sizing the KV slab\")\n")
         sys.stdout.write(line)
+    pp_steps = counters.get("pipeline.steps", 0)
+    if pp_steps:
+        gauges = snap.get("gauges", {})
+        line = (f"\npipeline: {pp_steps} pipelined steps at "
+                f"{gauges.get('pipeline.stages', 0):.0f} stages x "
+                f"{gauges.get('pipeline.microbatches', 0):.0f} micro-batches")
+        bubble = gauges.get("pipeline.bubble_ratio")
+        if bubble is not None:
+            line += f", bubble ratio {bubble:.3f}"
+        imb = gauges.get("pipeline.stage_cost_imbalance")
+        if imb is not None:
+            line += f", stage imbalance {imb:.2f}x"
+        line += ("\n  (high bubble = raise MXNET_PIPELINE_MICROBATCHES - "
+                 "docs/faq/perf.md \"Choosing micro-batch count\")\n")
+        sys.stdout.write(line)
+    lost = counters.get("elastic.lost_workers", 0)
+    shrinks = counters.get("elastic.shrinks", 0)
+    gen = snap.get("gauges", {}).get("elastic.generation", 0)
+    if lost or shrinks or gen:
+        hists = snap.get("histograms", {})
+        line = (f"\nelastic: generation {gen:.0f}, {lost} lost worker(s), "
+                f"{shrinks} shrink(s), world "
+                f"{snap.get('gauges', {}).get('elastic.world_size', 0):.0f}")
+        sh = hists.get("elastic.shrink_us") or {}
+        if sh.get("count"):
+            line += f"; shrink p50 {sh['p50'] / 1e3:.1f} ms"
+        line += ("\n  (a lost worker raised WorkerLostError instead of a "
+                 "hung barrier; survivors resumed from the latest "
+                 "checkpoint)\n")
+        sys.stdout.write(line)
     ts = snap.get("ts")
     if ts is not None:
         import datetime
