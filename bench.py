@@ -729,6 +729,96 @@ def _measure_module(on_tpu, fetch_cost, fused=True):
     return img_s_fetch, img_s_disp, compile_s
 
 
+def _measure_lazy(on_tpu):
+    """Eager-vs-lazy on the plain per-op imperative fp32 path — the lane
+    the fused step refuses (Monitor, custom ops, gluon imperative, eager
+    inference). BENCH_r05's framework_vs_raw 0.883 measured the whole
+    gluon train loop; this lane isolates the per-op dispatch tax that
+    number carries by driving a dispatch-bound imperative MLP chain
+    (dot+bias+relu per layer, every op a separate `invoke_nd`) with the
+    SAME code under `MXNET_LAZY=0` (one jitted XLA program per op — the
+    eager basis) and `MXNET_LAZY=1` (one fused jitted program per
+    segment). Reports segment count, mean ops/segment, cold compile
+    seconds separated from steady state, and asserts
+    steady_state_compiles == 0 after warmup."""
+    import numpy as np
+
+    from mxnet_tpu import compile_cache, nd, telemetry
+    from mxnet_tpu.lazy import graph as lazy_graph
+
+    layers, width, batch = 8, 128, 16
+    rng = np.random.RandomState(0)
+    ws = [nd.array(rng.uniform(-0.2, 0.2, (width, width)).astype(np.float32))
+          for _ in range(layers)]
+    bs = [nd.array(rng.uniform(-0.1, 0.1, (width,)).astype(np.float32))
+          for _ in range(layers)]
+    x = nd.array(rng.uniform(-1, 1, (batch, width)).astype(np.float32))
+
+    def step():
+        h = x
+        for w, b in zip(ws, bs):
+            h = nd.relu(nd.dot(h, w) + b)  # 3 invoke_nd dispatches/layer
+        # the materialization barrier: one concrete-value fetch per step
+        return float(nd.sum(h).asnumpy())
+
+    iters = max(30, int(os.environ.get("BENCH_ITERS", "3")) * 10)
+    prev = os.environ.get("MXNET_LAZY")
+    out = {"basis": "imperative_mlp_fp32 (per-op eager vs lazy capture)",
+           "layers": layers, "width": width, "batch": batch, "iters": iters}
+    try:
+        def timed_window():
+            # best-of-3 windows: host scheduling jitter only ever ADDS
+            # time, and this dispatch-bound lane is all host time
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    step()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        os.environ["MXNET_LAZY"] = "0"
+        step(); step()  # per-op warmup (compiles each one-op executable)
+        ref = step()
+        eager_s = timed_window()
+
+        os.environ["MXNET_LAZY"] = "1"
+        cold0 = compile_cache.named_stats("lazy")
+        t0 = time.perf_counter()
+        val = step(); step()  # cold: segment compiles land here
+        cold_s = time.perf_counter() - t0
+        warm0 = compile_cache.named_stats("lazy")
+        segs0 = telemetry.counter("lazy.segments").value
+        ops0 = telemetry.counter("lazy.ops_captured").value
+        lazy_s = timed_window()
+        warm1 = compile_cache.named_stats("lazy")
+        if abs(val - ref) > 1e-4 * max(1.0, abs(ref)):
+            raise RuntimeError(f"lazy/eager mismatch: {val} vs {ref}")
+        steady_compiles = warm1["misses"] - warm0["misses"]
+        segs = telemetry.counter("lazy.segments").value - segs0
+        ops = telemetry.counter("lazy.ops_captured").value - ops0
+        assert steady_compiles == 0, \
+            f"lazy steady state compiled {steady_compiles} programs"
+        out.update(
+            eager_steps_per_s=round(iters / max(eager_s, 1e-9), 1),
+            lazy_steps_per_s=round(iters / max(lazy_s, 1e-9), 1),
+            lazy_vs_eager=round(eager_s / max(lazy_s, 1e-9), 3),
+            segments=segs,
+            mean_ops_per_segment=round(ops / max(segs, 1), 1),
+            cold_wall_s=round(cold_s, 3),
+            cold_compile_s=round(
+                warm0["compile_seconds"] - cold0["compile_seconds"], 3),
+            segment_compiles=warm0["misses"] - cold0["misses"],
+            steady_state_compiles=steady_compiles,
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_LAZY", None)
+        else:
+            os.environ["MXNET_LAZY"] = prev
+    return out
+
+
 def _pct(sorted_vals, q):
     """Nearest-rank percentile of an ascending-sorted list (shared by the
     serving and generation probes so their p50/p99 are comparable)."""
@@ -1103,6 +1193,16 @@ def main():
                 result["generation"] = _measure_generation(on_tpu)
         except Exception:  # noqa: BLE001
             result["generation_error"] = \
+                traceback.format_exc(limit=3).strip().splitlines()[-1]
+        try:
+            # the lazy plane: per-op eager vs deferred-segment capture on
+            # the plain fp32 imperative path (MXNET_LAZY=1), zero
+            # steady-state compiles asserted; lazy.* counters land in the
+            # BENCH_TELEMETRY sidecar
+            with _phase_scope("lazy"):
+                result["lazy"] = _measure_lazy(on_tpu)
+        except Exception:  # noqa: BLE001
+            result["lazy_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
         try:
             import jax

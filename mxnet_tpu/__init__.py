@@ -52,6 +52,7 @@ from . import random
 from .random import seed
 
 from . import engine
+from . import lazy
 from . import resilience
 from . import telemetry
 from . import tracing

@@ -40,7 +40,8 @@ from . import telemetry
 from .base import getenv, register_env
 
 __all__ = ["CompileCache", "persistent_cache_dir", "stats", "named_stats",
-           "all_caches", "donation_warnings_suppressed", "trace_salt"]
+           "name_totals", "all_caches", "donation_warnings_suppressed",
+           "trace_salt"]
 
 register_env("MXNET_FUSED_STEP", True,
              "fuse forward+backward+optimizer update into one jitted XLA "
@@ -170,9 +171,14 @@ class CompileCache:
     configuration the builder closes over (train flag, optimizer
     fingerprint), the CachedOp signature-match model."""
 
-    def __init__(self, name, maxsize=None):
+    def __init__(self, name, maxsize=None, track_memory=True):
         self.name = name
         self.maxsize = maxsize
+        # track_memory=False skips first-call aval recording, keeping this
+        # cache OUT of executable_stats()/the /memory scrape — the per-op
+        # caches hold hundreds of tiny one-op programs whose per-entry AOT
+        # memory analysis would cost a recompile each for no insight
+        self.track_memory = track_memory
         self.hits = 0
         self.misses = 0
         self.compile_seconds = 0.0
@@ -341,7 +347,7 @@ class CompileCache:
                     # cache pause + accounting intact (another caller can
                     # hit this shared entry after one caller's trace error)
                     self._first = False
-                    if key is not None:
+                    if key is not None and cache.track_memory:
                         cache._record_avals(key, args, kwargs)
                     dt = time.perf_counter() - t0
                     cache.compile_seconds += dt
@@ -379,6 +385,26 @@ def stats():
             "misses": sum(p["misses"] for p in per),
             "compile_seconds": sum(p["compile_seconds"] for p in per),
             "caches": sorted(per, key=lambda p: p["name"])}
+
+
+def name_totals():
+    """{name: {hits, misses, compile_seconds, entries}} for EVERY cache
+    name ever seen — the monotonic per-name ledger behind
+    :func:`named_stats`, in one map. ``entries`` counts currently-live
+    executables. `telemetry.snapshot()` embeds this as the
+    ``compile_caches`` section so op-level (``op_eager``/``op_vjp``),
+    segment-level (``lazy``) and subsystem caches all read the same way in
+    ``tools/telemetry_report.py``."""
+    with _caches_lock:
+        totals = {n: dict(t) for n, t in _name_totals.items()}
+        live = list(_caches)
+    for t in totals.values():
+        t["entries"] = 0
+    for c in live:
+        t = totals.get(c.name)
+        if t is not None:
+            t["entries"] += len(c)
+    return totals
 
 
 def named_stats(name):

@@ -1,0 +1,45 @@
+"""Lazy eager execution — deferred dataflow capture for the op-by-op path.
+
+``MXNET_LAZY=1`` turns every imperative NDArray op into a recorded node of
+a per-thread dataflow segment instead of a one-op XLA dispatch; any
+concrete-value escape (``asnumpy``/``item``/``print``, ``wait_to_read``,
+bool/len on values, engine/kvstore/checkpoint handoffs, feeding a bound
+executor) flushes the segment as ONE fused jitted program through the
+named ``CompileCache("lazy")`` — see :mod:`mxnet_tpu.lazy.graph` for the
+design and docs/faq/env_var.md (Lazy section) for the knobs. Default OFF:
+per-op eager remains the bit-parity reference (test_lazy.py sweeps it).
+"""
+from __future__ import annotations
+
+from ..base import register_env
+from .graph import (LazyArray, LazyGraph, enabled, flush_all, force_list,
+                    graph_for_thread, lazy_stats, pending_ops)
+
+__all__ = ["LazyArray", "LazyGraph", "enabled", "flush_all", "force_list",
+           "graph_for_thread", "lazy_stats", "pending_ops"]
+
+register_env("MXNET_LAZY", False,
+             "defer imperative NDArray ops into per-thread dataflow "
+             "segments compiled as ONE fused XLA program per "
+             "materialization barrier (default off; per-op eager is the "
+             "bit-parity reference)")
+register_env("MXNET_LAZY_MAX_OPS", 256,
+             "flush a lazy segment when it reaches this many recorded ops "
+             "(bounds host memory and compile size)")
+register_env("MXNET_LAZY_CACHE_SIZE", 256,
+             "max compiled segment executables kept in CompileCache('lazy') "
+             "(LRU eviction)")
+register_env("MXNET_LAZY_CHURN_WINDOW", 32,
+             "hysteresis window: number of recent segment flushes inspected "
+             "for compile-cache churn")
+register_env("MXNET_LAZY_CHURN_RATIO_PCT", 50,
+             "hysteresis trip point: if more than this percentage of the "
+             "window's flushes were cache misses, capture disables for the "
+             "cool-off")
+register_env("MXNET_LAZY_COOLOFF", 512,
+             "ops to run per-op eager after a hysteresis trip before "
+             "re-trying capture")
+register_env("MXNET_OP_CACHE_SIZE", 1024,
+             "max entries in each per-op eager jit cache "
+             "(CompileCache('op_eager') / ('op_vjp'), LRU eviction)")
+

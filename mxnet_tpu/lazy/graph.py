@@ -1,0 +1,655 @@
+"""Lazy dataflow graph — deferred op capture + fused-segment compilation.
+
+This is the framework's rendering of the reference's L2 dependency engine
+(`src/engine/threaded_engine.h`: every imperative op becomes a node in an
+async dataflow graph; `WaitForVar` materializes): under ``MXNET_LAZY=1``
+an eager NDArray op does NOT dispatch a one-op XLA program — it records a
+node into a per-thread :class:`LazyGraph` (op + attrs, edges = which
+earlier node/leaf produced each input; in-place writes are versioned for
+free because an NDArray mutation swaps its buffer handle, so the old
+value stays addressable by the nodes that read it — the ``ThreadedVar``
+version bump, functionally). A **materialization barrier** — any read of
+a concrete value (`asnumpy`/`item`/`print`, control flow on values,
+`wait_to_read`, an engine/kvstore/checkpoint handoff, feeding a bound
+executor) — flushes the pending graph as ONE jitted XLA program per
+segment through the named ``CompileCache("lazy")``.
+
+The segment cache key is the full dataflow signature: topologically
+ordered (op, attrs) specs, the wiring between them, the shape/dtype of
+every leaf, and which outputs are still live. A steady training or
+inference loop therefore replays cached executables with ZERO
+steady-state compiles (asserted by test_lazy.py), and XLA fuses across
+the whole chain — the TVM elementwise/injective-chain grouping
+(arXiv:1802.04799) delegated to the compiler, per the compile-once
+discipline of arXiv:2603.09555.
+
+Autograd composes: a recorded op captures ``jax.vjp`` INSIDE the segment
+(forward + residuals in one program); the tape receives a
+:class:`_LazyVjp` pullback whose first application materializes the
+segment. Backward itself stays per-node ``run_vjp`` — identical math to
+the eager tape.
+
+Fallbacks (the per-op safety net):
+
+* ops that cannot trace (``eager_only``, ``Custom`` host callbacks) run
+  eagerly WITHOUT flushing the pending segment (pure values have no
+  ordering hazard);
+* a segment whose signature churns the cache (shape-polymorphic user
+  code) trips a hysteresis: capture disables for a cool-off window and
+  per-op eager — always the bit-parity reference — takes over;
+* a trace/compile failure at flush falls back to per-op eager REPLAY of
+  the same recorded nodes, so a lazy bug degrades to slow, never wrong.
+"""
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from .. import tracing
+from ..base import MXNetError
+
+__all__ = ["LazyArray", "LazyGraph", "enabled", "graph_for_thread",
+           "force_list", "flush_all", "pending_ops", "lazy_stats"]
+
+# ops that must never be captured: eager_only is flagged on the Op itself
+# (data-dependent shapes); Custom runs user python through host callbacks
+# whose side effects (their own nd ops, prints) must not happen inside a
+# deferred replay.
+_UNJITTABLE = frozenset({"Custom"})
+
+_CACHE = None
+_CACHE_LOCK = threading.Lock()
+
+
+def _segment_cache():
+    global _CACHE
+    if _CACHE is None:
+        with _CACHE_LOCK:
+            if _CACHE is None:
+                from ..base import getenv
+                from ..compile_cache import CompileCache
+
+                # track_memory=False: segment count scales with distinct
+                # dataflow signatures (hundreds in a diverse process), and
+                # the /memory scrape's per-entry AOT analysis would re-pay
+                # a compile for each — same exclusion as the per-op caches
+                _CACHE = CompileCache(
+                    "lazy", maxsize=int(getenv("MXNET_LAZY_CACHE_SIZE", 256)),
+                    track_memory=False)
+    return _CACHE
+
+
+# env knobs memoized on the raw string (read per record/flush, never
+# re-parsed unless the variable actually changes — the tracing.py pattern)
+@functools.lru_cache(maxsize=64)
+def _int_env(name, raw, default):
+    try:
+        return int(raw) if raw not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def _knob(name, default):
+    import os
+
+    return _int_env(name, os.environ.get(name), default)
+
+
+def enabled():
+    """The MXNET_LAZY master gate — one dict lookup when off."""
+    import os
+
+    raw = os.environ.get("MXNET_LAZY")
+    return raw not in (None, "", "0", "false", "False")
+
+
+class LazyArray:
+    """A pending (or realized) value: one flat output slot of one node of
+    one :class:`LazyGraph`. Shape/dtype queries are free (abstract value);
+    :meth:`force` is the materialization barrier."""
+
+    __slots__ = ("graph", "slot", "gen", "_shape", "_dtype", "value",
+                 "__weakref__")
+
+    def __init__(self, graph, slot, gen, shape, dtype):
+        self.graph = graph
+        self.slot = slot
+        self.gen = gen  # graph generation: stale after the owning flush
+        self._shape = tuple(shape)
+        self._dtype = dtype
+        self.value = None  # set by the owning graph's flush
+
+    # -- the duck-typed subset NDArray metadata queries need ----------------
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._shape:
+            n *= int(s)
+        return n
+
+    def force(self, reason="value"):
+        """Materialize: flush the owning graph's pending segment (a no-op
+        if some other barrier already flushed it) and return the concrete
+        jax array."""
+        v = self.value
+        if v is None:
+            self.graph.flush(reason)
+            v = self.value
+            if v is None:  # cannot happen: flush realizes every live slot
+                raise MXNetError("lazy value was lost at flush — this is a "
+                                 "bug in mxnet_tpu.lazy")
+        return v
+
+    def __repr__(self):
+        state = "pending" if self.value is None else "realized"
+        return f"LazyArray({state}, shape={self._shape}, dtype={self._dtype})"
+
+
+def _lazy_pullback_base():
+    from ..autograd import _PyPullback
+
+    return _PyPullback
+
+
+class _LazyVjp(_lazy_pullback_base()):
+    """The tape-side pullback of a lazily captured op: holds the segment's
+    residual slots (strong refs — the tape keeps residuals alive) and the
+    pullback pytree structure from abstract eval. First application
+    materializes the segment, rebuilds the ``tree_util.Partial`` and runs
+    it through the shared jitted ``run_vjp`` — byte-for-byte the eager
+    tape's backward convention."""
+
+    def __init__(self, treedef, leaves):
+        self.treedef = treedef
+        self.leaves = list(leaves)   # LazyArray residuals (strong refs)
+        self.value = None            # realized Partial (eager-replay sets it)
+        super().__init__(self._run)
+
+    def _partial(self):
+        if self.value is None:
+            concrete = [la.force("backward") for la in self.leaves]
+            self.value = jax.tree_util.tree_unflatten(self.treedef, concrete)
+        return self.value
+
+    def _run(self, cts):
+        from ..ops.registry import run_vjp
+
+        return run_vjp(self._partial(), cts)
+
+
+class _Node:
+    __slots__ = ("op_name", "frozen", "in_slots", "base", "n_flat",
+                 "out_refs", "kind", "n_out", "single", "vjp_ref")
+
+    def __init__(self, op_name, frozen, in_slots, base, n_flat, kind,
+                 n_out, single):
+        self.op_name = op_name
+        self.frozen = frozen          # _freeze()d wrapped attrs
+        self.in_slots = in_slots      # tuple of ('l', i) | ('s', slot) | None
+        self.base = base              # first flat output slot
+        self.n_flat = n_flat          # total flat outputs (incl. residuals)
+        self.kind = kind              # 'op' | 'vjp'
+        self.n_out = n_out            # user-visible outputs (prefix)
+        self.single = single          # fn returns a bare array, not a tuple
+        self.out_refs = [None] * n_flat  # weakrefs to LazyArrays
+        self.vjp_ref = None           # weakref to the _LazyVjp (kind='vjp')
+
+
+@functools.lru_cache(maxsize=8192)
+def _abstract_eval(op_name, frozen, in_sig, want_vjp):
+    """Cached shape/dtype inference for one captured op: returns
+    (out_avals tuple, single flag, partial_treedef, n_partial_leaves) or
+    None when the op cannot be abstractly evaluated (memoized decline —
+    the op then runs per-op eager forever). ``in_sig``: tuple of
+    (shape, dtype) | None per input."""
+    from ..ops.registry import _OPS
+
+    op = _OPS[op_name]
+    attrs = dict(frozen)
+
+    def fn(*arrays):
+        return op.fn(*arrays, **attrs)
+
+    avals = [None if s is None else jax.ShapeDtypeStruct(s[0], s[1])
+             for s in in_sig]
+    try:
+        if want_vjp:
+            out, pvjp = jax.eval_shape(lambda *a: jax.vjp(fn, *a), *avals)
+            p_leaves, p_treedef = jax.tree_util.tree_flatten(pvjp)
+        else:
+            out = jax.eval_shape(fn, *avals)
+            p_leaves, p_treedef = (), None
+        single = not isinstance(out, (tuple, list))
+        outs = (out,) if single else tuple(out)
+        out_avals = tuple((tuple(o.shape), o.dtype) for o in outs)
+        if any(not hasattr(l, "shape") for l in p_leaves):
+            return None  # a non-array residual leaf cannot cross the jit
+        p_avals = tuple((tuple(l.shape), l.dtype) for l in p_leaves)
+        return (out_avals, single, p_treedef, p_avals)
+    except Exception:  # noqa: BLE001 — decline capture, eager is always right
+        return None
+
+
+class LazyGraph:
+    """Per-thread pending dataflow segment + flush machinery."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes = []
+        self._leaves = []         # concrete jax arrays, deduped by id
+        self._leaf_index = {}     # id(array) -> leaf idx
+        self._n_slots = 0
+        self._gen = 0             # bumped per flush (stale-slot guard)
+        self._flushing = False
+        # signature-churn hysteresis (the PR 3 pad/reshape model): when the
+        # recent flush window is mostly cache misses, disable capture for a
+        # cool-off and let per-op eager absorb the churn
+        self._window = []
+        self._ops_seen = 0
+        self._cooloff_until = 0
+        self._seen_sigs = collections.OrderedDict()
+
+    # -- capture -------------------------------------------------------------
+
+    def capture_allowed(self):
+        self._ops_seen += 1
+        if self._flushing:
+            return False
+        if self._ops_seen < self._cooloff_until:
+            return False
+        if self._cooloff_until and self._ops_seen >= self._cooloff_until:
+            self._cooloff_until = 0
+            self._window.clear()
+        return True
+
+    def _resolve(self, x):
+        """Pre-lock input resolution: concrete values stay as-is; a
+        pending value of ANOTHER thread's graph is forced here — BEFORE
+        taking our own lock, so two graphs can never deadlock. Pending
+        values of THIS graph pass through as LazyArrays and are classified
+        under the lock (a peer thread may flush us in between — the
+        generation check there handles it)."""
+        if x is None:
+            return None
+        if isinstance(x, LazyArray):
+            if x.value is not None:
+                return x.value
+            if x.graph is not self:
+                return x.force()
+        return x
+
+    def record(self, op, arrays, attrs, want_vjp):
+        """Try to capture one op invocation. Returns (outs, vjp) — outs a
+        LazyArray or tuple of LazyArrays mirroring the eager return shape,
+        vjp a _LazyVjp (or None when not recording) — or None to decline
+        (caller runs the op per-op eager)."""
+        if op.eager_only or op.name in _UNJITTABLE:
+            telemetry.counter("lazy.fallback_ops").inc()
+            return None
+        if not self.capture_allowed():
+            telemetry.counter("lazy.fallback_ops").inc()
+            return None
+        resolved = [self._resolve(a) for a in arrays]
+        for r in resolved:
+            if isinstance(r, jax.core.Tracer):
+                return None  # being captured into an outer program
+        if op.wrap_kwargs is not None:
+            attrs = op.wrap_kwargs(dict(attrs))
+        from ..ops.registry import _freeze
+
+        try:
+            frozen = _freeze(attrs)
+            hash(frozen)
+        except TypeError:
+            telemetry.counter("lazy.fallback_ops").inc()
+            return None
+        # (shape, dtype) signature per input for abstract eval (pending
+        # inputs carry their aval on the LazyArray — no graph walk)
+        in_sig = tuple(
+            None if r is None
+            else (tuple(r.shape), jnp.result_type(r.dtype))
+            for r in resolved)
+        try:
+            ae = _abstract_eval(op.name, frozen, in_sig, bool(want_vjp))
+        except TypeError:  # unhashable attr slipped past _freeze
+            ae = None
+        if ae is None:
+            telemetry.counter("lazy.fallback_ops").inc()
+            return None
+        out_avals, single, p_treedef, p_avals = ae
+
+        with self._lock:
+            in_slots = []
+            for r in resolved:
+                if r is None:
+                    in_slots.append(None)
+                elif isinstance(r, LazyArray):
+                    if r.value is not None or r.gen != self._gen:
+                        # a peer thread flushed us between resolution and
+                        # the lock: the value is realized now — a leaf
+                        in_slots.append(("l", self._leaf(r.force())))
+                    else:
+                        in_slots.append(("s", r.slot))
+                else:
+                    in_slots.append(("l", self._leaf(r)))
+            n_out = len(out_avals)
+            n_flat = n_out + len(p_avals)
+            node = _Node(op.name, frozen, tuple(in_slots), self._n_slots,
+                         n_flat, "vjp" if want_vjp else "op", n_out, single)
+            self._n_slots += n_flat
+            self._nodes.append(node)
+            outs = []
+            for i, (shp, dt) in enumerate(out_avals):
+                la = LazyArray(self, node.base + i, self._gen, shp, dt)
+                node.out_refs[i] = weakref.ref(la)
+                outs.append(la)
+            vjp = None
+            if want_vjp:
+                residuals = []
+                for j, (shp, dt) in enumerate(p_avals):
+                    la = LazyArray(self, node.base + n_out + j, self._gen,
+                                   shp, dt)
+                    node.out_refs[n_out + j] = weakref.ref(la)
+                    residuals.append(la)
+                vjp = _LazyVjp(p_treedef, residuals)
+                node.vjp_ref = weakref.ref(vjp)
+            telemetry.counter("lazy.ops_captured").inc()
+            over_cap = len(self._nodes) >= _knob("MXNET_LAZY_MAX_OPS", 256)
+        if over_cap:
+            # bound host memory and compile size; the outputs just created
+            # realize immediately (their NDArrays read concrete values)
+            self.flush("segment_cap")
+        return ((outs[0] if single else tuple(outs)), vjp)
+
+    def _leaf(self, array):
+        idx = self._leaf_index.get(id(array))
+        if idx is None:
+            idx = len(self._leaves)
+            self._leaves.append(array)
+            self._leaf_index[id(array)] = idx
+        return idx
+
+    # -- flush ---------------------------------------------------------------
+
+    def flush(self, reason="value"):
+        """Compile-and-run the pending segment as ONE fused XLA program and
+        realize every live output. Safe to call with nothing pending."""
+        with self._lock:
+            if self._flushing or not self._nodes:
+                return
+            self._flushing = True
+            nodes, leaves = self._nodes, self._leaves
+            self._nodes, self._leaves = [], []
+            self._leaf_index = {}
+            self._n_slots = 0
+            self._gen += 1
+            try:
+                self._flush_nodes(nodes, leaves, reason)
+            finally:
+                self._flushing = False
+
+    def _flush_nodes(self, nodes, leaves, reason):
+        # liveness: a flat output slot is live iff its LazyArray is still
+        # referenced (NDArray._buf or a tape _LazyVjp holds it strongly)
+        live = {}
+        for node in nodes:
+            for i, ref in enumerate(node.out_refs):
+                la = ref() if ref is not None else None
+                if la is not None and la.value is None:
+                    live[node.base + i] = la
+        telemetry.counter("lazy.segments").inc()
+        telemetry.counter(f"lazy.flush_reason.{reason}").inc()
+        if not live:
+            telemetry.histogram("lazy.segment_ops").record(0)
+            return
+        # dead-code elimination: keep only nodes a live slot depends on
+        needed = set(live)
+        kept = []
+        for node in reversed(nodes):
+            if any((node.base + i) in needed for i in range(node.n_flat)):
+                kept.append(node)
+                for s in node.in_slots:
+                    if isinstance(s, tuple) and s[0] == "s":
+                        needed.add(s[1])
+        kept.reverse()
+        telemetry.histogram("lazy.segment_ops").record(len(kept))
+
+        # stable renumbering shared by the SIGNATURE and the REPLAY:
+        # leaves in first-use order over the KEPT nodes, slots as
+        # (kept-node index, flat output index). The replay must consume
+        # these renumbered specs, never the nodes' original indices — DCE
+        # can drop a node that introduced an earlier leaf, shifting every
+        # later leaf position.
+        leaf_order, leaf_renum = [], {}
+        slot_renum = {}
+        specs = []
+        for k, node in enumerate(kept):
+            ins = []
+            for s in node.in_slots:
+                if s is None:
+                    ins.append(("n",))
+                elif s[0] == "s":
+                    ins.append(("s", slot_renum[s[1]]))
+                else:
+                    li = s[1]
+                    if li not in leaf_renum:
+                        leaf_renum[li] = len(leaf_order)
+                        leaf_order.append(li)
+                    ins.append(("l", leaf_renum[li]))
+            for i in range(node.n_flat):
+                slot_renum[node.base + i] = (k, i)
+            specs.append((node.op_name, node.frozen, node.kind, tuple(ins),
+                          node.n_flat))
+        out_slots = sorted(live)
+        out_spec = tuple(slot_renum[s] for s in out_slots)
+        leaf_avals = tuple(
+            (tuple(leaves[li].shape), jnp.result_type(leaves[li].dtype))
+            for li in leaf_order)
+        sig = (tuple(specs), leaf_avals, out_spec)
+
+        cache = _segment_cache()
+        hit = sig in self._seen_sigs
+        if hit:
+            self._seen_sigs.move_to_end(sig)
+        else:
+            self._seen_sigs[sig] = True
+            bound = 4 * max(_knob("MXNET_LAZY_CHURN_WINDOW", 32), 8)
+            while len(self._seen_sigs) > bound:
+                self._seen_sigs.popitem(last=False)
+
+        def build():
+            return jax.jit(_make_replay(specs, out_spec))
+
+        args = [leaves[li] for li in leaf_order]
+        try:
+            with tracing.span("lazy.flush", cat="lazy", reason=reason,
+                              ops=len(kept), outputs=len(out_slots)):
+                fn = cache.get_or_build(sig, build)
+                outs = fn(*args)
+        except Exception:  # noqa: BLE001 — degrade to slow, never wrong
+            telemetry.counter("lazy.flush_errors").inc()
+            self._replay_eager(kept, leaves, live)
+            self._churn(hit=False)
+            return
+        for la, v in zip((live[s] for s in out_slots), outs):
+            la.value = v
+        self._churn(hit)
+
+    def _churn(self, hit):
+        win = _knob("MXNET_LAZY_CHURN_WINDOW", 32)
+        if win <= 0:
+            return
+        w = self._window
+        w.append(0 if hit else 1)
+        if len(w) > win:
+            del w[:len(w) - win]
+        if len(w) == win:
+            pct = _knob("MXNET_LAZY_CHURN_RATIO_PCT", 50)
+            if sum(w) * 100 > pct * win:
+                # the segment signature keeps missing: user code is shape/
+                # graph polymorphic here — stop paying capture + compile,
+                # run per-op eager for a cool-off window
+                self._cooloff_until = self._ops_seen + \
+                    _knob("MXNET_LAZY_COOLOFF", 512)
+                del w[:]
+                telemetry.counter("lazy.hysteresis_trips").inc()
+
+    def _replay_eager(self, kept, leaves, live):
+        """Per-op eager replay of the recorded nodes — the fallback when
+        the fused segment fails to trace or compile. Bit-identical to the
+        pre-lazy eager path (same per-op jitted executables)."""
+        from ..ops.registry import _jitted, _vjp_fwd_jitted
+
+        env = {}
+
+        def val(s):
+            if s is None or s == ("n",):
+                return None
+            if s[0] == "l":
+                return leaves[s[1]]
+            return env[s[1]]
+
+        for node in kept:
+            ins = [val(s) for s in node.in_slots]
+            if node.kind == "vjp":
+                out, partial = _vjp_fwd_jitted(node.op_name, node.frozen)(*ins)
+                vjp = node.vjp_ref() if node.vjp_ref is not None else None
+                if vjp is not None:
+                    vjp.value = partial
+                outs = out if isinstance(out, tuple) else (out,)
+                flat = list(outs)
+                # residual slots: realized through the Partial (vjp.value);
+                # fill any still-live residual LazyArray from its leaves so
+                # force() never re-flushes
+                p_leaves = jax.tree_util.tree_flatten(partial)[0]
+                flat += list(p_leaves)
+            else:
+                out = _jitted(node.op_name, node.frozen, None)(*ins)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                flat = list(outs)
+            for i, v in enumerate(flat):
+                slot = node.base + i
+                env[slot] = v
+                la = live.get(slot)
+                if la is not None:
+                    la.value = v
+
+
+def _make_replay(specs, out_spec):
+    """Build the pure replay function from the RENUMBERED segment specs —
+    the exact content the cache key hashes, so a cache hit built from a
+    different (but sig-identical) graph replays the same computation.
+    Inputs address leaves by their renumbered first-use position and
+    producer outputs as (kept-node index, flat output index)."""
+    from ..ops.registry import _OPS
+
+    steps = []
+    for op_name, frozen, kind, ins, n_flat in specs:
+        steps.append((_OPS[op_name].fn, dict(frozen), kind, ins, n_flat))
+    out_list = list(out_spec)
+
+    def replay(*leaf_vals):
+        env = {}
+
+        def val(s):
+            if s == ("n",):
+                return None
+            if s[0] == "l":
+                return leaf_vals[s[1]]
+            return env[s[1]]
+
+        for k, (op_fn, attrs, kind, ins_spec, n_flat) in enumerate(steps):
+            ins = [val(s) for s in ins_spec]
+            if kind == "vjp":
+                out, partial = jax.vjp(
+                    lambda *a, _f=op_fn, _at=attrs: _f(*a, **_at), *ins)
+                outs = out if isinstance(out, tuple) else (out,)
+                flat = list(outs) + jax.tree_util.tree_flatten(partial)[0]
+            else:
+                out = op_fn(*ins, **attrs)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                flat = list(outs)
+            if len(flat) != n_flat:
+                raise MXNetError(
+                    f"lazy replay of {op_fn}: {len(flat)} outputs, "
+                    f"recorded {n_flat} (abstract/concrete trace mismatch)")
+            for i, v in enumerate(flat):
+                env[(k, i)] = v
+        return tuple(env[s] for s in out_list)
+
+    return replay
+
+
+# ---------------------------------------------------------------------------
+# per-thread graphs
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+_graphs = weakref.WeakSet()
+_graphs_lock = threading.Lock()
+
+
+def graph_for_thread():
+    g = getattr(_tls, "graph", None)
+    if g is None:
+        g = _tls.graph = LazyGraph()
+        with _graphs_lock:
+            _graphs.add(g)
+    return g
+
+
+def force_list(values, reason="value"):
+    """Materialize every LazyArray in ``values`` (per-op eager fallback
+    path: the op runs on concrete arrays)."""
+    return [v.force(reason) if isinstance(v, LazyArray) else v
+            for v in values]
+
+
+def flush_all(reason="wait"):
+    """Flush every thread's pending segment (``nd.waitall`` semantics: all
+    outstanding work, not just this thread's, must be complete)."""
+    with _graphs_lock:
+        graphs = list(_graphs)
+    for g in graphs:
+        g.flush(reason)
+
+
+def pending_ops():
+    """Number of ops pending in the CURRENT thread's segment (tests)."""
+    g = getattr(_tls, "graph", None)
+    return len(g._nodes) if g is not None else 0
+
+
+def lazy_stats():
+    """{segments, ops_captured, fallback_ops, hysteresis_trips} from the
+    telemetry counters plus the ``"lazy"`` compile-cache named totals —
+    one stop for the bench lane and tests."""
+    from ..compile_cache import named_stats
+
+    snap = telemetry.snapshot()["counters"]
+    out = {k.split("lazy.", 1)[1]: v for k, v in snap.items()
+           if k.startswith("lazy.") and not k.startswith("lazy.flush_reason")}
+    out["flush_reasons"] = {k.split("lazy.flush_reason.", 1)[1]: v
+                            for k, v in snap.items()
+                            if k.startswith("lazy.flush_reason.")}
+    out["cache"] = named_stats("lazy")
+    return out

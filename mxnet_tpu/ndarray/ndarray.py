@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError, np_dtype, integer_types, numeric_types
 from ..context import Context, current_context, cpu
+from ..lazy.graph import LazyArray as _LazyArray
 from ..ops import registry as _reg
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange", "concatenate", "waitall"]
@@ -36,12 +37,12 @@ def _dtype_name(dt):
 
 class NDArray:
     __slots__ = (
-        "_data", "_ctx", "grad", "grad_req", "_ag_marked", "_stype",
+        "_buf", "_ctx", "grad", "grad_req", "_ag_marked", "_stype",
         "_fresh_grad", "__weakref__",
     )
 
     def __init__(self, data, ctx=None, stype="default"):
-        self._data = data
+        self._buf = data
         self._ctx = ctx if ctx is not None else _ctx_of(data)
         self.grad = None
         self.grad_req = "null"
@@ -54,20 +55,47 @@ class NDArray:
     # -- basic properties ---------------------------------------------------
 
     @property
+    def _data(self):
+        """The concrete jax array — THE materialization barrier. Under
+        ``MXNET_LAZY=1`` the buffer may be a pending
+        :class:`~mxnet_tpu.lazy.graph.LazyArray`; reading ``_data``
+        flushes the owning segment (one fused XLA program) and swaps the
+        realized buffer in. Every concrete-value escape in the codebase —
+        ``asnumpy``, kvstore pushes, checkpoint writes, executor feeds —
+        reads through here, which is what makes the barrier audit
+        structural rather than a site-by-site hunt. Metadata queries
+        (``shape``/``dtype``/``ndim``/``size``) read ``_buf`` and never
+        flush."""
+        buf = self._buf
+        if type(buf) is _LazyArray:
+            buf = buf.force()
+            self._buf = buf
+        return buf
+
+    @_data.setter
+    def _data(self, value):
+        # a buffer swap IS the version bump: nodes that recorded the old
+        # value keep referencing it (reference ThreadedVar versioning)
+        self._buf = value
+
+    @property
     def shape(self):
-        return tuple(self._data.shape)
+        return tuple(self._buf.shape)
 
     @property
     def dtype(self):
-        return _np.dtype(self._data.dtype)
+        return _np.dtype(self._buf.dtype)
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return len(self._buf.shape)
 
     @property
     def size(self):
-        return int(self._data.size)
+        n = 1
+        for s in self._buf.shape:
+            n *= int(s)
+        return n
 
     @property
     def context(self):
@@ -103,7 +131,10 @@ class NDArray:
 
     def asnumpy(self):
         """Blocking copy to host (reference `WaitToRead` + copy)."""
-        return _np.asarray(self._data)
+        buf = self._buf
+        if type(buf) is _LazyArray:
+            self._buf = buf = buf.force("asnumpy")
+        return _np.asarray(buf)
 
     def asscalar(self):
         if self.size != 1:
@@ -142,10 +173,13 @@ class NDArray:
     # -- engine-var parity --------------------------------------------------
 
     def wait_to_read(self):
-        self._data.block_until_ready()
+        buf = self._buf
+        if type(buf) is _LazyArray:
+            self._buf = buf = buf.force("wait")
+        buf.block_until_ready()
 
     def wait_to_write(self):
-        self._data.block_until_ready()
+        self.wait_to_read()
 
     # -- autograd -----------------------------------------------------------
 
@@ -172,7 +206,9 @@ class NDArray:
                           retain_graph=retain_graph, train_mode=train_mode)
 
     def detach(self):
-        out = NDArray(self._data, self._ctx)
+        # shares the (possibly still-pending) buffer — detaching must not
+        # force a segment flush
+        out = NDArray(self._buf, self._ctx)
         return out
 
     # -- shape ops (methods) ------------------------------------------------
@@ -477,7 +513,7 @@ class NDArray:
                 if k.step is not None and int(k.step) < 0:
                     return False  # negative-step writes stay on the raw path
                 begin.append(k.start); end.append(k.stop); step.append(k.step or 1)
-        old = NDArray(self._data, self._ctx)
+        old = NDArray(self._buf, self._ctx)
         old.grad, old.grad_req = self.grad, self.grad_req
         old._ag_marked, self._ag_marked = self._ag_marked, False
         from .. import autograd
@@ -500,6 +536,21 @@ class NDArray:
     def __iter__(self):
         for i in range(self.shape[0]):
             yield self[i]
+
+    # -- pickling ------------------------------------------------------------
+
+    def __getstate__(self):
+        import copyreg
+
+        self._data  # materialize: a pending lazy buffer must not pickle
+        names = copyreg._slotnames(type(self))
+        return (None, {n: getattr(self, n) for n in names
+                       if n != "__weakref__" and hasattr(self, n)})
+
+    def __setstate__(self, state):
+        _, slots = state
+        for k, v in (slots or {}).items():
+            setattr(self, k, v)
 
     # -- serialization ------------------------------------------------------
 
@@ -703,7 +754,11 @@ def true_divide(lhs, rhs):
 
 
 def waitall():
-    """Block until all async work completes (parity `mx.nd.waitall`)."""
+    """Block until all async work completes (parity `mx.nd.waitall`) —
+    including every thread's pending lazy segment."""
+    from ..lazy.graph import flush_all
+
+    flush_all("wait")
     jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
     try:
         jax.block_until_ready(jnp.zeros(()))

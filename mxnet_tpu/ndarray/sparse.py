@@ -72,6 +72,16 @@ class BaseSparseNDArray(NDArray):
         self._shape_meta = tuple(int(s) for s in value.shape)
         self._aux_stale = True
 
+    @property
+    def _buf(self):
+        # sparse arrays are never lazy: the raw-buffer view IS the dense
+        # view (NDArray methods like detach read _buf to avoid flushing)
+        return self._data
+
+    @_buf.setter
+    def _buf(self, value):
+        self._data = value
+
     def _components(self):
         if self._aux_stale:
             self._resparsify(self._dense_cache)

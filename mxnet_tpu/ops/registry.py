@@ -16,6 +16,7 @@ Shape/type inference (the reference's FInferShape/FInferType,
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 
@@ -211,15 +212,44 @@ def _freeze(v):
     return v
 
 
-@functools.lru_cache(maxsize=None)
+# The per-op jit caches live in named CompileCaches ("op_eager" for plain
+# forwards, "op_vjp" for forward-with-residuals) instead of unbounded
+# anonymous lru_caches: attr-churning code (a loop sweeping `axis=` or
+# scalar values) used to grow executables without bound or accounting.
+# Bounded LRU (MXNET_OP_CACHE_SIZE) + compile_cache.named_stats makes
+# op-level compile accounting read exactly like the segment/executor-level
+# caches in tools/telemetry_report.py.
+_op_caches = {}
+_op_caches_lock = threading.Lock()
+
+
+def _op_cache(name):
+    cache = _op_caches.get(name)
+    if cache is None:
+        with _op_caches_lock:
+            cache = _op_caches.get(name)
+            if cache is None:
+                from ..base import getenv
+                from ..compile_cache import CompileCache
+
+                cache = _op_caches[name] = CompileCache(
+                    name, maxsize=int(getenv("MXNET_OP_CACHE_SIZE", 1024)),
+                    track_memory=False)
+    return cache
+
+
 def _jitted(name, frozen_attrs, backend):
     """One-op XLA computation, cached by (op, attrs); jax caches by shapes.
     This is the eager compile cache — the role CachedOp's signature check
     plays in the reference (`cached_op.cc:295`)."""
-    op = _OPS[name]
-    attrs = dict(frozen_attrs)
-    fn = lambda *arrays: op.fn(*arrays, **attrs)
-    return jax.jit(fn)
+
+    def build():
+        op = _OPS[name]
+        attrs = dict(frozen_attrs)
+        return jax.jit(lambda *arrays: op.fn(*arrays, **attrs))
+
+    return _op_cache("op_eager").get_or_build(
+        (name, frozen_attrs, backend), build)
 
 
 def bound_fn(name, **attrs):
@@ -234,22 +264,25 @@ def bound_fn(name, **attrs):
     return lambda *arrays, **kw: fn(*arrays, **attrs, **kw)
 
 
-@functools.lru_cache(maxsize=None)
 def _vjp_fwd_jitted(name, frozen_attrs):
     """jit-compiled forward-with-residuals: returns (outputs, vjp_partial).
     jax.vjp's pullback is a `tree_util.Partial` pytree, so it crosses the jit
     boundary; residuals stay on device. This is how the eager autograd tape
     avoids re-running forwards at backward time (reference keeps explicit
     FGradient graphs instead — here linearization is the compiler's job)."""
-    op = _OPS[name]
-    attrs = dict(frozen_attrs)
-    fn = lambda *arrays: op.fn(*arrays, **attrs)
 
-    def fwd(*arrays):
-        out, vjp = jax.vjp(fn, *arrays)
-        return out, vjp
+    def build():
+        op = _OPS[name]
+        attrs = dict(frozen_attrs)
+        fn = lambda *arrays: op.fn(*arrays, **attrs)
 
-    return jax.jit(fwd)
+        def fwd(*arrays):
+            out, vjp = jax.vjp(fn, *arrays)
+            return out, vjp
+
+        return jax.jit(fwd)
+
+    return _op_cache("op_vjp").get_or_build((name, frozen_attrs), build)
 
 
 @jax.jit

@@ -51,6 +51,40 @@ register_env("MXNET_SERVING_BUCKETS", "1,2,4,8,16,32",
              "serving batch-size bucket ladder (comma-separated ints): "
              "every request/coalesced batch pads up to the smallest bucket "
              "that fits, so steady traffic reuses len(buckets) executables")
+register_env("MXNET_SUBGRAPH_BACKEND", "TPU_FUSE",
+             "subgraph rewrite backend auto-applied by Predictor.load / "
+             "Predictor.from_module (conv+bn(+relu) folding for inference); "
+             "set to NONE or 0 to opt out. Training-side bind only applies "
+             "it when the variable is EXPLICITLY set (symbol.simple_bind "
+             "semantics unchanged)")
+
+
+def _serving_fused(symbol, arg_params, aux_params):
+    """Apply the serving-side subgraph backend (default ``TPU_FUSE``,
+    opt-out ``MXNET_SUBGRAPH_BACKEND=NONE``) to a checkpointed symbol and
+    migrate parameters across the rewrite: BatchNorm moving statistics are
+    *auxiliary* states of the original graph but plain *arguments* of the
+    folded `_fused_conv_bn_relu` node, so they move from ``aux_params``
+    into ``arg_params``. Returns (symbol, arg_params, aux_params) —
+    unchanged when the backend is disabled, unregistered, or matches
+    nothing."""
+    import os
+
+    backend = os.environ.get("MXNET_SUBGRAPH_BACKEND", "TPU_FUSE")
+    if not backend or backend in ("NONE", "none", "0"):
+        return symbol, arg_params, aux_params
+    from ..symbol.subgraph import build_subgraph, list_subgraph_backends
+
+    if backend not in list_subgraph_backends():
+        return symbol, arg_params, aux_params
+    fused = build_subgraph(symbol, backend)
+    fused_args = set(fused.list_arguments())
+    arg_params = dict(arg_params or {})
+    aux_params = dict(aux_params or {})
+    for name in list(aux_params):
+        if name in fused_args and name not in arg_params:
+            arg_params[name] = aux_params.pop(name)
+    return fused, arg_params, aux_params
 
 
 def bucket_ladder(buckets=None, env_var="MXNET_SERVING_BUCKETS"):
@@ -185,6 +219,8 @@ class Predictor:
         if symbol is None:
             raise MXNetError(f"no symbol json found for prefix {prefix!r} "
                              "(need prefix-symbol.json to serve)")
+        symbol, arg_params, aux_params = _serving_fused(
+            symbol, arg_params, aux_params)
         return cls(symbol, arg_params, aux_params,
                    data_shapes=data_shapes, **kwargs)
 
@@ -198,7 +234,9 @@ class Predictor:
                              "initialized parameters")
         arg_params, aux_params = module.get_params()
         kwargs.setdefault("label_shapes", getattr(module, "_label_shapes", None))
-        return cls(module.symbol, arg_params, aux_params,
+        symbol, arg_params, aux_params = _serving_fused(
+            module.symbol, arg_params, aux_params)
+        return cls(symbol, arg_params, aux_params,
                    data_shapes=module.data_shapes, buckets=buckets, **kwargs)
 
     # -- properties ----------------------------------------------------------

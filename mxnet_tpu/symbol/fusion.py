@@ -16,6 +16,16 @@ from .subgraph import (SubgraphProperty, SubgraphSelector,
                        register_subgraph_property)
 
 
+def _is_relu(node):
+    """Either spelling of ReLU: the `Activation(act_type='relu')` op or
+    the standalone `relu` op (gluon emits the former, hand-built symbols
+    and imported graphs often the latter)."""
+    if node.op == "relu":
+        return True
+    return node.op == "Activation" and \
+        str(node.attrs.get("act_type", "")) == "relu"
+
+
 class _ConvBNReLUSelector(SubgraphSelector):
     def select(self, node):
         return node.op == "Convolution"
@@ -24,9 +34,8 @@ class _ConvBNReLUSelector(SubgraphSelector):
         if node.op == "Convolution" and output_node.op == "BatchNorm":
             # BN must consume THIS conv's main output
             return bool(output_node.inputs) and output_node.inputs[0][0] is node
-        if node.op == "BatchNorm" and output_node.op == "Activation":
-            return str(output_node.attrs.get("act_type", "")) == "relu" and \
-                bool(output_node.inputs) and output_node.inputs[0][0] is node
+        if node.op == "BatchNorm" and _is_relu(output_node):
+            return bool(output_node.inputs) and output_node.inputs[0][0] is node
         return False
 
 
@@ -40,7 +49,7 @@ class ConvBNReLUProperty(SubgraphProperty):
         nodes = subgraph_sym._nodes()
         conv = next((n for n in nodes if n.op == "Convolution"), None)
         bn = next((n for n in nodes if n.op == "BatchNorm"), None)
-        act = next((n for n in nodes if n.op == "Activation"), None)
+        act = next((n for n in nodes if n.op and _is_relu(n)), None)
         if conv is None or bn is None or len(subgraph_sym._outputs) != 1:
             return None  # not the exact shape this fusion handles
         names = (subgraph_sym.list_arguments()

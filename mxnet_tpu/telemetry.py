@@ -337,6 +337,24 @@ def snapshot():
         # KV slab is oversized for the arrival rate (padding compute on
         # dead slots; docs/faq/perf.md "Sizing the KV slab")
         out["derived"]["serving.generation.slot_fill_ratio"] = dtok / cap
+    segs = out["counters"].get("lazy.segments", 0)
+    if segs > 0:
+        # fused ops per flushed lazy segment — near 1 means barriers fire
+        # per op and capture buys nothing (docs/faq/perf.md "Reading
+        # lazy-segment telemetry")
+        out["derived"]["lazy.mean_ops_per_segment"] = \
+            out["counters"].get("lazy.ops_captured", 0) / segs
+    try:
+        from . import compile_cache as _cc
+
+        # per-name compile ledger: op-level (op_eager/op_vjp), lazy
+        # segments, executors and the serving/generation planes — one
+        # accounting language (tools/telemetry_report.py renders it)
+        totals = _cc.name_totals()
+        if totals:
+            out["compile_caches"] = totals
+    except Exception:  # noqa: BLE001 — snapshot must never fail
+        pass
     return out
 
 

@@ -505,3 +505,81 @@ def test_telemetry_report_serving_summary(tele, tmp_path, capsys):
     assert telemetry_report.main([str(path)]) == 0
     out = capsys.readouterr().out
     assert "serving:" in out and "fill ratio" in out
+
+
+# ---------------------------------------------------------------------------
+# serving-side subgraph fusion (TPU_FUSE auto-applied by load/from_module)
+# ---------------------------------------------------------------------------
+
+
+def _conv_module(batch=4, seed=9):
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=6, pad=(1, 1),
+                           name="conv0")
+    b = mx.sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    r = mx.sym.Activation(b, act_type="relu", name="relu0")
+    f = mx.sym.FullyConnected(mx.sym.Flatten(r), num_hidden=3, name="fc0")
+    s = mx.sym.SoftmaxOutput(f, name="softmax")
+    mod = mx.mod.Module(s)
+    mod.bind([DataDesc("data", (batch, 3, 8, 8))],
+             [DataDesc("softmax_label", (batch,))], for_training=False)
+    mx.random.seed(seed)
+    mod.init_params(mx.init.Xavier())
+    # non-trivial moving statistics: the fold must actually use them
+    arg_p, aux_p = mod.get_params()
+    rng = np.random.RandomState(seed)
+    for v in aux_p.values():
+        v[:] = mx.nd.array(rng.uniform(0.2, 1.0, v.shape).astype(np.float32))
+    mod.set_params(arg_p, aux_p)
+    return mod
+
+
+def _conv_x(n, seed=1):
+    return np.random.RandomState(seed).randn(n, 3, 8, 8).astype(np.float32)
+
+
+def test_from_module_auto_fuses_conv_bn_relu(monkeypatch):
+    """Predictor.from_module applies TPU_FUSE by default: the served graph
+    folds conv+bn+relu, BN moving stats migrate from aux to args, and
+    outputs agree with the unfused predictor (fold is algebraically exact;
+    ~1e-7 float reassociation)."""
+    mod = _conv_module()
+    fused = Predictor.from_module(mod, buckets=(4,))
+    ops = [n.op for n in fused._symbol._nodes() if n.op]
+    assert "_fused_conv_bn_relu" in ops and "BatchNorm" not in ops
+    # the moving stats became plain arguments of the folded node
+    assert "bn0_moving_mean" in fused._arg_params
+    assert "bn0_moving_mean" not in fused._aux_params
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "NONE")
+    plain = Predictor.from_module(mod, buckets=(4,))
+    assert "BatchNorm" in [n.op for n in plain._symbol._nodes() if n.op]
+    X = _conv_x(4)
+    np.testing.assert_allclose(fused.predict(X).asnumpy(),
+                               plain.predict(X).asnumpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_load_checkpoint_auto_fuses(tmp_path, monkeypatch):
+    mod = _conv_module()
+    prefix = str(tmp_path / "convnet")
+    arg_p, aux_p = mod.get_params()
+    mx.model.save_checkpoint(prefix, 1, mod.symbol, arg_p, aux_p)
+    fused = Predictor.load(prefix, data_shapes=[("data", (1, 3, 8, 8))],
+                           buckets=(2, 4))
+    assert "_fused_conv_bn_relu" in [n.op for n in fused._symbol._nodes()
+                                     if n.op]
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "0")
+    plain = Predictor.load(prefix, data_shapes=[("data", (1, 3, 8, 8))],
+                           buckets=(2, 4))
+    X = _conv_x(6, seed=2)  # exercises chunking across buckets too
+    np.testing.assert_allclose(fused.predict(X).asnumpy(),
+                               plain.predict(X).asnumpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_serving_fusion_unknown_backend_is_noop(monkeypatch):
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "NO_SUCH_BACKEND")
+    mod = _conv_module()
+    pred = Predictor.from_module(mod, buckets=(4,))
+    assert "BatchNorm" in [n.op for n in pred._symbol._nodes() if n.op]
+    assert np.isfinite(pred.predict(_conv_x(4)).asnumpy()).all()

@@ -118,6 +118,39 @@ def test_default_opaque_subgraph_node():
 
 def test_backend_registry():
     assert "TPU_FUSE" in list_subgraph_backends()
+
+
+def test_conv_bn_relu_op_spelling_fuses():
+    """The standalone `relu` op (not Activation) fuses the same way —
+    hand-built symbols and imported graphs use that spelling."""
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv0")
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    r = sym.relu(b, name="relu0")
+    net = sym.FullyConnected(sym.Flatten(r), num_hidden=4, name="fc0")
+    fused = net.get_backend_symbol("TPU_FUSE")
+    ops = [n.op for n in fused._nodes() if n.op]
+    assert "_fused_conv_bn_relu" in ops and "relu" not in ops
+
+    x = np.random.RandomState(4).randn(2, 3, 8, 8).astype(np.float32)
+    y1, params = _fill_and_run(net, {"data": (2, 3, 8, 8)}, x)
+    y2, _ = _fill_and_run(fused, {"data": (2, 3, 8, 8)}, x, copy_from=params)
+    np.testing.assert_allclose(y1, y2, rtol=2e-4, atol=2e-5)
+
+
+def test_conv_bn_without_relu_fuses():
+    """conv+bn with NO activation folds too (with_relu=False)."""
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(1, 1), num_filter=4, name="conv0")
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    fused = b.get_backend_symbol("TPU_FUSE")
+    ops = [n.op for n in fused._nodes() if n.op]
+    assert "_fused_conv_bn_relu" in ops and "BatchNorm" not in ops
+
+    x = np.random.RandomState(5).randn(2, 3, 6, 6).astype(np.float32)
+    y1, params = _fill_and_run(b, {"data": (2, 3, 6, 6)}, x)
+    y2, _ = _fill_and_run(fused, {"data": (2, 3, 6, 6)}, x, copy_from=params)
+    np.testing.assert_allclose(y1, y2, rtol=2e-4, atol=2e-5)
     with pytest.raises(MXNetError):
         sym.Variable("x").get_backend_symbol("NOPE")
 

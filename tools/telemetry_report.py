@@ -52,6 +52,38 @@ def main(argv=None):
         line += ("\n  (a hit ratio well below 1 at steady state means "
                  "recompile churn — docs/faq/perf.md)\n")
         sys.stdout.write(line)
+    caches = snap.get("compile_caches") or {}
+    if caches:
+        # per-name ledger: op-level (op_eager/op_vjp), lazy segments,
+        # executors and the serving planes read in one accounting language
+        rows = ", ".join(
+            f"{n} {v.get('misses', 0)} compiled/{v.get('hits', 0)} hits"
+            for n, v in sorted(caches.items()))
+        sys.stdout.write(f"\nnamed compile caches: {rows}\n")
+    lazy_segs = counters.get("lazy.segments", 0)
+    lazy_ops = counters.get("lazy.ops_captured", 0)
+    if lazy_segs or lazy_ops:
+        derived = snap.get("derived", {})
+        hists = snap.get("histograms", {})
+        line = f"\nlazy: {lazy_ops} ops captured in {lazy_segs} segments"
+        mean = derived.get("lazy.mean_ops_per_segment")
+        if mean is not None:
+            line += f" (mean {mean:.1f} ops/segment)"
+        seg = hists.get("lazy.segment_ops") or {}
+        if seg.get("count"):
+            line += f", p99 {seg['p99']:.0f} ops"
+        reasons = {k.split("lazy.flush_reason.", 1)[1]: v
+                   for k, v in counters.items()
+                   if k.startswith("lazy.flush_reason.")}
+        if reasons:
+            top = sorted(reasons.items(), key=lambda kv: -kv[1])[:4]
+            line += "; flushes: " + ", ".join(f"{k} {v}" for k, v in top)
+        line += (f"; fallback ops {counters.get('lazy.fallback_ops', 0)},"
+                 f" hysteresis trips "
+                 f"{counters.get('lazy.hysteresis_trips', 0)}")
+        line += ("\n  (mean ops/segment near 1 = flush-happy code; see "
+                 "docs/faq/perf.md \"Reading lazy-segment telemetry\")\n")
+        sys.stdout.write(line)
     dropped = counters.get("profiler.dropped_events", 0)
     t_dropped = counters.get("tracing.dropped_events", 0)
     if dropped or t_dropped:
