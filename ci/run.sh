@@ -50,7 +50,8 @@ unit() {
       --ignore=tests/python/unittest/test_tracing.py \
       --ignore=tests/python/unittest/test_pipeline.py \
       --ignore=tests/python/unittest/test_elastic.py \
-      --ignore=tests/python/unittest/test_lazy.py
+      --ignore=tests/python/unittest/test_lazy.py \
+      --ignore=tests/python/unittest/test_health.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
   # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
@@ -124,6 +125,14 @@ unit() {
   # under MXNET_LAZY=1, parity-checked against eager
   log "lazy suite (deferred capture parity, barrier sweep, zero-steady-state compiles, fit+Monitor e2e)"
   python -m pytest tests/python/unittest/test_lazy.py -q
+  # health gate, standalone: these tests flip the process-global health/
+  # telemetry/tracing state, spin engine scheduler threads and the
+  # telemetry HTTP endpoint, and drive deterministic watchdog sweeps
+  # (incl. the chaos acceptance run with an artificially wedged engine)
+  # — an SLO, readiness, drain or watchdog regression fails HERE,
+  # attributed, not as a flaky assertion inside an unrelated suite
+  log "health suite (SLO tracker, liveness/readiness, stall watchdog + capture, router drain, chaos acceptance)"
+  python -m pytest tests/python/unittest/test_health.py -q
 }
 
 train() {
@@ -210,6 +219,15 @@ PY
   env JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 BENCH_ITERS=2 \
       BENCH_COMPILE_CACHE="$bench_cache" timeout 900 python bench.py
   rm -rf "$bench_cache"
+
+  log "bench trajectory check (tools/bench_compare.py, advisory)"
+  # ADVISORY: diff the two newest committed sidecars so a throughput
+  # cliff or a broken compile-once invariant between bench rounds is at
+  # least loud in the CI log; nonzero exit does not fail the stage
+  # (the sidecars are historical artifacts, not this run's output)
+  python tools/bench_compare.py BENCH_r04.json BENCH_r05.json \
+      --threshold 0.25 \
+      || log "bench_compare: ADVISORY regression between BENCH_r04 and BENCH_r05 (see table above)"
 }
 
 case "$stage" in

@@ -57,6 +57,7 @@ from . import resilience
 from . import telemetry
 from . import tracing
 from . import memory
+from . import health
 from . import compile_cache
 from . import runtime
 

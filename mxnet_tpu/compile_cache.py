@@ -238,6 +238,18 @@ class CompileCache:
                 evicted = next(iter(self._entries))
                 self._entries.pop(evicted)
                 self._entry_stats.pop(evicted, None)
+                try:
+                    from . import health
+
+                    if health._enabled:
+                        # an eviction at steady state means the next use
+                        # of that key RECOMPILES — exactly the sequence a
+                        # postmortem wants in the journal
+                        health.event("compile_cache_evict",
+                                     cache=self.name,
+                                     entries=len(self._entries))
+                except Exception:  # noqa: BLE001 — journal is additive
+                    pass
             self._entries[key] = fn
         _entries_gauge()
         return fn

@@ -50,6 +50,7 @@ import weakref
 import jax
 import jax.numpy as jnp
 
+from .. import health
 from .. import telemetry
 from .. import tracing
 from ..base import MXNetError
@@ -267,6 +268,15 @@ class LazyGraph:
         self._ops_seen = 0
         self._cooloff_until = 0
         self._seen_sigs = collections.OrderedDict()
+        self._beacon = None       # lazy: stall-watchdog flush beacon
+
+    def _flush_beacon(self):
+        """This graph's stall-watchdog beacon (created on first use —
+        graphs are per-thread, so the thread id names it)."""
+        if self._beacon is None:
+            self._beacon = health.beacon(
+                f"lazy.flush.{threading.get_ident()}", owner=self)
+        return self._beacon
 
     # -- capture -------------------------------------------------------------
 
@@ -373,6 +383,11 @@ class LazyGraph:
                 vjp = _LazyVjp(p_treedef, residuals)
                 node.vjp_ref = weakref.ref(vjp)
             telemetry.counter("lazy.ops_captured").inc()
+            if health._enabled and len(self._nodes) == 1:
+                # a segment is now pending: the stall watchdog counts
+                # silence until the flush (no-flush-within-k×-median =
+                # a barrier that never came)
+                self._flush_beacon().arm()
             over_cap = len(self._nodes) >= _knob("MXNET_LAZY_MAX_OPS", 256)
         if over_cap:
             # bound host memory and compile size; the outputs just created
@@ -406,6 +421,13 @@ class LazyGraph:
                 self._flush_nodes(nodes, leaves, reason)
             finally:
                 self._flushing = False
+                if health._enabled:
+                    # progress: the barrier fired (even an error-path
+                    # flush replayed eagerly); nothing pending = idle
+                    b = self._flush_beacon()
+                    b.touch()
+                    if not self._nodes:
+                        b.idle()
 
     def _flush_nodes(self, nodes, leaves, reason):
         # liveness: a flat output slot is live iff its LazyArray is still
@@ -487,6 +509,9 @@ class LazyGraph:
                 outs = fn(*args)
         except Exception:  # noqa: BLE001 — degrade to slow, never wrong
             telemetry.counter("lazy.flush_errors").inc()
+            if health._enabled:
+                health.event("lazy_flush_error", ops=len(kept),
+                             reason=reason)
             self._replay_eager(kept, leaves, live)
             self._churn(hit=False)
             return
@@ -512,6 +537,10 @@ class LazyGraph:
                     _knob("MXNET_LAZY_COOLOFF", 512)
                 del w[:]
                 telemetry.counter("lazy.hysteresis_trips").inc()
+                if health._enabled:
+                    health.event("lazy_hysteresis",
+                                 cooloff_ops=_knob("MXNET_LAZY_COOLOFF",
+                                                   512))
 
     def _replay_eager(self, kept, leaves, live):
         """Per-op eager replay of the recorded nodes — the fallback when

@@ -13,6 +13,7 @@ import time
 
 import numpy as _np
 
+from .. import health
 from .. import telemetry
 from .. import tracing
 from ..base import MXNetError
@@ -215,129 +216,151 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            end_of_batch = False
-            data_iter = iter(train_data)
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                # telemetry: per-step breakdown — where a training step's
-                # wall time actually goes (data wait / fwd-bwd dispatch /
-                # optimizer update / metric sync). The metric update fetches
-                # values, so it doubles as the device sync segment.
-                # tracing: the same boundaries become a span tree under one
-                # "step" root whose trace id is DETERMINISTIC in
-                # (epoch, step) — every dist worker labels the same step
-                # identically, so tools/trace_merge.py can join their
-                # dumps. Nested spans (grad_sync issue/drain, fused
-                # dispatch, zero1 phases) parent to the root through the
-                # context var; the finished tree feeds the slow-step
-                # flight recorder.
-                tele = telemetry._enabled
-                trc = tracing._enabled
-                timed = tele or trc
-                step_span = tracing.span(
-                    "step", cat="train",
-                    trace_id=(tracing.deterministic_trace_id(
-                        "fit", epoch, nbatch) if trc else None),
-                    epoch=epoch, step=nbatch)
-                with step_span:
-                    t0 = time.perf_counter() if timed else 0.0
-                    # fused path: fwd+bwd+update as one XLA computation
-                    # (its whole cost lands in the fwdbwd segment)
-                    fused = self.fused_step(data_batch)
-                    if not fused:
-                        self.forward_backward(data_batch)
-                    t_fb = time.perf_counter() if timed else 0.0
-                    if not fused:
-                        self.update()
-                    t_up = time.perf_counter() if timed else 0.0
-                    if tele:
-                        telemetry.gauge("step.fused").set(1 if fused else 0)
-                    if isinstance(data_batch, list):
-                        self.update_metric(eval_metric,
-                                           [db.label for db in data_batch],
-                                           pre_sliced=True)
-                    else:
-                        self.update_metric(eval_metric, data_batch.label)
-                    t_sync = time.perf_counter() if timed else 0.0
-                    try:
-                        next_data_batch = next(data_iter)
-                        self.prepare(next_data_batch,
-                                     sparse_row_id_fn=sparse_row_id_fn)
-                    except StopIteration:
-                        end_of_batch = True
-                    t_data = time.perf_counter() if timed else 0.0
+        # stall-watchdog progress beacon: armed while the training
+        # loop owes steps, touched per completed step — a hang inside
+        # forward/backward/update/data surfaces as a watchdog stall
+        # with a diagnostic bundle instead of an opaque dead process
+        fit_beacon = health.beacon("fit.step") if health._enabled \
+            else None
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                if fit_beacon is not None:
+                    # armed per EPOCH: the validation/checkpoint tail
+                    # between epochs has no step cadence, so its silence
+                    # must not be judged by the training-step median
+                    fit_beacon.arm()
+                tic = time.time()
+                eval_metric.reset()
+                nbatch = 0
+                end_of_batch = False
+                data_iter = iter(train_data)
+                next_data_batch = next(data_iter)
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if monitor is not None:
+                        monitor.tic()
+                    # telemetry: per-step breakdown — where a training step's
+                    # wall time actually goes (data wait / fwd-bwd dispatch /
+                    # optimizer update / metric sync). The metric update fetches
+                    # values, so it doubles as the device sync segment.
+                    # tracing: the same boundaries become a span tree under one
+                    # "step" root whose trace id is DETERMINISTIC in
+                    # (epoch, step) — every dist worker labels the same step
+                    # identically, so tools/trace_merge.py can join their
+                    # dumps. Nested spans (grad_sync issue/drain, fused
+                    # dispatch, zero1 phases) parent to the root through the
+                    # context var; the finished tree feeds the slow-step
+                    # flight recorder.
+                    tele = telemetry._enabled
+                    trc = tracing._enabled
+                    timed = tele or trc
+                    step_span = tracing.span(
+                        "step", cat="train",
+                        trace_id=(tracing.deterministic_trace_id(
+                            "fit", epoch, nbatch) if trc else None),
+                        epoch=epoch, step=nbatch)
+                    with step_span:
+                        t0 = time.perf_counter() if timed else 0.0
+                        # fused path: fwd+bwd+update as one XLA computation
+                        # (its whole cost lands in the fwdbwd segment)
+                        fused = self.fused_step(data_batch)
+                        if not fused:
+                            self.forward_backward(data_batch)
+                        t_fb = time.perf_counter() if timed else 0.0
+                        if not fused:
+                            self.update()
+                        t_up = time.perf_counter() if timed else 0.0
+                        if tele:
+                            telemetry.gauge("step.fused").set(1 if fused else 0)
+                        if isinstance(data_batch, list):
+                            self.update_metric(eval_metric,
+                                               [db.label for db in data_batch],
+                                               pre_sliced=True)
+                        else:
+                            self.update_metric(eval_metric, data_batch.label)
+                        t_sync = time.perf_counter() if timed else 0.0
+                        try:
+                            next_data_batch = next(data_iter)
+                            self.prepare(next_data_batch,
+                                         sparse_row_id_fn=sparse_row_id_fn)
+                        except StopIteration:
+                            end_of_batch = True
+                        t_data = time.perf_counter() if timed else 0.0
+                        if trc:
+                            # the phase children, reconstructed from the perf
+                            # marks (one wall-clock read anchors them all)
+                            end_us = tracing.now_us()
+
+                            def _seg(name, a, b):
+                                tracing.emit_span(
+                                    name, end_us - (t_data - a) * 1e6,
+                                    (b - a) * 1e6, cat="train",
+                                    parent=step_span)
+
+                            _seg("step.fwdbwd", t0, t_fb)
+                            _seg("step.update", t_fb, t_up)
+                            _seg("step.sync", t_up, t_sync)
+                            _seg("step.data", t_sync, t_data)
+                            step_span.set(fused=fused)
                     if trc:
-                        # the phase children, reconstructed from the perf
-                        # marks (one wall-clock read anchors them all)
-                        end_us = tracing.now_us()
-
-                        def _seg(name, a, b):
-                            tracing.emit_span(
-                                name, end_us - (t_data - a) * 1e6,
-                                (b - a) * 1e6, cat="train",
-                                parent=step_span)
-
-                        _seg("step.fwdbwd", t0, t_fb)
-                        _seg("step.update", t_fb, t_up)
-                        _seg("step.sync", t_up, t_sync)
-                        _seg("step.data", t_sync, t_data)
-                        step_span.set(fused=fused)
-                if trc:
-                    tracing.flight_recorder.observe(step_span.tree())
-                step_stats = None
-                if tele:
-                    total_h = telemetry.histogram("step.total_us")
-                    for name, us in (("step.fwdbwd_us", (t_fb - t0) * 1e6),
-                                     ("step.update_us", (t_up - t_fb) * 1e6),
-                                     ("step.sync_us", (t_sync - t_up) * 1e6),
-                                     ("step.data_us", (t_data - t_sync) * 1e6)):
-                        telemetry.histogram(name).record(us)
-                    total_us = (t_data - t0) * 1e6
-                    total_h.record(total_us)
+                        tracing.flight_recorder.observe(step_span.tree())
+                    step_stats = None
+                    if tele:
+                        total_h = telemetry.histogram("step.total_us")
+                        for name, us in (("step.fwdbwd_us", (t_fb - t0) * 1e6),
+                                         ("step.update_us", (t_up - t_fb) * 1e6),
+                                         ("step.sync_us", (t_sync - t_up) * 1e6),
+                                         ("step.data_us", (t_data - t_sync) * 1e6)):
+                            telemetry.histogram(name).record(us)
+                        total_us = (t_data - t0) * 1e6
+                        total_h.record(total_us)
+                        if batch_end_callback is not None:
+                            # quantiles sort the reservoir, so they are NOT
+                            # computed here each batch — the histogram rides
+                            # along and consumers (Speedometer) pull
+                            # hist.quantiles(50, 99) only on their log ticks
+                            step_stats = {
+                                "fwdbwd_ms": (t_fb - t0) * 1e3,
+                                "update_ms": (t_up - t_fb) * 1e3,
+                                "sync_ms": (t_sync - t_up) * 1e3,
+                                "data_ms": (t_data - t_sync) * 1e3,
+                                "total_ms": total_us / 1e3,
+                                "hist": total_h,
+                            }
+                    if monitor is not None:
+                        monitor.toc_print()
                     if batch_end_callback is not None:
-                        # quantiles sort the reservoir, so they are NOT
-                        # computed here each batch — the histogram rides
-                        # along and consumers (Speedometer) pull
-                        # hist.quantiles(50, 99) only on their log ticks
-                        step_stats = {
-                            "fwdbwd_ms": (t_fb - t0) * 1e3,
-                            "update_ms": (t_up - t_fb) * 1e3,
-                            "sync_ms": (t_sync - t_up) * 1e3,
-                            "data_ms": (t_data - t_sync) * 1e3,
-                            "total_ms": total_us / 1e3,
-                            "hist": total_h,
-                        }
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    for cb in _as_list(batch_end_callback):
-                        cb(_BatchEndParam(epoch, nbatch, eval_metric,
-                                          locals(), step_stats=step_stats))
-                nbatch += 1
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+                        for cb in _as_list(batch_end_callback):
+                            cb(_BatchEndParam(epoch, nbatch, eval_metric,
+                                              locals(), step_stats=step_stats))
+                    nbatch += 1
+                    if fit_beacon is not None:
+                        # progress: one full step (data/fwdbwd/update/sync)
+                        # completed — the watchdog's rolling median learns
+                        # the step cadence from these
+                        fit_beacon.touch()
+                if fit_beacon is not None:
+                    fit_beacon.idle()
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
 
-            arg_p, aux_p = self.get_params()
-            self.set_params(arg_p, aux_p)
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_p, aux_p)
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-            train_data.reset()
+                arg_p, aux_p = self.get_params()
+                self.set_params(arg_p, aux_p)
+                if epoch_end_callback is not None:
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_p, aux_p)
+                if eval_data is not None:
+                    res = self.score(eval_data, validation_metric,
+                                     score_end_callback=eval_end_callback,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                train_data.reset()
+        finally:
+            if fit_beacon is not None:
+                fit_beacon.idle()
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass

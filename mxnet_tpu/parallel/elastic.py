@@ -47,6 +47,7 @@ import sys
 import threading
 import time
 
+from .. import health
 from .. import telemetry
 from .. import tracing
 from ..base import getenv, register_env
@@ -199,6 +200,9 @@ class ElasticRuntime:
                 self._lost.add(r)
                 if telemetry._enabled:
                     telemetry.counter("elastic.lost_workers").inc()
+                if health._enabled:
+                    health.event("worker_lost", rank=r, world=self.world,
+                                 generation=self.generation)
                 _logger().error(
                     "worker %d lost (lease expired > %.1fs) — fleet was "
                     "%d ranks, generation %d", r, self.grace_s, self.world,
@@ -284,6 +288,9 @@ class ElasticRuntime:
             telemetry.histogram("elastic.shrink_us").record(dt_us)
             telemetry.gauge("elastic.generation").set(spec["generation"])
             telemetry.gauge("elastic.world_size").set(spec["world"])
+        if health._enabled:
+            health.event("elastic_shrink", generation=spec["generation"],
+                         world=spec["world"], rank=spec["rank"])
         _logger().warning(
             "shrink rendezvous complete in %.0f ms: generation %d -> %d, "
             "world %d -> %d, new rank %d, coordinator %s",

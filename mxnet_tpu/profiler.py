@@ -229,6 +229,15 @@ def _render_doc(events, dropped):
             events = events + telemetry.trace_counter_events()
     except Exception:  # noqa: BLE001
         pass
+    try:  # health journal merge: runtime events (evictions, drains,
+        # watchdog firings) as chrome-trace instant marks on the same
+        # timeline as spans and counters
+        from . import health
+
+        if health._enabled:
+            events = events + health.trace_instant_events()
+    except Exception:  # noqa: BLE001
+        pass
     doc = {"traceEvents": events}
     other = {}
     if dropped:

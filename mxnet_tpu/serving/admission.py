@@ -31,6 +31,7 @@ import collections
 import threading
 import time
 
+from .. import health
 from .. import telemetry
 from ..base import MXNetError, getenv, register_env
 
@@ -155,6 +156,9 @@ class AdmissionQueue:
             if len(self._q) >= self._max_depth:
                 if telemetry._enabled:
                     telemetry.counter(f"{self._prefix}.rejected").inc()
+                if health._enabled:
+                    health.event("admission_reject", prefix=self._prefix,
+                                 depth=len(self._q))
                 raise QueueFullError(
                     f"serving queue full ({len(self._q)} >= "
                     f"{self._max_depth} requests); shed load or raise "

@@ -42,6 +42,7 @@ from ..base import getenv, register_env
 from ..log import get_logger
 from ..resilience import retry_call
 from .admission import AdmissionQueue, DeadlineExceededError, Request
+from .health import attach_batcher, queue_ready
 
 __all__ = ["DynamicBatcher"]
 
@@ -87,6 +88,9 @@ class DynamicBatcher:
         self._worker = threading.Thread(
             target=self._loop, daemon=True, name="mxnet_tpu.serving.batcher")
         self._worker.start()
+        # fleet health: /healthz watches the worker thread, /readyz the
+        # queue watermark + warmup state (construction-time registration)
+        self.health_name = attach_batcher(self)
 
     # -- client API ----------------------------------------------------------
 
@@ -97,6 +101,25 @@ class DynamicBatcher:
     @property
     def queue_depth(self):
         return len(self._admission)
+
+    def healthy(self):
+        """Liveness: (ok, detail) — False only when the worker thread
+        died while the batcher still accepts work."""
+        if not self._worker.is_alive() and not self._admission.closed:
+            return False, "batcher worker thread died"
+        return True, "ok"
+
+    def ready(self):
+        """Readiness: (ok, reason) — closed/draining, predictor not yet
+        warmed, or intake queue above the health watermark all report
+        not-ready (the /readyz probe)."""
+        if self._admission.closed:
+            return False, "closed (draining)"
+        p = self._predictor
+        # traffic-compiled predictors count as warmed (the engine rule)
+        if not getattr(p, "_warmed", True) and not getattr(p, "_execs", True):
+            return False, "predictor warmup not run"
+        return queue_ready(self._admission)
 
     def submit(self, data, timeout=None):
         """Enqueue one request; returns a Future resolving to the same
@@ -144,10 +167,15 @@ class DynamicBatcher:
 
     def close(self, timeout=None):
         """Graceful drain: stop admission, let the worker finish every
-        already-accepted request, join it. Idempotent."""
+        already-accepted request, join it. Idempotent. Deregisters the
+        health probes — a deliberately closed batcher must not pin
+        ``/readyz``."""
         self._admission.close()
         if self._worker.is_alive():
             self._worker.join(timeout)
+        from .. import health
+
+        health.unregister(self.health_name)
 
     def __enter__(self):
         return self

@@ -42,6 +42,7 @@ import time
 
 import numpy as np
 
+from ... import health
 from ... import memory
 from ... import telemetry
 from ... import tracing
@@ -49,6 +50,7 @@ from ...base import MXNetError, getenv, register_env
 from ...compile_cache import CompileCache
 from ...log import get_logger
 from ..admission import AdmissionQueue, DeadlineExceededError, Request
+from ..health import attach_engine, queue_ready
 from .session import GenerationStream
 
 __all__ = ["GenerationEngine", "prefill_ladder"]
@@ -165,6 +167,14 @@ class GenerationEngine:
         self._tokens_window = 0
         self._rate_t0 = time.monotonic()
         self.sessions_submitted = 0   # per-replica intake (router balance)
+        # fleet-health wiring: liveness/readiness probes (/healthz,
+        # /readyz, router drain) + the scheduler-tick progress beacon the
+        # stall watchdog monitors. Registration is construction-time;
+        # the tick path pays one health._enabled read when the layer is
+        # off (pinned by test_health.py)
+        self._warmed = False          # set by warm(); ready() also
+        #                               accepts traffic-compiled engines
+        self.health_name, self._beacon = attach_engine(self)
 
         # the slab is device state the engine REPLACES every tick, so the
         # census needs a live view, not a snapshot weakref
@@ -214,6 +224,31 @@ class GenerationEngine:
     @property
     def closed(self):
         return self._closed
+
+    # -- health --------------------------------------------------------------
+
+    def healthy(self):
+        """Liveness: (ok, detail). False only when the scheduler worker
+        thread died while the engine still owes work (a closed engine's
+        joined worker is fine, and manually-ticked engines have none)."""
+        if (self._worker is not None and not self._worker.is_alive()
+                and not self._closed):
+            return False, "scheduler worker thread died"
+        return True, "ok"
+
+    def ready(self):
+        """Readiness: (ok, reason) — the router's placement gate and the
+        ``/readyz`` probe. Not ready while draining (closed), while the
+        tick beacon is marked stalled by the watchdog, before any
+        executable exists (warm() not run AND no traffic compiled one),
+        or with the intake queue above the watermark."""
+        if self._closed:
+            return False, "closed (draining)"
+        if self._beacon.stalled:
+            return False, "scheduler stalled (watchdog)"
+        if not self._warmed and not len(self._cache):
+            return False, "warmup not run"
+        return queue_ready(self._queue)
 
     def kv_slab_bytes(self):
         """Total device bytes the KV slab pins (both key and value
@@ -272,6 +307,10 @@ class GenerationEngine:
             raise
         if telemetry._enabled:
             telemetry.counter("serving.generation.sessions").inc()
+        if health._enabled:
+            # work is pending: the tick beacon's silence now counts as a
+            # stall until the slab drains again
+            self._beacon.arm()
         with self._work:
             # under the condition lock: concurrent submitters would lose
             # increments of a bare +=
@@ -324,6 +363,7 @@ class GenerationEngine:
                     jnp.asarray(self._last_tok), jnp.asarray(self._lengths))
         compiles = self._cache.misses - misses0
         seconds = time.perf_counter() - t0
+        self._warmed = True           # readiness: warmup complete
         if telemetry._enabled:
             telemetry.counter("serving.generation.warmup_compiles").inc(
                 compiles)
@@ -337,13 +377,16 @@ class GenerationEngine:
     def close(self, timeout=None):
         """Graceful drain: stop admission (``ServerClosedError`` for new
         submits), keep ticking until every admitted AND queued session
-        completes, join the worker. Idempotent."""
+        completes, join the worker. Idempotent. Deregisters the health
+        probes — a deliberately closed engine must not pin ``/readyz``."""
         self._queue.close()
         self._closed = True
         with self._work:
             self._work.notify_all()
         if self._worker is not None and self._worker.is_alive():
             self._worker.join(timeout)
+        health.unregister(self.health_name)
+        self._beacon.idle()
 
     def __enter__(self):
         return self
@@ -444,26 +487,43 @@ class GenerationEngine:
         guard) and reallocates the possibly-donated slab."""
         tele = telemetry._enabled
         t0 = time.perf_counter()
-        try:
-            now = time.monotonic()
-            for req in self._queue.expire(now):
-                self._fail_queued(req.payload, now)
-            for slot, sess in enumerate(self._sessions):
-                if (sess is not None and sess.deadline is not None
-                        and now >= sess.deadline):
-                    self._evict(slot, "deadline", DeadlineExceededError(
-                        f"session deadline passed after {sess.generated} "
-                        "generated token(s)"))
-            self._admit()
-            self._decode()
-        except Exception as e:  # noqa: BLE001 — never-strand + keep serving
-            self._logger.error("generation tick failed: %r", e)
-            for slot, sess in enumerate(self._sessions):
-                if sess is not None:
-                    self._evict(slot, "error", e)
-            # the failed executable may have consumed the donated slab
-            self._ck, self._cv = self._model.init_cache(self._slots,
-                                                        self._max_len)
+        # the tick's own span tree (admit/decode children via the context
+        # var; per-SESSION spans keep their explicit session parents) —
+        # observed into tracing.tick_recorder, the generation analog of
+        # the slow-step flight recorder (/trace serves it as worst_tick)
+        tick_span = tracing.span("generation.tick", cat="generation",
+                                 live=self._live, queued=len(self._queue))
+        with tick_span:
+            try:
+                now = time.monotonic()
+                for req in self._queue.expire(now):
+                    self._fail_queued(req.payload, now)
+                for slot, sess in enumerate(self._sessions):
+                    if (sess is not None and sess.deadline is not None
+                            and now >= sess.deadline):
+                        self._evict(slot, "deadline", DeadlineExceededError(
+                            f"session deadline passed after "
+                            f"{sess.generated} generated token(s)"))
+                self._admit()
+                self._decode()
+            except Exception as e:  # noqa: BLE001 — never-strand + serve on
+                self._logger.error("generation tick failed: %r", e)
+                tick_span.set(error=repr(e))
+                for slot, sess in enumerate(self._sessions):
+                    if sess is not None:
+                        self._evict(slot, "error", e)
+                # the failed executable may have consumed the donated slab
+                self._ck, self._cv = self._model.init_cache(self._slots,
+                                                            self._max_len)
+        if tracing._enabled:
+            tracing.tick_recorder.observe(tick_span.tree())
+        if health._enabled:
+            # progress beacon: the tick RAN (even a failed one evicted and
+            # reallocated — that is progress, not a stall); an empty slab
+            # parks the scheduler, so silence while idle is not a stall
+            self._beacon.touch()
+            if not self._has_work():
+                self._beacon.idle()
         if tele:
             dt = time.perf_counter() - t0
             telemetry.counter("serving.generation.ticks").inc()
@@ -488,13 +548,18 @@ class GenerationEngine:
         until the slab is full, the queue is empty, or the tick budget is
         spent — at least one admission per tick when a slot is free, so
         backlog always drains even under a tiny budget."""
-        import jax.numpy as jnp
-
         free = [i for i, s in enumerate(self._sessions) if s is None]
         if not free:
             return
         t0 = time.perf_counter()
         tele = telemetry._enabled
+        with tracing.span("generation.admit", cat="generation",
+                          free=len(free)):
+            self._admit_into(free, t0, tele)
+
+    def _admit_into(self, free, t0, tele):
+        import jax.numpy as jnp
+
         while free:
             batch, _ = self._queue.get_batch_nowait(1)
             if not batch:
@@ -569,10 +634,12 @@ class GenerationEngine:
         if self._live == 0:
             return
         fn = self._decode_fn()
-        toks, self._ck, self._cv = fn(
-            self._params, self._ck, self._cv,
-            jnp.asarray(self._last_tok), jnp.asarray(self._lengths))
-        toks = np.asarray(toks)
+        with tracing.span("generation.decode", cat="generation",
+                          live=self._live):
+            toks, self._ck, self._cv = fn(
+                self._params, self._ck, self._cv,
+                jnp.asarray(self._last_tok), jnp.asarray(self._lengths))
+            toks = np.asarray(toks)
         trc = tracing._enabled
         if trc:
             t_us = tracing.now_us()
@@ -631,6 +698,12 @@ class GenerationEngine:
         if telemetry._enabled:
             telemetry.counter("serving.generation.evictions").inc()
             telemetry.counter(f"serving.generation.evict_{reason}").inc()
+        if health._enabled and reason not in ("eos", "finished"):
+            # journal only the ABNORMAL evictions (deadline/max_len/error)
+            # — normal completions would drown the ring
+            health.event("generation_evict", engine=self.health_name,
+                         slot=slot, reason=reason,
+                         tokens=sess.generated)
         if exc is not None:
             sess.stream._fail(exc)
         else:
@@ -653,6 +726,9 @@ class GenerationEngine:
         if telemetry._enabled:
             telemetry.counter("serving.generation.evict_deadline").inc()
             telemetry.counter("serving.generation.evictions").inc()
+        if health._enabled:
+            health.event("generation_evict", engine=self.health_name,
+                         reason="deadline", queued=True)
         sess.stream._fail(exc)
         if sess.span is not None:
             sess.span.set(error=repr(exc), reason="deadline").finish()

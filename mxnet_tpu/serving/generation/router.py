@@ -9,11 +9,26 @@ prefills land — with a rotating tie-break so equal-load replicas (an idle
 fleet) still share evenly. A replica rejecting with ``QueueFullError``
 fails over to the next-least-loaded one; only when EVERY replica is full
 does the caller see backpressure.
+
+Under ``MXNET_HEALTH=1`` placement also consults per-engine READINESS
+(:meth:`GenerationEngine.ready`): an unready replica — wedged scheduler
+(watchdog-stalled beacon), intake queue above the watermark, draining
+after ``close()`` — is **drained**: the router stops placing new
+sessions there while its live sessions finish, and re-admits it the
+moment the probe passes again. Transitions land in the health event
+journal (``engine_drain`` / ``engine_undrain``) and the
+``health.ready_engines`` gauge. A fleet with NO ready replica falls back
+to load-order over all of them (availability over strictness — the
+engines' own backpressure still bounds the damage). The router also
+registers itself as an autoscale source
+(:func:`mxnet_tpu.health.register_fleet`), feeding the
+``health.desired_engines`` gauge.
 """
 from __future__ import annotations
 
 import itertools
 
+from ... import health
 from ... import telemetry
 from ...base import MXNetError
 from ..admission import QueueFullError
@@ -30,6 +45,9 @@ class GenerationRouter:
             raise MXNetError("GenerationRouter needs >= 1 engine")
         self._engines = engines
         self._rr = itertools.count()
+        self._ready_state = {}      # engine index -> last readiness bool
+        self._all_unready = False
+        health.register_fleet(self)
 
     @property
     def engines(self):
@@ -39,16 +57,52 @@ class GenerationRouter:
         """Per-replica occupancy, the placement signal."""
         return [e.load for e in self._engines]
 
+    def _ready_indices(self):
+        """Readiness sweep (health gate on): the engine indices placement
+        may use, with drain/undrain transitions journaled. Falls back to
+        ALL indices when nothing is ready."""
+        ready = []
+        for i, eng in enumerate(self._engines):
+            ok, reason = eng.ready()
+            prev = self._ready_state.get(i)
+            # journal the transition — including a first sweep that finds
+            # the engine already unready (a wedge that predates traffic)
+            if prev != ok and not (prev is None and ok):
+                kind = "engine_undrain" if ok else "engine_drain"
+                health.event(kind, engine=eng.health_name, index=i,
+                             reason=reason)
+                telemetry.counter(
+                    "health.undrains" if ok else "health.drains").inc()
+            self._ready_state[i] = ok
+            if ok:
+                ready.append(i)
+        telemetry.gauge("health.ready_engines").set(len(ready))
+        if not ready:
+            # availability over strictness: an all-unready fleet still
+            # places by load (engines' own backpressure bounds the harm)
+            if not self._all_unready:
+                self._all_unready = True
+                health.event("fleet_all_unready",
+                             engines=len(self._engines))
+            return list(range(len(self._engines)))
+        self._all_unready = False
+        return ready
+
     def submit(self, prompt, **kwargs):
-        """Place one session on the least-loaded replica (rotating
-        tie-break); fail over across replicas on ``QueueFullError`` and
-        re-raise it only when every replica is saturated."""
+        """Place one session on the least-loaded READY replica (rotating
+        tie-break; every replica when health is off or none is ready);
+        fail over across replicas on ``QueueFullError`` and re-raise it
+        only when every candidate is saturated."""
         n = len(self._engines)
         k = next(self._rr)
+        candidates = (set(self._ready_indices()) if health._enabled
+                      else None)
         order = sorted(range(n),
                        key=lambda i: (self._engines[(i + k) % n].load, i))
         last_exc = None
         for i in order:
+            if candidates is not None and (i + k) % n not in candidates:
+                continue
             eng = self._engines[(i + k) % n]
             try:
                 stream = eng.submit(prompt, **kwargs)

@@ -1,0 +1,99 @@
+"""Serving-side health probes: per-object liveness/readiness wiring.
+
+The core health layer (:mod:`mxnet_tpu.health`) owns the registries, the
+watchdog and the SLO tracker; this module is the glue that teaches the
+serving objects to report into them:
+
+* :func:`attach_engine` — a :class:`GenerationEngine` registers a
+  liveness probe (scheduler worker thread alive), a readiness probe
+  (warmed + intake queue below the watermark + tick beacon not stalled +
+  not draining) and a progress beacon the stall watchdog monitors (armed
+  on submit, touched per scheduler tick, idled when the slab empties).
+* :func:`attach_batcher` / :func:`attach_predictor` — the request-level
+  serving plane: worker-thread liveness, queue-watermark + warmed
+  readiness.
+
+Readiness drives PLACEMENT, not existence: the
+:class:`~mxnet_tpu.serving.generation.router.GenerationRouter` skips
+engines whose readiness probe fails (drain — live sessions finish, new
+sessions go elsewhere) and re-admits them the moment the probe passes
+again. ``/readyz`` aggregates the same probes per process.
+
+Everything here is construction-time registration (weak references, a
+few dict entries); the hot paths pay the usual one
+``health._enabled`` attribute read when the layer is off.
+"""
+from __future__ import annotations
+
+import itertools
+
+from .. import health
+from ..base import getenv
+
+__all__ = ["attach_engine", "attach_batcher", "attach_predictor",
+           "queue_watermark", "queue_ready"]
+
+_seq = itertools.count()
+
+
+def queue_watermark():
+    """The readiness watermark fraction (``MXNET_HEALTH_QUEUE_WATERMARK``
+    of the admission bound)."""
+    return float(getenv("MXNET_HEALTH_QUEUE_WATERMARK"))
+
+
+def queue_ready(queue):
+    """(ok, detail) for one admission queue against the watermark."""
+    depth = len(queue)
+    limit = queue.max_depth * queue_watermark()
+    if depth >= limit:
+        return False, (f"queue depth {depth} >= watermark "
+                       f"{limit:.0f} (of {queue.max_depth})")
+    return True, f"queue {depth}/{queue.max_depth}"
+
+
+def _engine_live(e):
+    return e.healthy()
+
+
+def _engine_ready(e):
+    return e.ready()
+
+
+def attach_engine(engine):
+    """Register one generation engine's probes + tick beacon. Returns the
+    (engine-unique) probe name, which is also the beacon name."""
+    name = f"generation.engine.{next(_seq)}"
+    health.register_liveness(name, engine, _engine_live)
+    health.register_readiness(name, engine, _engine_ready)
+    return name, health.beacon(name, owner=engine)
+
+
+def _batcher_live(b):
+    return b.healthy()
+
+
+def _batcher_ready(b):
+    return b.ready()
+
+
+def attach_batcher(batcher):
+    name = f"serving.batcher.{next(_seq)}"
+    health.register_liveness(name, batcher, _batcher_live)
+    health.register_readiness(name, batcher, _batcher_ready)
+    return name
+
+
+def _predictor_ready(p):
+    # traffic-compiled predictors count as warmed (the engine rule): a
+    # deployment that skipped serving.warmup() but has bound buckets is
+    # serving fine and must not report 503 forever
+    if not p._warmed and not p._execs:
+        return False, "warmup not run"
+    return True, f"buckets bound: {sorted(p._execs)}"
+
+
+def attach_predictor(predictor):
+    name = f"serving.predictor.{next(_seq)}"
+    health.register_readiness(name, predictor, _predictor_ready)
+    return name

@@ -200,6 +200,12 @@ class Predictor:
         self._cache = CompileCache("serving")
         self._execs = {}
         self._lock = threading.RLock()
+        # fleet health: /readyz reports warmup state per predictor
+        # (serving.warmup sets _warmed; registration is weakly held)
+        self._warmed = False
+        from .health import attach_predictor
+
+        self.health_name = attach_predictor(self)
         # memory census: the bound parameters are the serving side's
         # weight residency (SHARED across bucket executors — the census
         # dedupes by buffer, so N buckets still count one copy)

@@ -52,6 +52,7 @@ def warmup(target, buckets=None):
         pred.warm_bucket(b)
     compiles = cache.misses - misses0
     seconds = time.perf_counter() - t0
+    pred._warmed = True           # readiness: warmup complete (/readyz)
     if telemetry._enabled:
         telemetry.counter("serving.warmup_compiles").inc(compiles)
     get_logger("mxnet_tpu.serving").info(

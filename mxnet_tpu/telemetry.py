@@ -77,7 +77,8 @@ register_env("MXNET_TELEMETRY_RESERVOIR", 1024,
              "histogram reservoir size (quantile accuracy vs. memory)")
 register_env("MXNET_TELEMETRY_HTTP_PORT", 0,
              "serve /metrics (Prometheus text), /trace (chrome trace + "
-             "worst-step span tree) and /memory (device-buffer census) on "
+             "worst-step/tick span trees), /memory (device-buffer census) "
+             "and the health plane (/slo, /healthz, /readyz, /events) on "
              "this port from a background thread (0 = off)")
 register_env("MXNET_TELEMETRY_HTTP_HOST", "127.0.0.1",
              "bind address for the telemetry HTTP endpoint — loopback by "
@@ -477,6 +478,32 @@ def _prom_name(name):
     return n if n[:1].isalpha() or n[:1] == "_" else "_" + n
 
 
+def _prom_value(v):
+    """A metric value in Prometheus text form, or None when the value is
+    not representable (a gauge someone set to a string must be skipped,
+    not emitted as an unparseable sample). Non-finite floats use the
+    spec spellings ``+Inf`` / ``-Inf`` / ``NaN``."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if not isinstance(v, (int, float)):
+        return None
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _prom_label(value):
+    """A label VALUE escaped per the text exposition format: backslash,
+    double-quote and newline are the three characters the parser cannot
+    take raw."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def prom_text(refresh_memory=True):
     """The registry in Prometheus text exposition format (what the HTTP
     ``/metrics`` endpoint serves, scrapeable by any Prometheus-compatible
@@ -496,9 +523,14 @@ def prom_text(refresh_memory=True):
     lines = []
 
     def emit(name, kind, value):
+        v = _prom_value(value)
+        if v is None:
+            # un-renderable (e.g. a gauge set to a string): a skipped
+            # sample keeps the whole exposition parseable
+            return
         n = "mxnet_" + _prom_name(name)
         lines.append(f"# TYPE {n} {kind}")
-        lines.append(f"{n} {value}")
+        lines.append(f"{n} {v}")
 
     for name, v in sorted(snap["counters"].items()):
         emit(name, "counter", v)
@@ -511,9 +543,14 @@ def prom_text(refresh_memory=True):
         lines.append(f"# TYPE {n} summary")
         if h["count"]:
             for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-                lines.append(f'{n}{{quantile="{q}"}} {h[key]}')
-        lines.append(f"{n}_sum {h['sum']}")
-        lines.append(f"{n}_count {h['count']}")
+                qv = _prom_value(h[key])
+                if qv is None:
+                    # a zero-size reservoir records count/sum but no
+                    # quantiles — "None" is not a float the parser takes
+                    continue
+                lines.append(f'{n}{{quantile="{_prom_label(q)}"}} {qv}')
+        lines.append(f"{n}_sum {_prom_value(h['sum'])}")
+        lines.append(f"{n}_count {_prom_value(h['count'])}")
     return "\n".join(lines) + "\n"
 
 
@@ -532,7 +569,13 @@ def start_http_server(port=None, host=None):
       flight recorder's worst-step span tree;
     * ``/memory`` — the live device-buffer census
       (:func:`mxnet_tpu.memory.census`) + per-executable XLA memory
-      analysis where computed.
+      analysis where computed;
+    * ``/slo`` — the SLO tracker's evaluation report (objectives, burn
+      rates, budget state, the autoscale signal);
+    * ``/healthz`` / ``/readyz`` — liveness/readiness probe aggregation
+      (HTTP 503 when any probe fails — a k8s-shaped contract);
+    * ``/events`` — the health event journal (bounded ring of runtime
+      events: rejections, evictions, drains, watchdog firings).
 
     Returns the server (its ``.server_address[1]`` is the bound port —
     pass port 0 for an ephemeral one in tests), or None when off."""
@@ -549,9 +592,9 @@ def start_http_server(port=None, host=None):
         def log_message(self, *a):  # quiet: not a user-facing web server
             pass
 
-        def _send(self, body, ctype):
+        def _send(self, body, ctype, code=200):
             data = body.encode() if isinstance(body, str) else body
-            self.send_response(200)
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
@@ -569,6 +612,11 @@ def start_http_server(port=None, host=None):
                     worst = tracing.flight_recorder.worst()
                     if worst is not None:
                         doc.setdefault("otherData", {})["worst_step"] = worst
+                    # the generation analog: the worst scheduler decode
+                    # tick's span tree (tracing.tick_recorder)
+                    tick = tracing.tick_recorder.worst()
+                    if tick is not None:
+                        doc.setdefault("otherData", {})["worst_tick"] = tick
                     # compact: a near-cap buffer is hundreds of MB
                     # pretty-printed, and this is a machine-read endpoint
                     self._send(json.dumps(doc), "application/json")
@@ -578,8 +626,38 @@ def start_http_server(port=None, host=None):
                     doc = memory.census()
                     doc["executables"] = memory.executable_stats()
                     self._send(json.dumps(doc, indent=2), "application/json")
+                elif path == "/slo":
+                    from . import health
+
+                    self._send(json.dumps(health.slo_report(), indent=2,
+                                          default=repr),
+                               "application/json")
+                elif path == "/healthz":
+                    from . import health
+
+                    ok, probes = health.liveness()
+                    body = {"ok": ok, "pid": os.getpid(),
+                            "health_enabled": health._enabled,
+                            "probes": probes}
+                    self._send(json.dumps(body, indent=2),
+                               "application/json", 200 if ok else 503)
+                elif path == "/readyz":
+                    from . import health
+
+                    ok, probes = health.readiness()
+                    body = {"ok": ok, "probes": probes}
+                    self._send(json.dumps(body, indent=2),
+                               "application/json", 200 if ok else 503)
+                elif path == "/events":
+                    from . import health
+
+                    self._send(json.dumps(health.events(), indent=2,
+                                          default=repr),
+                               "application/json")
                 else:
-                    self.send_error(404, "try /metrics, /trace or /memory")
+                    self.send_error(404, "try /metrics, /trace, /memory, "
+                                         "/slo, /healthz, /readyz or "
+                                         "/events")
             except Exception as e:  # noqa: BLE001 — a scrape must not crash
                 try:
                     self.send_error(500, repr(e))

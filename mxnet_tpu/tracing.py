@@ -62,7 +62,7 @@ __all__ = ["Span", "span", "emit_span", "begin", "inject", "attach",
            "deterministic_trace_id",
            "enabled", "enable", "disable", "take_events", "peek_events",
            "dropped_events", "reset", "FlightRecorder", "flight_recorder",
-           "now_us"]
+           "tick_recorder", "now_us"]
 
 register_env("MXNET_TRACING", False,
              "enable span tracing (per-request / per-step span trees "
@@ -143,6 +143,7 @@ def reset():
         _dropped = 0
         _unmirrored = 0
     flight_recorder.reset()
+    tick_recorder.reset()
 
 
 def dropped_events():
@@ -478,3 +479,10 @@ class FlightRecorder:
 
 
 flight_recorder = FlightRecorder()
+
+# the generation-plane analog of the slow-step recorder: the worst
+# scheduler DECODE TICK's span tree since last read (`GenerationEngine`
+# feeds it per tick; the HTTP /trace endpoint serves it as `worst_tick`
+# beside `worst_step`, and watchdog diagnostic bundles capture it) —
+# the "what did the slow tick actually do" black box for serving
+tick_recorder = FlightRecorder()

@@ -357,3 +357,99 @@ def test_report_tool_renders_snapshot(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     assert "r.count" in r.stdout and "r.lat_us" in r.stdout
     assert "Telemetry Statistics" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# Snapshot schema stability + Prometheus text hardening (health/SLO PR)
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_schema_stability():
+    """Pin the snapshot schema that tools/telemetry_report.py AND the SLO
+    tracker both parse: the top-level keys and the histogram quantile
+    fields. A refactor that renames any of these silently breaks every
+    snapshot consumer — this test makes it loud."""
+    telemetry.counter("schema.c").inc(3)
+    telemetry.gauge("schema.g").set(1.5)
+    telemetry.histogram("schema.h").record(123.0)
+    from mxnet_tpu.compile_cache import CompileCache
+
+    cache = CompileCache("schema_test")
+    cache.get_or_build(("k",), lambda: (lambda: None))
+    snap = telemetry.snapshot()
+    # top-level contract
+    for key in ("ts", "pid", "counters", "gauges", "histograms", "derived",
+                "compile_caches"):
+        assert key in snap, f"snapshot lost top-level key {key!r}"
+    assert isinstance(snap["counters"], dict)
+    assert isinstance(snap["gauges"], dict)
+    assert isinstance(snap["histograms"], dict)
+    # histogram field contract (telemetry_report columns, SLO quantile
+    # stats, bench sidecar consumers)
+    h = snap["histograms"]["schema.h"]
+    assert set(h) == {"count", "sum", "min", "max", "avg",
+                      "p50", "p95", "p99"}
+    # the empty-histogram shape is part of the contract too
+    telemetry.histogram("schema.empty")
+    h0 = telemetry.snapshot()["histograms"]["schema.empty"]
+    assert h0["count"] == 0 and h0["p99"] is None
+    # per-name compile ledger rows carry hits/misses/compile_seconds
+    row = snap["compile_caches"]["schema_test"]
+    for key in ("hits", "misses", "compile_seconds"):
+        assert key in row
+    # round-trips through JSON (the dump/report path)
+    json.loads(json.dumps(snap))
+
+
+def _parse_prom(text):
+    """Minimal text-exposition parser: every non-comment line must be
+    `name[{labels}] value` with a float-parseable value."""
+    samples = []
+    for line in text.strip().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value = line.rpartition(" ")
+        assert name_part, f"malformed sample line: {line!r}"
+        float(value)  # +Inf/-Inf/NaN all parse
+        if "{" in name_part:
+            assert name_part.endswith("}"), f"unclosed labels: {line!r}"
+            name, _, labels = name_part.partition("{")
+            assert '"' in labels  # values quoted
+        else:
+            name = name_part
+        assert name.replace("_", "").replace(":", "").isalnum(), \
+            f"bad metric name {name!r}"
+        samples.append((name, value))
+    return samples
+
+
+def test_prom_text_escapes_malformed_names_and_values():
+    """Metric names with exposition-hostile characters, non-finite
+    values, and quantile-less histograms (reservoir size 0) must all
+    render as parseable Prometheus text — the current-output-was-
+    unescaped-interpolation satellite."""
+    telemetry.counter('weird"metric\nwith\\stuff').inc(2)
+    telemetry.gauge("g.inf").set(float("inf"))
+    telemetry.gauge("g.nan").set(float("nan"))
+    telemetry.gauge("g.string").set("not-a-number")  # must be SKIPPED
+    h = telemetry.Histogram("h.noquant", reservoir=0)
+    with telemetry._registry_lock:
+        telemetry._registry["h.noquant"] = h
+    h.record(5.0)  # count/sum exist, quantiles are None
+    text = telemetry.prom_text(refresh_memory=False)
+    samples = _parse_prom(text)
+    names = {n for n, _ in samples}
+    assert "mxnet_weird_metric_with_stuff" in names
+    assert ("mxnet_g_inf", "+Inf") in samples
+    assert any(n == "mxnet_g_nan" and v == "NaN" for n, v in samples)
+    assert not any("g_string" in n for n in names), \
+        "a string-valued gauge leaked into the exposition"
+    # the quantile-less histogram emits sum/count but no `None` sample
+    assert "None" not in text
+    assert "mxnet_h_noquant_count" in names
+
+
+def test_prom_label_escaping_helper():
+    assert telemetry._prom_label('a"b') == 'a\\"b'
+    assert telemetry._prom_label("a\\b") == "a\\\\b"
+    assert telemetry._prom_label("a\nb") == "a\\nb"

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Diff two bench sidecars and flag regressions — trajectory tooling for
+the repo's ``BENCH_*.json`` series.
+
+Usage::
+
+    python tools/bench_compare.py OLD.json NEW.json [--threshold 0.1]
+
+Each input is either a raw ``bench.py`` result record or a repo sidecar
+wrapper (``{"n", "cmd", "rc", "tail", "parsed"}`` — the ``parsed`` record
+wins; a wrapper without one falls back to the last JSON line of
+``tail``). The comparison covers the steady-state throughput numbers
+(img/s, serving req/s, generation tokens/s, lazy speedup), the latency
+tails (serving p99, generation TTFT), and the compile costs — each
+metric knows its direction, so "higher" and "lower" are both regressions
+only when they move the WRONG way past ``--threshold`` (relative).
+
+``steady_state_compiles`` is special-cased as a hard invariant: any
+nonzero value in NEW is a regression regardless of OLD (the compile-once
+discipline is a contract, not a trend).
+
+Exit status: 0 = no regression, 1 = regression(s) beyond threshold,
+2 = input problem. ``ci/run.sh`` runs an ADVISORY invocation over the
+two newest repo sidecars (nonzero exit logged, not fatal) so a
+throughput cliff is at least loud.
+"""
+import argparse
+import json
+import sys
+
+# (path, label, direction) — direction "up" = bigger is better,
+# "down" = smaller is better. Paths index nested records with dots.
+METRICS = [
+    ("value", "headline img/s", "up"),
+    ("raw_fp32", "raw jax img/s", "up"),
+    ("framework_module_fused", "module fused img/s", "up"),
+    ("fused_vs_eager", "fused/eager speedup", "up"),
+    ("framework_vs_raw", "framework/raw ratio", "up"),
+    ("serving.req_per_s", "serving req/s", "up"),
+    ("serving.p99_ms", "serving p99 ms", "down"),
+    ("serving.cold_compile_s", "serving cold compile s", "down"),
+    ("generation.tokens_per_s", "generation tokens/s", "up"),
+    ("generation.ttft_p50_ms", "generation TTFT p50 ms", "down"),
+    ("generation.ttft_p99_ms", "generation TTFT p99 ms", "down"),
+    ("generation.cold_compile_s", "generation cold compile s", "down"),
+    ("lazy.lazy_vs_eager", "lazy/eager speedup", "up"),
+    ("framework_module_compile_s", "module compile s", "down"),
+]
+
+# nonzero in NEW = broken compile-once contract, whatever OLD said
+INVARIANTS = [
+    ("serving.steady_state_compiles", "serving steady-state compiles"),
+    ("generation.steady_state_compiles", "generation steady-state compiles"),
+    ("lazy.steady_state_compiles", "lazy steady-state compiles"),
+]
+
+
+def load_record(path):
+    """The bench result record inside ``path`` (raw record, or the repo
+    sidecar wrapper's ``parsed`` / last ``tail`` JSON line)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        return doc["parsed"]
+    if "tail" in doc and "metric" not in doc:
+        for line in reversed(doc["tail"].strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise ValueError(f"{path}: wrapper has no parseable tail record")
+    return doc
+
+
+def get(record, path):
+    cur = record
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline BENCH json (raw or sidecar)")
+    ap.add_argument("new", help="candidate BENCH json (raw or sidecar)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression tolerance (default 0.10 = "
+                         "10%% the wrong way)")
+    args = ap.parse_args(argv)
+
+    try:
+        old = load_record(args.old)
+        new = load_record(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        sys.stderr.write(f"bench_compare: {e}\n")
+        return 2
+
+    ob, nb = old.get("backend"), new.get("backend")
+    if ob and nb and ob != nb:
+        # numbers across backends are not a trend — still print, but say so
+        sys.stdout.write(f"NOTE: backend changed {ob} -> {nb}; deltas "
+                         "below compare different hardware\n")
+
+    hdr = f"{'metric':<34}{'old':>12}{'new':>12}{'delta':>9}  verdict"
+    sys.stdout.write(hdr + "\n" + "-" * len(hdr) + "\n")
+    regressions = []
+    for path, label, direction in METRICS:
+        o, n = get(old, path), get(new, path)
+        if o is None or n is None:
+            continue
+        if o == 0:
+            delta = 0.0 if n == 0 else float("inf")
+        else:
+            delta = (n - o) / abs(o)
+        bad = (delta < -args.threshold if direction == "up"
+               else delta > args.threshold)
+        verdict = "REGRESSION" if bad else (
+            "improved" if (delta > 0) == (direction == "up") and delta != 0
+            else "ok")
+        if bad:
+            regressions.append((label, o, n, delta))
+        sys.stdout.write(f"{label:<34}{o:>12.3f}{n:>12.3f}"
+                         f"{delta * 100:>8.1f}%  {verdict}\n")
+    for path, label in INVARIANTS:
+        n = get(new, path)
+        if n is None:
+            continue
+        if n > 0:
+            regressions.append((label, 0, n, float("inf")))
+            sys.stdout.write(f"{label:<34}{'0':>12}{n:>12}"
+                             f"{'':>9}  REGRESSION (must be 0)\n")
+        else:
+            sys.stdout.write(f"{label:<34}{'0':>12}{n:>12}{'':>9}  ok\n")
+
+    if regressions:
+        sys.stdout.write(
+            f"\n{len(regressions)} regression(s) beyond "
+            f"{args.threshold * 100:.0f}%:\n")
+        for label, o, n, d in regressions:
+            sys.stdout.write(f"  - {label}: {o} -> {n}\n")
+        return 1
+    sys.stdout.write("\nno regressions beyond threshold\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,6 +152,35 @@ def main(argv=None):
         line += ("\n  (high bubble = raise MXNET_PIPELINE_MICROBATCHES - "
                  "docs/faq/perf.md \"Choosing micro-batch count\")\n")
         sys.stdout.write(line)
+    gauges = snap.get("gauges", {})
+    slo_keys = sorted({k[len("slo."):-len(".ok")]
+                       for k in gauges if k.startswith("slo.")
+                       and k.endswith(".ok")})
+    stalls = counters.get("health.stalls", 0)
+    h_events = counters.get("health.events", 0)
+    if slo_keys or stalls or h_events:
+        violated = [k for k in slo_keys if not gauges.get(f"slo.{k}.ok", 1)]
+        line = (f"\nhealth: {len(slo_keys) - len(violated)}/{len(slo_keys)} "
+                f"SLOs ok")
+        if violated:
+            burns = []
+            for k in violated:
+                b = gauges.get(f"slo.{k}.burn_short")
+                burns.append(f"{k}" + (f" (burn {b:.1f}x)"
+                                       if b is not None else ""))
+            line += "; VIOLATED: " + ", ".join(burns)
+        if gauges.get("slo.budget_exhausted"):
+            line += "; ERROR BUDGET EXHAUSTED"
+        line += (f"; stalls {stalls}, drains "
+                 f"{counters.get('health.drains', 0)}, journal events "
+                 f"{h_events}")
+        de = gauges.get("health.desired_engines")
+        if de is not None:
+            line += (f"; autoscale wants {de:.0f} engine(s) of "
+                     f"{gauges.get('health.ready_engines', 0):.0f} ready")
+        line += ("\n  (read /slo and /events for the full picture - "
+                 "docs/faq/perf.md \"Operating a fleet\")\n")
+        sys.stdout.write(line)
     lost = counters.get("elastic.lost_workers", 0)
     shrinks = counters.get("elastic.shrinks", 0)
     gen = snap.get("gauges", {}).get("elastic.generation", 0)
