@@ -937,16 +937,32 @@ def _measure_generation(on_tpu):
     the 'generation' compile cache stayed cold-free afterwards
     (`steady_state_compiles` must be 0 — nonzero means admission or
     eviction churned a shape, the regression continuous batching exists
-    to prevent)."""
+    to prevent).
+
+    Two scale-out lanes ride the same probe:
+    * **speculative** — the workload re-runs through an engine with
+      `spec_k=4` and the n-gram draft; reports `spec_tokens_per_s`, the
+      `spec_vs_plain` speedup and `accepted_tokens_per_tick` (committed
+      tokens per live slot per verify tick — plain decode's floor is
+      1.0, so > 1 is the headline). Greedy output is bit-exact with the
+      plain lane by construction, so the speedup is free of quality
+      caveats. On CPU the verify's k+1-fold compute usually outweighs
+      the dispatch savings (see docs/faq/perf.md "when speculation
+      loses") — the tokens/tick number is the hardware-independent one.
+    * **prefix cache** — clients share one system prompt with ragged
+      tails through a `prefix_cache=True` engine; reports
+      `prefix_hit_ratio` (target (N-1)/N) and `prefix_ttft_p50_ms`
+      (fork + suffix prefill) next to the cold `ttft_p50_ms` above.
+    Both lanes assert zero steady-state compiles on their own engines."""
     import threading
 
     import numpy as np
 
     import jax
     from mxnet_tpu import parallel as par
-    from mxnet_tpu import serving
+    from mxnet_tpu import serving, telemetry
     from mxnet_tpu.models import TransformerLM, TransformerLMConfig
-    from mxnet_tpu.serving.generation import GenerationEngine
+    from mxnet_tpu.serving.generation import GenerationEngine, NgramDraft
 
     mesh = par.create_mesh(devices=jax.devices()[:1], dp=1)
     cfg = TransformerLMConfig(
@@ -1015,6 +1031,79 @@ def _measure_generation(on_tpu):
         slab_mb = eng.kv_slab_bytes() / 2 ** 20
     assert steady == 0, f"steady-state generation compiles: {steady}"
     ttfts.sort()
+
+    def _counter(name):
+        m = telemetry.get(name)
+        return float(m.value) if m is not None else 0.0
+
+    # speculative lane: the same ragged workload, one engine with the
+    # n-gram draft proposing 4 tokens per tick
+    spec_k = 4
+    n_spec = min(n_clients * per_client, 16)
+    com0 = _counter("serving.generation.spec.committed")
+    vs0 = _counter("serving.generation.spec.verified_slots")
+    with GenerationEngine(lm, params, max_slots=slots, max_len=cfg.max_len,
+                          buckets=buckets, spec_k=spec_k,
+                          draft=NgramDraft()) as spec_eng:
+        serving.warmup(spec_eng)
+        m0 = spec_eng.cache.misses
+        t0 = time.perf_counter()
+        spec_streams = [spec_eng.submit(prompts[i % len(prompts)],
+                                        max_new_tokens=16)
+                        for i in range(n_spec)]
+        spec_out = [s.result(timeout=120) for s in spec_streams]
+        spec_wall = time.perf_counter() - t0
+        spec_steady = spec_eng.cache.misses - m0
+    assert spec_steady == 0, \
+        f"steady-state speculative compiles: {spec_steady}"
+    committed = _counter("serving.generation.spec.committed") - com0
+    vslots = _counter("serving.generation.spec.verified_slots") - vs0
+    spec_tps = sum(len(o) for o in spec_out) / max(spec_wall, 1e-9)
+
+    # plain engine over the SAME closed-loop shape, for an apples-to-
+    # apples spec_vs_plain wall ratio (the threaded run above has
+    # different client dynamics)
+    with GenerationEngine(lm, params, max_slots=slots, max_len=cfg.max_len,
+                          buckets=buckets) as plain_eng:
+        serving.warmup(plain_eng)
+        t0 = time.perf_counter()
+        plain_streams = [plain_eng.submit(prompts[i % len(prompts)],
+                                          max_new_tokens=16)
+                         for i in range(n_spec)]
+        plain_out = [s.result(timeout=120) for s in plain_streams]
+        plain_wall = time.perf_counter() - t0
+    # the TOKEN SEQUENCES, not counts: with no eos both lanes always
+    # emit max_new_tokens, so a count comparison could never fail
+    assert plain_out == spec_out, \
+        "speculative lane diverged from plain greedy"
+
+    # prefix-cache lane: every client shares one 16-token system prompt
+    ph0 = _counter("serving.generation.prefix.hits")
+    pm0 = _counter("serving.generation.prefix.misses")
+    sys_prompt = rng.randint(1, cfg.vocab_size, 16).astype(np.int32)
+    n_pref = min(n_clients * per_client, 24)
+    pref_prompts = [np.concatenate([sys_prompt,
+                                    rng.randint(1, cfg.vocab_size,
+                                                1 + int(l)).astype(np.int32)])
+                    for l in rng.randint(1, 8, size=n_pref)]
+    with GenerationEngine(lm, params, max_slots=slots, max_len=cfg.max_len,
+                          buckets=buckets, prefix_cache=True,
+                          prefix_min_tokens=8) as pref_eng:
+        serving.warmup(pref_eng)
+        m0 = pref_eng.cache.misses
+        pref_ttfts = []
+        for p in pref_prompts:
+            t0 = time.perf_counter()
+            stream = pref_eng.submit(p, max_new_tokens=8)
+            next(stream)
+            pref_ttfts.append(time.perf_counter() - t0)
+            stream.result(timeout=120)
+        pref_steady = pref_eng.cache.misses - m0
+    assert pref_steady == 0, f"steady-state prefix compiles: {pref_steady}"
+    hits = _counter("serving.generation.prefix.hits") - ph0
+    misses = _counter("serving.generation.prefix.misses") - pm0
+    hit_ttfts = sorted(pref_ttfts[1:]) or [0.0]
+
     return {
         "metric": "generation_throughput",
         "sessions": n_clients * per_client,
@@ -1031,6 +1120,14 @@ def _measure_generation(on_tpu):
         "buckets": list(buckets),
         "max_len": cfg.max_len,
         "kv_slab_mb": round(slab_mb, 2),
+        "spec_k": spec_k,
+        "spec_tokens_per_s": round(spec_tps, 1),
+        "spec_vs_plain": round(plain_wall / max(spec_wall, 1e-9), 3),
+        "accepted_tokens_per_tick": round(committed / max(vslots, 1.0), 3),
+        "spec_steady_state_compiles": spec_steady,
+        "prefix_hit_ratio": round(hits / max(hits + misses, 1.0), 3),
+        "prefix_ttft_p50_ms": round(_pct(hit_ttfts, 50) * 1e3, 3),
+        "prefix_steady_state_compiles": pref_steady,
     }
 
 

@@ -46,6 +46,7 @@ unit() {
       --ignore=tests/python/unittest/test_grad_sync.py \
       --ignore=tests/python/unittest/test_serving.py \
       --ignore=tests/python/unittest/test_generation.py \
+      --ignore=tests/python/unittest/test_generation_scale.py \
       --ignore=tests/python/unittest/test_zero1.py \
       --ignore=tests/python/unittest/test_tracing.py \
       --ignore=tests/python/unittest/test_pipeline.py \
@@ -87,6 +88,16 @@ unit() {
   # scheduler, KV-slab or compile-discipline regression fails HERE
   log "generation suite (slot KV-cache sessions, continuous batching parity, streaming deadlines, router)"
   python -m pytest tests/python/unittest/test_generation.py -q
+  # generation-scale gate, standalone: these tests pin spec-vs-plain
+  # greedy BIT-EXACT parity, fork isolation (no KV bleed after the
+  # source prefix evicts), refcount-safe LRU eviction under slot
+  # pressure, EXACT per-feature warmup compile counts with zero
+  # steady-state misses, router prefix-affinity + the autoscale
+  # actuator, and the 1k shared-system-prompt acceptance run — a
+  # prefix-cache, draft, verify-lane or fleet-routing regression fails
+  # HERE, attributed
+  log "generation-scale suite (radix prefix cache + KV forking, speculative decoding, fleet affinity/autoscale)"
+  python -m pytest tests/python/unittest/test_generation_scale.py -q
   # zero1 gate, standalone: these tests flip MXNET_ZERO1/MXNET_ZERO1_NDEV
   # and pin sharding invariance, 1/N state allocation, checkpoint
   # round-trips and exact compile-cache miss counts — a sharded-update

@@ -10,7 +10,11 @@ them. This module is the truth plane:
   registered under one of ``weights`` / ``optimizer_state`` /
   ``gradients`` / ``serving_batches`` / ``kv_cache`` (the generation
   engines' preallocated KV slabs — registered as live-view providers
-  because the slab arrays are REPLACED by every donated decode step);
+  because the slab arrays are REPLACED by every donated decode step;
+  prefix-cache entries and their forked session copies are ROWS of that
+  same slab, so the buffer-pointer dedup below attributes them once, at
+  the slab's allocation size, never double — only a speculative draft
+  model's own slab adds bytes, through its own provider);
   everything else live on the backend (feeds in flight, temporaries the
   GC has not collected) shows up as ``other``. Registration is by WEAK reference — a provider
   (executor, updater, ZeRO-1 context, predictor) that dies drops out of

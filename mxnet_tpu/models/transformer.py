@@ -418,6 +418,89 @@ class TransformerLM:
         logits = (last @ self._head(params).astype(dt)).astype(jnp.float32)
         return logits[0], cache_k, cache_v
 
+    def prefill_at(self, params, cache_k, cache_v, tokens, length, slot,
+                   offset):
+        """Suffix prefill: the prompt's UNMATCHED tail after a prefix-cache
+        fork. The slot's rows ``[0, offset)`` already hold the K/V of the
+        prompt's first ``offset`` tokens (copied slot-to-slot from a cached
+        entry by the fork executable); this forward consumes only the
+        remaining ``length`` tokens, writes their K/V into rows
+        ``[offset, offset + Lb)`` and returns the logits at the last REAL
+        suffix token — so a cache hit pays O(suffix), not O(prompt).
+
+        tokens : int32 [Lb]   suffix padded up to the compile bucket
+        length : int32 scalar real suffix length (1 <= length <= Lb)
+        slot   : int32 scalar slab row (traced)
+        offset : int32 scalar matched-prefix length (traced — ONE
+                              executable per bucket serves every split
+                              point, the compile-once discipline)
+
+        Unlike :meth:`prefill` (whose attention is the Lb x Lb causal
+        block), the suffix block must also attend the cached rows, so each
+        layer scores the suffix queries against the slot's FULL slab row
+        masked to ``j <= offset + i`` — the decode-step mask family, at
+        Lb x slab_len cost. Returns ``(logits [V] fp32, cache_k,
+        cache_v)``. Pure; jit with the cache operands donated.
+        """
+        c = self.cfg
+        dt = jnp.dtype(c.dtype)
+        Lb = tokens.shape[0]
+        L = cache_k.shape[3]
+        hd = c.d_model // c.n_heads
+        scale = 1.0 / np.sqrt(hd)
+        pos = offset + jnp.arange(Lb)
+        h = jnp.take(params["embed"], tokens, axis=0).astype(dt)     # [Lb,D]
+        # jnp.take clips out-of-range positions (pad rows past the model's
+        # positional range read row max_len-1 — garbage the mask hides)
+        h = h + jnp.take(params["pos_embed"], pos, axis=0).astype(dt)
+        # suffix token i attends slab rows j <= offset + i: the cached
+        # prefix plus causal-within-suffix, one mask over the whole row.
+        # Large-negative, not -inf (finite garbage for fully-masked rows)
+        mask = jnp.where(jnp.arange(L)[None, None, :]
+                         <= pos[None, :, None], 0.0, -1e9)     # [1,Lb,L]
+        for i in range(c.n_layers):
+            ln1 = self._ln(h, params[f"l{i}.ln1_scale"],
+                           params[f"l{i}.ln1_bias"])
+            qkv = ln1 @ params[f"l{i}.wqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(Lb, c.n_heads, hd)
+            k = k.reshape(Lb, c.n_heads, hd)
+            v = v.reshape(Lb, c.n_heads, hd)
+            # slab write: [1, 1, H, Lb, hd] block at (slot, layer, 0,
+            # offset, 0) — rows [0, offset) stay the forked prefix
+            cache_k = lax.dynamic_update_slice(
+                cache_k, k.transpose(1, 0, 2)[None, None].astype(cache_k.dtype),
+                (slot, i, 0, offset, 0))
+            cache_v = lax.dynamic_update_slice(
+                cache_v, v.transpose(1, 0, 2)[None, None].astype(cache_v.dtype),
+                (slot, i, 0, offset, 0))
+            ck_i = lax.dynamic_slice(
+                cache_k, (slot, i, 0, 0, 0),
+                (1, 1, c.n_heads, L, hd))[0, 0]                # [H,L,hd]
+            cv_i = lax.dynamic_slice(
+                cache_v, (slot, i, 0, 0, 0),
+                (1, 1, c.n_heads, L, hd))[0, 0]
+            s = jnp.einsum("qhd,hkd->hqk", q, ck_i.astype(dt),
+                           preferred_element_type=jnp.float32) * scale
+            p = jax.nn.softmax(s + mask, axis=-1).astype(dt)
+            attn = jnp.einsum("hqk,hkd->qhd", p,
+                              cv_i.astype(dt)).reshape(Lb, c.d_model)
+            h = h + attn @ params[f"l{i}.wo"]
+            ln2 = self._ln(h, params[f"l{i}.ln2_scale"],
+                           params[f"l{i}.ln2_bias"])
+            if self._is_moe(i):
+                # batch-1 grouped dispatch, as in prefill
+                ff, _ = self._moe_ffn(i, params, ln2[None])
+                h = h + ff[0]
+            else:
+                ff = jax.nn.gelu(ln2 @ params[f"l{i}.w1"]
+                                 + params[f"l{i}.b1"].astype(dt))
+                h = h + ff @ params[f"l{i}.w2"] + params[f"l{i}.b2"].astype(dt)
+        h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
+        last = lax.dynamic_slice_in_dim(h, length - 1, 1, axis=0)    # [1,D]
+        logits = (last @ self._head(params).astype(dt)).astype(jnp.float32)
+        return logits[0], cache_k, cache_v
+
     def decode_step(self, params, cache_k, cache_v, tokens, positions):
         """One fused incremental step over the WHOLE slot slab: each slot
         consumes one token, writes its K/V at ``positions[s]`` and attends
@@ -492,6 +575,41 @@ class TransformerLM:
         h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
         logits = (h @ self._head(params).astype(dt)).astype(jnp.float32)
         return logits, cache_k, cache_v
+
+    def verify_step(self, params, cache_k, cache_v, tokens, positions):
+        """Speculative-decoding verify: advance every slot by ``K = k + 1``
+        tokens in ONE executable. ``tokens[:, 0]`` is each slot's last
+        committed token, ``tokens[:, 1:]`` the draft's k proposals; the
+        returned logits row ``i`` is the model's next-token distribution
+        after consuming ``tokens[:, :i+1]`` — the engine accepts the
+        longest draft prefix whose proposals match the greedy argmaxes and
+        rolls the rest back by NOT advancing ``positions`` past it (the
+        rejected rows beyond the new frontier are never attended and are
+        overwritten sequentially before they could be).
+
+        tokens    : int32 [S, K]  fed block per slot (dead slots: anything)
+        positions : int32 [S]     row the block starts at (== slot length)
+
+        Returns ``(logits [S, K, V] fp32, cache_k, cache_v)``.
+
+        Structure is deliberately K *unrolled* :meth:`decode_step` graphs
+        chained through the slab — NOT a batched K-query attention block.
+        The per-token math is then structurally identical to the
+        non-speculative decode executable, which is what makes speculative
+        greedy output BIT-EXACT with the plain path (a batched
+        formulation reassociates the attention reductions and can flip an
+        argmax by a ulp — the PR 6/8 FMA precedent). On accelerators the
+        unrolled chain still amortizes K dispatches and K HBM round-trips
+        of host scheduling into one program launch, which is where the
+        speculative win lives at decode batch sizes. Pure; jit with the
+        cache operands donated.
+        """
+        steps = []
+        for i in range(tokens.shape[1]):
+            logits, cache_k, cache_v = self.decode_step(
+                params, cache_k, cache_v, tokens[:, i], positions + i)
+            steps.append(logits)
+        return jnp.stack(steps, axis=1), cache_k, cache_v
 
     # -- training -----------------------------------------------------------
 

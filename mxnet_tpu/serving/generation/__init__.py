@@ -15,7 +15,16 @@ serves token-by-token generation, the millions-of-users workload:
   caller-runs assist, plus ``result()`` for collectors; failures
   (deadline, engine error) raise in-band instead of wedging the iterator;
 * :class:`GenerationRouter` — spreads sessions across N engine replicas
-  by live-slot occupancy with queue-full failover.
+  by cached-prefix affinity then live-slot occupancy, with queue-full
+  failover and an autoscale actuator (``scale_to`` / ``bind_autoscale``);
+* :class:`~.prefix_cache.RadixPrefixCache` — refcounted radix trie over
+  prompt tokens whose payloads are KV rows in the engine's slab: shared
+  prefixes prefill once and FORK into sessions (one traced slot-to-slot
+  copy + a suffix-only prefill), ``MXNET_GENERATION_PREFIX_CACHE=1``;
+* :mod:`~.speculative` — draft models (``MXNET_GENERATION_DRAFT``
+  checkpoint or n-gram fallback) for the ``MXNET_GENERATION_SPEC_K``
+  verify lane: k proposed tokens per tick checked by ONE fixed-shape
+  slab-wide executable, greedy output bit-exact with plain decode.
 
 Quick start::
 
@@ -26,9 +35,14 @@ Quick start::
     for tok in stream:                       # tokens as they decode
         ...
 """
+from . import speculative
 from .engine import GenerationEngine, prefill_ladder
+from .prefix_cache import RadixPrefixCache
 from .router import GenerationRouter
 from .session import GenerationStream
+from .speculative import (CheckpointDraft, NgramDraft, load_draft,
+                          save_draft)
 
 __all__ = ["GenerationEngine", "GenerationRouter", "GenerationStream",
-           "prefill_ladder"]
+           "RadixPrefixCache", "NgramDraft", "CheckpointDraft",
+           "save_draft", "load_draft", "prefill_ladder", "speculative"]

@@ -23,17 +23,43 @@ token, O(T²) per sequence). This engine is the token-level scheduler:
   per-session deadlines swept per tick via ``expire()``), prompts pad up
   a prefill-length bucket ladder, and a blocking stream iterator assists
   caller-runs style.
+* **prefix cache + in-slab KV forking** — with
+  ``MXNET_GENERATION_PREFIX_CACHE=1`` a refcounted radix trie
+  (:mod:`.prefix_cache`) maps prompt prefixes to slab slots holding their
+  K/V. Admission of a prompt whose prefix is cached runs ONE traced fork
+  executable (``dynamic_slice`` + ``dynamic_update_slice`` copying the
+  source slot's rows) and prefills only the unmatched suffix
+  (:meth:`TransformerLM.prefill_at`) — a fleet-shared system prompt
+  prefills once, then every later session pays O(suffix). Sessions
+  always outrank cached entries for slots (LRU eviction of refcount-zero
+  entries on admission pressure, journaled through the health ring).
+* **speculative decoding** — with ``MXNET_GENERATION_SPEC_K=k`` a draft
+  (:mod:`.speculative`: ``MXNET_GENERATION_DRAFT`` checkpoint or the
+  n-gram fallback) proposes k tokens per live slot per tick and ONE
+  fixed-shape slab-wide verify executable
+  (:meth:`TransformerLM.verify_step` — k+1 unrolled decode graphs, so
+  greedy output is BIT-EXACT with the plain path) checks them all;
+  the engine commits the longest agreeing draft prefix plus the target's
+  own next token (1 to k+1 tokens per tick) and rolls the rest back by
+  simply not advancing the slot's position — rejected rows beyond the
+  frontier are never attended and are overwritten before they could be.
 * **compile discipline** — one ``CompileCache("generation")`` entry per
-  prefill bucket plus exactly ONE decode executable, all with the slab
-  buffers donated (``persistent=False``: donated programs stay out of the
+  prefill bucket plus exactly ONE decode (or verify) executable — and,
+  per enabled feature, one fork entry, one suffix-prefill entry per
+  bucket and the draft's own pinned set — all with the slab buffers
+  donated (``persistent=False``: donated programs stay out of the
   on-disk XLA cache, the PR 3 aliasing rule). ``serving.warmup`` pins the
   exact count ahead of traffic; steady state compiles nothing.
 
 Telemetry rides ``serving.generation.*`` (live-slot gauge, tokens/s,
 TTFT/tick histograms, per-reason eviction counters, derived
-``slot_fill_ratio``); tracing builds one span tree per session (root →
-queued → prefill → decode ticks → evict); the slab registers under the
-``kv_cache`` memory-census category.
+``slot_fill_ratio``, plus ``prefix.{hits,misses,forks,inserts,
+evictions}``/``prefix.cached_tokens`` and ``spec.{proposed,accepted,
+rolled_back,committed}`` with derived ``spec.acceptance_ratio``); tracing
+builds one span tree per session (root → queued → fork/prefill → decode
+ticks → evict); the slab (and the checkpoint draft's slab) registers
+under the ``kv_cache`` memory-census category — forked rows live inside
+the same slab buffers, so the census never double-counts them.
 """
 from __future__ import annotations
 
@@ -51,6 +77,8 @@ from ...compile_cache import CompileCache
 from ...log import get_logger
 from ..admission import AdmissionQueue, DeadlineExceededError, Request
 from ..health import attach_engine, queue_ready
+from . import speculative
+from .prefix_cache import RadixPrefixCache
 from .session import GenerationStream
 
 __all__ = ["GenerationEngine", "prefill_ladder"]
@@ -71,6 +99,15 @@ register_env("MXNET_GENERATION_TICK_BUDGET_MS", 10.0,
              "max milliseconds one scheduler tick spends admitting queued "
              "prefills before the fused decode runs again (>= 1 admission "
              "per tick when slots are free, so queues always drain)")
+register_env("MXNET_GENERATION_PREFIX_CACHE", False,
+             "cache prompt-prefix KV in free slab slots (refcounted radix "
+             "trie): admission of a prompt with a cached prefix runs one "
+             "traced slot-to-slot fork + a suffix-only prefill instead of "
+             "a full-prompt prefill")
+register_env("MXNET_GENERATION_PREFIX_MIN_TOKENS", 8,
+             "shortest prompt prefix worth forking from (or inserting "
+             "into) the prefix cache — below this a full prefill is "
+             "cheaper than the fork dispatch")
 
 
 def prefill_ladder(buckets, max_len):
@@ -97,7 +134,7 @@ class _Session:
     """Engine-side state of one admitted (or queued) generation."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline", "stream",
-                 "span", "slot", "generated")
+                 "span", "slot", "generated", "prefix_len")
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline, stream):
         self.prompt = prompt            # np.int32 [n]
@@ -108,6 +145,7 @@ class _Session:
         self.span = None                # tracing root (MXNET_TRACING=1)
         self.slot = None
         self.generated = 0
+        self.prefix_len = 0             # cached tokens forked at admission
 
 
 class GenerationEngine:
@@ -129,18 +167,46 @@ class GenerationEngine:
     start : bool
         Spin the scheduler worker thread (tests drive ticks manually with
         ``False``).
+    prefix_cache / prefix_min_tokens :
+        Overrides of ``MXNET_GENERATION_PREFIX_CACHE`` /
+        ``_PREFIX_MIN_TOKENS`` — cache prompt-prefix KV in free slab
+        slots and admit matching prompts via fork + suffix prefill.
+    spec_k : int, optional
+        Override of ``MXNET_GENERATION_SPEC_K`` — draft length for the
+        speculative verify lane (0 = plain one-token decode). The slab
+        grows ``spec_k`` scratch rows so a near-capacity slot's verify
+        writes stay in bounds, which costs ``spec_k`` positions of the
+        model's range: ``max_len`` is clamped to ``cfg.max_len - spec_k``.
+    draft : Draft, optional
+        The draft model for the speculative lane (default: a
+        ``CheckpointDraft`` from ``MXNET_GENERATION_DRAFT``, else the
+        n-gram fallback).
     """
 
     def __init__(self, model, params, max_slots=None, max_len=None,
                  buckets=None, max_queue=None, tick_budget_ms=None,
-                 eos_id=None, start=True):
+                 eos_id=None, start=True, prefix_cache=None,
+                 prefix_min_tokens=None, spec_k=None, draft=None):
         self._model = model
         self._params = params
         self._slots = int(getenv("MXNET_GENERATION_SLOTS")
                           if max_slots is None else max_slots)
+        self._spec_k = int(getenv("MXNET_GENERATION_SPEC_K")
+                           if spec_k is None else spec_k)
+        if self._spec_k < 0:
+            raise MXNetError(f"spec_k must be >= 0, got {self._spec_k}")
         self._max_len = int(getenv("MXNET_GENERATION_MAX_LEN")
                             if max_len is None else max_len)
-        self._max_len = min(self._max_len, model.cfg.max_len)
+        self._max_len = min(self._max_len, model.cfg.max_len - self._spec_k)
+        if self._max_len < 2:
+            raise MXNetError(
+                f"max_len {self._max_len} after reserving {self._spec_k} "
+                f"speculative scratch rows from the model's positional "
+                f"range {model.cfg.max_len} — lower MXNET_GENERATION_SPEC_K")
+        # the slab carries spec_k scratch rows past session capacity: a
+        # verify block starting at the last legal position writes k rows
+        # past it, and those writes must land somewhere no session owns
+        self._slab_len = self._max_len + self._spec_k
         if self._slots < 1:
             raise MXNetError(f"need >= 1 slot, got {self._slots}")
         self._buckets = prefill_ladder(buckets, self._max_len)
@@ -151,7 +217,7 @@ class GenerationEngine:
         self._logger = get_logger("mxnet_tpu.serving.generation")
 
         self._cache = CompileCache("generation")
-        self._ck, self._cv = model.init_cache(self._slots, self._max_len)
+        self._ck, self._cv = model.init_cache(self._slots, self._slab_len)
         # host-side slot metadata — only the tick loop (under _tick_lock)
         # mutates these
         self._sessions = [None] * self._slots
@@ -175,6 +241,32 @@ class GenerationEngine:
         self._warmed = False          # set by warm(); ready() also
         #                               accepts traffic-compiled engines
         self.health_name, self._beacon = attach_engine(self)
+
+        use_prefix = (bool(getenv("MXNET_GENERATION_PREFIX_CACHE"))
+                      if prefix_cache is None else bool(prefix_cache))
+        if use_prefix and getattr(model.cfg, "moe_experts", 0) > 0:
+            # MoE expert capacity is computed over the forward's input
+            # length, so a suffix-only prefill can capacity-drop
+            # DIFFERENT tokens than the full-prompt prefill would — the
+            # fork path would then diverge beyond the documented ulp
+            # level depending on what the cache happened to hold. Until
+            # prefill_at routes with full-prompt capacity semantics the
+            # cache stays off for MoE models
+            self._logger.warning(
+                "prefix cache disabled: MoE capacity is length-dependent"
+                " and a suffix prefill would route differently than the"
+                " full prefill")
+            use_prefix = False
+        self._prefix_min = int(
+            getenv("MXNET_GENERATION_PREFIX_MIN_TOKENS")
+            if prefix_min_tokens is None else prefix_min_tokens)
+        self._prefix = (RadixPrefixCache(owner=self.health_name)
+                        if use_prefix else None)
+        self._draft = None
+        if self._spec_k:
+            self._draft = (speculative.default_draft(model.mesh)
+                           if draft is None else draft)
+            self._draft.attach(self)
 
         # the slab is device state the engine REPLACES every tick, so the
         # census needs a live view, not a snapshot weakref
@@ -201,6 +293,30 @@ class GenerationEngine:
     @property
     def prefill_buckets(self):
         return self._buckets
+
+    @property
+    def spec_k(self):
+        """Draft length of the speculative lane (0 = plain decode)."""
+        return self._spec_k
+
+    @property
+    def draft(self):
+        return self._draft
+
+    @property
+    def prefix_cache(self):
+        """The engine's :class:`RadixPrefixCache` (None when disabled)."""
+        return self._prefix
+
+    def prefix_match_len(self, prompt):
+        """Longest USABLE cached prefix of ``prompt`` on this engine (0
+        when below the fork threshold or the cache is off) — the router's
+        affinity probe; cheap host trie walk, no device work."""
+        if self._prefix is None:
+            return 0
+        m = self._prefix.match_len(
+            np.asarray(prompt, dtype=np.int32).reshape(-1))
+        return m if m >= self._prefix_min else 0
 
     @property
     def cache(self):
@@ -325,13 +441,18 @@ class GenerationEngine:
         return list(self.submit(prompt, **kwargs))
 
     def warm(self, buckets=None):
-        """Compile-ahead every generation executable: one prefill program
-        per bucket plus THE decode program, counted exactly
-        (``cache.misses`` delta). Prefill warms write garbage into a FREE
+        """Compile-ahead every generation executable the enabled features
+        will run, counted exactly (``cache.misses`` delta): one prefill
+        program per bucket, plus — prefix cache on — one suffix-prefill
+        program per bucket and THE fork program, plus THE decode program
+        (plain) or THE verify program and the draft's own pinned set
+        (speculative). Prefill/suffix warms write garbage into a FREE
         slot (skipped, with a log, for buckets that cannot get one on an
-        already-full slab — they were compiled by real traffic anyway) and
-        the decode warm runs only while no session is live, so warming a
-        serving engine never perturbs a session. Returns
+        already-full slab — they were compiled by real traffic anyway);
+        the decode/verify warm runs only while no session is live, and
+        its garbage K/V writes are steered to the slab's last row
+        (:meth:`_tick_positions`), so warming a serving engine never
+        perturbs a session or a cached prefix entry. Returns
         ``{"buckets", "compiles", "seconds", "cache_entries"}``."""
         import jax.numpy as jnp
 
@@ -340,8 +461,8 @@ class GenerationEngine:
         t0 = time.perf_counter()
         misses0 = self._cache.misses
         with self._tick_lock:
-            free = next((i for i, s in enumerate(self._sessions)
-                         if s is None), None)
+            free_list = self._free_slots()
+            free = free_list[0] if free_list else None
             for b in buckets:
                 if b not in self._buckets:
                     raise MXNetError(f"bucket {b} not in ladder "
@@ -356,11 +477,40 @@ class GenerationEngine:
                     self._params, self._ck, self._cv,
                     jnp.zeros((b,), jnp.int32), jnp.asarray(1, jnp.int32),
                     jnp.asarray(free, jnp.int32))
-            if self._live == 0:
+                if self._prefix is not None:
+                    fn = self._suffix_prefill_fn(b)
+                    _, self._ck, self._cv = fn(
+                        self._params, self._ck, self._cv,
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.asarray(1, jnp.int32),
+                        jnp.asarray(free, jnp.int32),
+                        jnp.asarray(0, jnp.int32))
+            if self._prefix is not None and free is not None:
+                # self-copy: compiles the fork without disturbing anything
+                fn = self._fork_fn()
+                self._ck, self._cv = fn(self._ck, self._cv,
+                                        jnp.asarray(free, jnp.int32),
+                                        jnp.asarray(free, jnp.int32))
+            idle = self._live == 0
+            if self._spec_k:
+                if idle:
+                    fn = self._verify_fn()
+                    _, self._ck, self._cv = fn(
+                        self._params, self._ck, self._cv,
+                        jnp.zeros((self._slots, self._spec_k + 1),
+                                  jnp.int32),
+                        jnp.asarray(self._tick_positions()))
+                    self._draft.warm()
+                else:
+                    self._logger.warning(
+                        "generation warmup: engine busy, skipping "
+                        "verify/draft warm")
+            elif idle:
                 fn = self._decode_fn()
                 _, self._ck, self._cv = fn(
                     self._params, self._ck, self._cv,
-                    jnp.asarray(self._last_tok), jnp.asarray(self._lengths))
+                    jnp.asarray(self._last_tok),
+                    jnp.asarray(self._tick_positions()))
         compiles = self._cache.misses - misses0
         seconds = time.perf_counter() - t0
         self._warmed = True           # readiness: warmup complete
@@ -368,8 +518,9 @@ class GenerationEngine:
             telemetry.counter("serving.generation.warmup_compiles").inc(
                 compiles)
         self._logger.info(
-            "generation warmup: %d bucket(s) + decode -> %d compile(s) in "
-            "%.2fs (cache %r holds %d executables)", len(buckets), compiles,
+            "generation warmup: %d bucket(s) + %s -> %d compile(s) in "
+            "%.2fs (cache %r holds %d executables)", len(buckets),
+            "verify" if self._spec_k else "decode", compiles,
             seconds, self._cache.name, len(self._cache))
         return {"buckets": list(buckets), "compiles": compiles,
                 "seconds": seconds, "cache_entries": len(self._cache)}
@@ -396,13 +547,19 @@ class GenerationEngine:
         return False
 
     def stats(self):
-        return {"cache": self._cache.snapshot(),
-                "buckets": list(self._buckets),
-                "slots": self._slots, "live": self._live,
-                "queued": len(self._queue),
-                "sessions": self.sessions_submitted,
-                "max_len": self._max_len,
-                "kv_slab_bytes": self.kv_slab_bytes()}
+        out = {"cache": self._cache.snapshot(),
+               "buckets": list(self._buckets),
+               "slots": self._slots, "live": self._live,
+               "queued": len(self._queue),
+               "sessions": self.sessions_submitted,
+               "max_len": self._max_len,
+               "spec_k": self._spec_k,
+               "kv_slab_bytes": self.kv_slab_bytes()}
+        if self._prefix is not None:
+            out["prefix"] = self._prefix.stats()
+        if self._draft is not None and hasattr(self._draft, "slab_bytes"):
+            out["draft_slab_bytes"] = self._draft.slab_bytes()
+        return out
 
     # -- compiled programs ---------------------------------------------------
 
@@ -422,7 +579,7 @@ class GenerationEngine:
 
             return jax.jit(fn, donate_argnums=(1, 2))
 
-        key = ("prefill", bucket, self._slots, self._max_len)
+        key = ("prefill", bucket, self._slots, self._slab_len)
         return cache.get_or_build(key, build, persistent=False)
 
     def _decode_fn(self):
@@ -442,7 +599,73 @@ class GenerationEngine:
 
             return jax.jit(fn, donate_argnums=(1, 2))
 
-        key = ("decode", self._slots, self._max_len)
+        key = ("decode", self._slots, self._slab_len)
+        return cache.get_or_build(key, build, persistent=False)
+
+    def _fork_fn(self):
+        """THE prefix-fork executable: copy one slot's slab rows (both K
+        and V, all layers) onto another slot, src/dst traced — one
+        program serves every (cached entry, session slot) pair. Slab
+        donated; a cache hit costs one dispatch plus the suffix prefill."""
+        cache = self._cache
+
+        def build():
+            import jax
+            from jax import lax
+
+            def fn(ck, cv, src, dst):
+                rk = lax.dynamic_slice(ck, (src, 0, 0, 0, 0),
+                                       (1,) + ck.shape[1:])
+                rv = lax.dynamic_slice(cv, (src, 0, 0, 0, 0),
+                                       (1,) + cv.shape[1:])
+                return (lax.dynamic_update_slice(ck, rk, (dst, 0, 0, 0, 0)),
+                        lax.dynamic_update_slice(cv, rv, (dst, 0, 0, 0, 0)))
+
+            return jax.jit(fn, donate_argnums=(0, 1))
+
+        key = ("fork", self._slots, self._slab_len)
+        return cache.get_or_build(key, build, persistent=False)
+
+    def _suffix_prefill_fn(self, bucket):
+        """The bucket's suffix-prefill executable: the prompt tail after
+        a fork, writing rows [offset, offset+bucket) and attending the
+        forked prefix — offset traced, one program per bucket."""
+        model, cache = self._model, self._cache
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            def fn(params, ck, cv, toks, length, slot, offset):
+                logits, ck, cv = model.prefill_at(params, ck, cv, toks,
+                                                  length, slot, offset)
+                return jnp.argmax(logits).astype(jnp.int32), ck, cv
+
+            return jax.jit(fn, donate_argnums=(1, 2))
+
+        key = ("suffix_prefill", bucket, self._slots, self._slab_len)
+        return cache.get_or_build(key, build, persistent=False)
+
+    def _verify_fn(self):
+        """THE speculative verify executable — k+1 unrolled decode graphs
+        over the whole slab in one program (greedy argmax per position
+        inside), slab donated. Like the decode key, it never changes:
+        every draft/accept pattern is a hit."""
+        model, cache = self._model, self._cache
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            def fn(params, ck, cv, tokens, positions):
+                logits, ck, cv = model.verify_step(params, ck, cv, tokens,
+                                                   positions)
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        ck, cv)
+
+            return jax.jit(fn, donate_argnums=(1, 2))
+
+        key = ("verify", self._spec_k, self._slots, self._slab_len)
         return cache.get_or_build(key, build, persistent=False)
 
     # -- scheduler -----------------------------------------------------------
@@ -514,7 +737,22 @@ class GenerationEngine:
                         self._evict(slot, "error", e)
                 # the failed executable may have consumed the donated slab
                 self._ck, self._cv = self._model.init_cache(self._slots,
-                                                            self._max_len)
+                                                            self._slab_len)
+                if self._prefix is not None:
+                    # the cached rows died with the donated buffers
+                    self._prefix.clear("slab_reset")
+                if self._draft is not None:
+                    self._draft.reset()
+        if self._has_work():
+            # close an assist-vs-worker race: an assist tick pops the
+            # queue BEFORE publishing the session as live, and a parked
+            # worker re-checking _has_work() inside that window goes back
+            # to sleep with nobody left to wake it once the assisting
+            # client stops iterating (e.g. takes its first token, then
+            # blocks in result()). Any tick that leaves work pending
+            # re-notifies, so the worker always resumes the schedule.
+            with self._work:
+                self._work.notify_all()
         if tracing._enabled:
             tracing.tick_recorder.observe(tick_span.tree())
         if health._enabled:
@@ -543,13 +781,62 @@ class GenerationEngine:
                 self._tokens_window = 0
                 self._rate_t0 = now
 
+    def _free_slots(self):
+        """Slots holding neither a live session nor a cached prefix."""
+        held = self._prefix.slots() if self._prefix is not None else ()
+        return [i for i, s in enumerate(self._sessions)
+                if s is None and i not in held]
+
+    def _tick_positions(self):
+        """Write positions for the fixed-shape decode/verify executables:
+        a live slot's length, and the slab's LAST row for every other
+        slot. Dead and — critically — CACHE-HELD slots still get a K/V
+        row written every tick (the fixed shape computes all slots); row
+        0 would silently corrupt a cached prefix entry's first tokens,
+        so the garbage is steered to row ``slab_len - 1``, which no
+        entry can own (a cached prompt is at most ``max_len - 1`` tokens
+        — submit requires >= 1 generated token — and the speculative
+        slab adds scratch rows past that). A verify block's clamped
+        writes pile onto the same last row, equally harmless."""
+        pos = self._lengths.copy()
+        safe = self._slab_len - 1
+        for i, s in enumerate(self._sessions):
+            if s is None:
+                pos[i] = safe
+        return pos
+
+    def _prefix_claimable(self):
+        """Cache entries session pressure may evict: everything above the
+        retention floor. The floor (one entry, zero on a single-slot
+        engine) keeps the hottest prefix alive through full occupancy —
+        without it a saturated slab would evict the shared system prompt
+        and every later admission would cold-miss, exactly the fleet
+        pathology the cache exists to prevent."""
+        if self._prefix is None:
+            return 0
+        keep = min(1, max(self._slots - 1, 0))
+        return max(len(self._prefix) - keep, 0)
+
+    def _claim_slot(self, free):
+        """Pop a slot for a session: from the free list, else by evicting
+        the LRU refcount-zero prefix entry above the retention floor —
+        live sessions outrank cached prefixes. None when the slab is
+        truly full."""
+        if free:
+            return free.pop(0)
+        if self._prefix_claimable() and len(self._queue):
+            return self._prefix.evict_lru("slot_pressure")
+        return None
+
     def _admit(self):
         """Move queued sessions into free slots (prefill), oldest first,
         until the slab is full, the queue is empty, or the tick budget is
-        spent — at least one admission per tick when a slot is free, so
-        backlog always drains even under a tiny budget."""
-        free = [i for i, s in enumerate(self._sessions) if s is None]
-        if not free:
+        spent — at least one admission per tick when a slot is free (or
+        freeable by evicting a cached prefix), so backlog always drains
+        even under a tiny budget."""
+        free = self._free_slots()
+        if not free and not (self._prefix_claimable()
+                             and len(self._queue)):
             return
         t0 = time.perf_counter()
         tele = telemetry._enabled
@@ -560,20 +847,36 @@ class GenerationEngine:
     def _admit_into(self, free, t0, tele):
         import jax.numpy as jnp
 
-        while free:
+        while True:
+            slot = self._claim_slot(free)
+            if slot is None:
+                return
             batch, _ = self._queue.get_batch_nowait(1)
             if not batch:
+                free.append(slot)
                 return
             sess = batch[0].payload
             now = time.monotonic()
             if sess.deadline is not None and now >= sess.deadline:
                 self._fail_queued(sess, now)
+                free.append(slot)
                 continue
-            slot = free.pop(0)
             n = int(sess.prompt.size)
-            bucket = self.bucket_for(n)
-            padded = np.zeros(bucket, np.int32)
-            padded[:n] = sess.prompt
+            # prefix-cache lane: fork the longest usable cached prefix
+            # slot-to-slot, then prefill only the unmatched suffix
+            node = None
+            if self._prefix is not None:
+                node, m = self._prefix.match(sess.prompt)
+                if node is None or m < self._prefix_min:
+                    node = None
+                elif m + self.bucket_for(n - m) > self._slab_len:
+                    # the suffix BUCKET (not just the suffix) must fit
+                    # past the split point — dynamic_update_slice CLAMPS
+                    # an overhanging block start, which would smear the
+                    # padded suffix over the forked prefix rows. Near-
+                    # capacity prompts fall back to the always-in-bounds
+                    # full prefill instead
+                    node = None
             t_pf = time.perf_counter()
             trc = tracing._enabled and sess.span is not None
             if trc:
@@ -582,11 +885,22 @@ class GenerationEngine:
                                   tracing.now_us() - sess.span.t0,
                                   cat="generation", parent=sess.span)
                 t_pf_us = tracing.now_us()
-            fn = self._prefill_fn(bucket)
             try:
-                tok, self._ck, self._cv = fn(
-                    self._params, self._ck, self._cv, jnp.asarray(padded),
-                    jnp.asarray(n, jnp.int32), jnp.asarray(slot, jnp.int32))
+                if node is not None:
+                    tok = self._fork_admit(sess, slot, node, m)
+                else:
+                    bucket = self.bucket_for(n)
+                    padded = np.zeros(bucket, np.int32)
+                    padded[:n] = sess.prompt
+                    fn = self._prefill_fn(bucket)
+                    tok, self._ck, self._cv = fn(
+                        self._params, self._ck, self._cv,
+                        jnp.asarray(padded), jnp.asarray(n, jnp.int32),
+                        jnp.asarray(slot, jnp.int32))
+                    tok = int(tok)
+                    if tele and self._prefix is not None:
+                        telemetry.counter(
+                            "serving.generation.prefix.misses").inc()
             except Exception as e:
                 # the popped session is in neither the queue nor a slot —
                 # the tick handler only evicts ADMITTED sessions, so fail
@@ -599,22 +913,37 @@ class GenerationEngine:
                 if sess.span is not None:
                     sess.span.set(error=repr(e), reason="error").finish()
                 raise
-            tok = int(tok)
             if trc:
                 tracing.emit_span("generation.prefill", t_pf_us,
                                   tracing.now_us() - t_pf_us,
                                   cat="generation", parent=sess.span,
-                                  bucket=bucket, slot=slot)
+                                  bucket=self.bucket_for(n - sess.prefix_len),
+                                  slot=slot, cached_prefix=sess.prefix_len)
             sess.slot = slot
             self._sessions[slot] = sess
             self._lengths[slot] = n
             self._last_tok[slot] = tok
             self._live += 1
+            if self._draft is not None:
+                self._draft.on_admit(slot, sess.prompt, tok)
             self._deliver(sess, tok, first=True)
             if tele:
                 telemetry.counter("serving.generation.prefills").inc()
                 telemetry.histogram("serving.generation.prefill_us").record(
                     (time.perf_counter() - t_pf) * 1e6)
+            # cache the full prompt's KV for future sessions while a free
+            # slot exists (never evict FOR an insert: only live sessions
+            # force evictions) — the slot's rows [0, n) are exactly the
+            # prompt's K/V right after prefill, so one fork snapshots them
+            if (self._prefix is not None and n >= self._prefix_min
+                    and free):
+                cslot = free[0]
+                if self._prefix.insert(sess.prompt, cslot) is not None:
+                    free.pop(0)
+                    fn = self._fork_fn()
+                    self._ck, self._cv = fn(
+                        self._ck, self._cv, jnp.asarray(slot, jnp.int32),
+                        jnp.asarray(cslot, jnp.int32))
             # the prompt's last token may already end the session; a slot
             # freed that way goes straight back on the free list so a
             # burst of first-token-EOS sessions drains within the tick
@@ -624,21 +953,58 @@ class GenerationEngine:
             if time.perf_counter() - t0 > self._tick_budget_s:
                 return
 
+    def _fork_admit(self, sess, slot, node, m):
+        """Cache-hit admission: pin the entry, fork its slot onto the
+        session's, suffix-prefill the unmatched tail at offset ``m``.
+        Returns the first sampled token."""
+        import jax.numpy as jnp
+
+        suffix = sess.prompt[m:]
+        ns = int(suffix.size)
+        bucket = self.bucket_for(ns)
+        padded = np.zeros(bucket, np.int32)
+        padded[:ns] = suffix
+        self._prefix.acquire(node)
+        try:
+            fk = self._fork_fn()
+            self._ck, self._cv = fk(self._ck, self._cv,
+                                    jnp.asarray(node.slot, jnp.int32),
+                                    jnp.asarray(slot, jnp.int32))
+            fn = self._suffix_prefill_fn(bucket)
+            tok, self._ck, self._cv = fn(
+                self._params, self._ck, self._cv, jnp.asarray(padded),
+                jnp.asarray(ns, jnp.int32), jnp.asarray(slot, jnp.int32),
+                jnp.asarray(m, jnp.int32))
+        finally:
+            self._prefix.release(node)
+        sess.prefix_len = m
+        sess.stream.cached_prefix_len = m
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.prefix.hits").inc()
+            telemetry.counter("serving.generation.prefix.forks").inc()
+            telemetry.counter(
+                "serving.generation.prefix.cached_tokens_served").inc(m)
+        return int(tok)
+
     def _decode(self):
-        """ONE fused decode step over the whole slab; every live session
-        advances one token. Dead slots ride along as masked garbage —
-        that fixed shape is exactly what makes mid-stream admit/evict
-        free."""
+        """ONE fused step over the whole slab; every live session
+        advances one token (plain) or up to ``spec_k + 1`` (speculative
+        verify). Dead slots ride along as masked garbage — that fixed
+        shape is exactly what makes mid-stream admit/evict free."""
         import jax.numpy as jnp
 
         if self._live == 0:
+            return
+        if self._spec_k:
+            self._spec_decode()
             return
         fn = self._decode_fn()
         with tracing.span("generation.decode", cat="generation",
                           live=self._live):
             toks, self._ck, self._cv = fn(
                 self._params, self._ck, self._cv,
-                jnp.asarray(self._last_tok), jnp.asarray(self._lengths))
+                jnp.asarray(self._last_tok),
+                jnp.asarray(self._tick_positions()))
             toks = np.asarray(toks)
         trc = tracing._enabled
         if trc:
@@ -663,6 +1029,85 @@ class GenerationEngine:
             telemetry.counter("serving.generation.tick_slots").inc(
                 self._slots)
 
+    def _spec_decode(self):
+        """The speculative verify tick: draft proposes k tokens per live
+        slot, ONE verify executable checks all of them, each slot commits
+        the longest agreeing draft prefix plus the target's next token
+        (1..k+1 tokens) and rolls the rest back by NOT advancing its
+        position past the last commit — the rejected rows beyond the new
+        frontier are never attended and the next tick overwrites them in
+        order before they could be."""
+        import jax.numpy as jnp
+
+        k = self._spec_k
+        props = np.asarray(
+            self._draft.propose(k, self._sessions), np.int32)   # [S, k]
+        tokens = np.concatenate([self._last_tok[:, None], props], axis=1)
+        fn = self._verify_fn()
+        with tracing.span("generation.verify", cat="generation",
+                          live=self._live, k=k):
+            toks, self._ck, self._cv = fn(
+                self._params, self._ck, self._cv, jnp.asarray(tokens),
+                jnp.asarray(self._tick_positions()))
+            toks = np.asarray(toks)                             # [S, k+1]
+        tele = telemetry._enabled
+        trc = tracing._enabled
+        if trc:
+            t_us = tracing.now_us()
+        live = accepted = committed_total = 0
+        for slot, sess in enumerate(self._sessions):
+            if sess is None:
+                continue
+            live += 1
+            t = toks[slot]
+            d = props[slot]
+            a = 0
+            while a < k and d[a] == t[a]:
+                a += 1
+            committed = []
+            for j in range(a + 1):
+                # same bookkeeping as one plain decode step: the token we
+                # fed at position lengths[slot] is now in the slab, t[j]
+                # is the sampled-but-not-yet-fed continuation
+                self._lengths[slot] += 1
+                tok = int(t[j])
+                self._last_tok[slot] = tok
+                committed.append(tok)
+                self._deliver(sess, tok)
+                self._maybe_finish(slot)
+                if self._sessions[slot] is None:
+                    break
+            if trc and sess.span is not None:
+                tracing.emit_span("generation.decode_tick", t_us, 0.0,
+                                  cat="generation", parent=sess.span,
+                                  position=int(self._lengths[slot]),
+                                  committed=len(committed), accepted=a)
+            if self._sessions[slot] is not None and self._draft is not None:
+                self._draft.on_commit(slot, committed)
+            # accepted = draft proposals that actually became committed
+            # tokens. On a full commit that is `a` (the bonus token is
+            # not a draft); when the loop broke early on a terminal
+            # state every committed token so far WAS a matching draft —
+            # counting the unreachable tail of `a` would inflate the
+            # acceptance_ratio operators tune k against
+            accepted += min(len(committed), a)
+            committed_total += len(committed)
+        if tele:
+            telemetry.counter("serving.generation.decode_tokens").inc(live)
+            telemetry.counter("serving.generation.tick_slots").inc(
+                self._slots)
+            telemetry.counter("serving.generation.spec.ticks").inc()
+            telemetry.counter("serving.generation.spec.verified_slots").inc(
+                live)
+            telemetry.counter("serving.generation.spec.proposed").inc(
+                live * k)
+            telemetry.counter("serving.generation.spec.accepted").inc(
+                accepted)
+            telemetry.counter("serving.generation.spec.rolled_back").inc(
+                live * k - accepted)
+            telemetry.counter("serving.generation.spec.committed").inc(
+                committed_total)
+
     # -- delivery / eviction -------------------------------------------------
 
     def _deliver(self, sess, tok, first=False):
@@ -672,8 +1117,14 @@ class GenerationEngine:
         if telemetry._enabled:
             telemetry.counter("serving.generation.tokens").inc()
             if first:
+                ttft = (time.monotonic() - sess.stream.submitted_at) * 1e6
                 telemetry.histogram("serving.generation.ttft_us").record(
-                    (time.monotonic() - sess.stream.submitted_at) * 1e6)
+                    ttft)
+                if sess.prefix_len:
+                    # hit-path TTFT separately: the fork+suffix admission
+                    # vs the full-prefill population above
+                    telemetry.histogram(
+                        "serving.generation.prefix.ttft_us").record(ttft)
 
     def _maybe_finish(self, slot):
         """Evict the slot if its session just reached a terminal state."""
@@ -695,6 +1146,8 @@ class GenerationEngine:
         self._lengths[slot] = 0
         self._last_tok[slot] = 0
         self._live -= 1
+        if self._draft is not None:
+            self._draft.on_evict(slot)
         if telemetry._enabled:
             telemetry.counter("serving.generation.evictions").inc()
             telemetry.counter(f"serving.generation.evict_{reason}").inc()

@@ -1,14 +1,27 @@
-"""GenerationRouter — spread sessions across engine replicas by occupancy.
+"""GenerationRouter — spread sessions across engine replicas by prefix
+affinity and occupancy, and actuate the autoscale signal.
 
 One :class:`~mxnet_tpu.serving.generation.engine.GenerationEngine` is one
 model replica with one KV slab; scale-out is N of them behind this router.
-Placement is LOAD-AWARE, not round-robin: each submit goes to the replica
-with the lowest ``(live slots + queued sessions) / max_slots`` — queued
-sessions count so that a burst doesn't pile onto one replica before its
-prefills land — with a rotating tie-break so equal-load replicas (an idle
-fleet) still share evenly. A replica rejecting with ``QueueFullError``
-fails over to the next-least-loaded one; only when EVERY replica is full
-does the caller see backpressure.
+Placement is decided in two tiers:
+
+* **prefix affinity** — each replica's
+  :meth:`~GenerationEngine.prefix_match_len` reports how many of the
+  prompt's tokens its radix prefix cache could fork (a cheap host trie
+  walk, no device work); the router places the session on the replica
+  with the LONGEST usable match. Without this a fleet cold-misses a
+  shared system prompt N-1 times: every replica would pay its own full
+  prefill for a prefix some other replica already cached. Affinity
+  placements are journaled (``router_affinity`` health events) and
+  counted (``serving.generation.routed_affinity``).
+* **load** — no usable match anywhere: the replica with the lowest
+  ``(live slots + queued sessions) / max_slots`` wins (queued sessions
+  count so a burst doesn't pile onto one replica before its prefills
+  land), with a rotating tie-break so an idle fleet still shares evenly.
+
+A replica rejecting with ``QueueFullError`` fails over to the next
+candidate; only when EVERY replica is full does the caller see
+backpressure.
 
 Under ``MXNET_HEALTH=1`` placement also consults per-engine READINESS
 (:meth:`GenerationEngine.ready`): an unready replica — wedged scheduler
@@ -19,98 +32,155 @@ moment the probe passes again. Transitions land in the health event
 journal (``engine_drain`` / ``engine_undrain``) and the
 ``health.ready_engines`` gauge. A fleet with NO ready replica falls back
 to load-order over all of them (availability over strictness — the
-engines' own backpressure still bounds the damage). The router also
-registers itself as an autoscale source
-(:func:`mxnet_tpu.health.register_fleet`), feeding the
-``health.desired_engines`` gauge.
+engines' own backpressure still bounds the damage).
+
+**Autoscale actuator** — the router registers as an autoscale source
+(:func:`mxnet_tpu.health.register_fleet`, feeding the
+``health.desired_engines`` gauge), and with an engine ``factory`` it can
+also ACT on the signal: :meth:`scale_to` constructs (and warms) new
+replicas or drains surplus ones (close in a background thread — live
+sessions finish, zero drops), and :meth:`bind_autoscale` wires
+:func:`mxnet_tpu.health.on_autoscale` straight to it, closing PR 11's
+"signal with no actuator" gap for single-host fleets.
 """
 from __future__ import annotations
 
 import itertools
+import threading
+import weakref
 
 from ... import health
 from ... import telemetry
 from ...base import MXNetError
-from ..admission import QueueFullError
+from ..admission import QueueFullError, ServerClosedError
 
 __all__ = ["GenerationRouter"]
 
 
 class GenerationRouter:
-    """Occupancy-balancing front end over N generation engines."""
+    """Affinity- and occupancy-balancing front end over N generation
+    engines.
 
-    def __init__(self, engines):
+    Parameters
+    ----------
+    engines : list[GenerationEngine]
+        The initial fleet (>= 1 replica).
+    factory : callable, optional
+        Zero-arg constructor for one new engine — required for
+        :meth:`scale_to` growth / :meth:`bind_autoscale`.
+    min_engines / max_engines : int, optional
+        Clamp for :meth:`scale_to` (defaults: 1 / no upper bound).
+    """
+
+    def __init__(self, engines, factory=None, min_engines=1,
+                 max_engines=None):
         engines = list(engines)
         if not engines:
             raise MXNetError("GenerationRouter needs >= 1 engine")
         self._engines = engines
+        self._factory = factory
+        self._min = max(int(min_engines), 1)
+        self._max = None if max_engines is None else int(max_engines)
         self._rr = itertools.count()
-        self._ready_state = {}      # engine index -> last readiness bool
+        self._lock = threading.Lock()       # engine-list mutation
+        self._scale_lock = threading.Lock()  # serializes scale_to calls
+        self._ready_state = {}      # engine health_name -> last ready bool
         self._all_unready = False
+        self._draining = []         # (engine, closer thread) during shrink
+        self._closed = False
         health.register_fleet(self)
 
     @property
     def engines(self):
-        return list(self._engines)
+        with self._lock:
+            return list(self._engines)
 
     def loads(self):
         """Per-replica occupancy, the placement signal."""
-        return [e.load for e in self._engines]
+        return [e.load for e in self.engines]
 
-    def _ready_indices(self):
+    def _ready_indices(self, engines):
         """Readiness sweep (health gate on): the engine indices placement
         may use, with drain/undrain transitions journaled. Falls back to
         ALL indices when nothing is ready."""
         ready = []
-        for i, eng in enumerate(self._engines):
+        for i, eng in enumerate(engines):
             ok, reason = eng.ready()
-            prev = self._ready_state.get(i)
+            key = eng.health_name
+            prev = self._ready_state.get(key)
             # journal the transition — including a first sweep that finds
             # the engine already unready (a wedge that predates traffic)
             if prev != ok and not (prev is None and ok):
                 kind = "engine_undrain" if ok else "engine_drain"
-                health.event(kind, engine=eng.health_name, index=i,
-                             reason=reason)
+                health.event(kind, engine=key, index=i, reason=reason)
                 telemetry.counter(
                     "health.undrains" if ok else "health.drains").inc()
-            self._ready_state[i] = ok
+            self._ready_state[key] = ok
             if ok:
                 ready.append(i)
+        # prune state for drained replicas — under autoscale churn every
+        # grow cycle mints a fresh engine name, and an unpruned dict
+        # grows for the life of the server
+        live = {e.health_name for e in engines}
+        for key in [k for k in self._ready_state if k not in live]:
+            del self._ready_state[key]
         telemetry.gauge("health.ready_engines").set(len(ready))
         if not ready:
             # availability over strictness: an all-unready fleet still
             # places by load (engines' own backpressure bounds the harm)
             if not self._all_unready:
                 self._all_unready = True
-                health.event("fleet_all_unready",
-                             engines=len(self._engines))
-            return list(range(len(self._engines)))
+                health.event("fleet_all_unready", engines=len(engines))
+            return list(range(len(engines)))
         self._all_unready = False
         return ready
 
     def submit(self, prompt, **kwargs):
-        """Place one session on the least-loaded READY replica (rotating
-        tie-break; every replica when health is off or none is ready);
-        fail over across replicas on ``QueueFullError`` and re-raise it
-        only when every candidate is saturated."""
-        n = len(self._engines)
+        """Place one session: longest cached prompt prefix first, then
+        least-loaded (rotating tie-break; READY replicas only when health
+        is on and any is ready); fail over across replicas on
+        ``QueueFullError`` and re-raise it only when every candidate is
+        saturated."""
+        engines = self.engines
+        n = len(engines)
         k = next(self._rr)
-        candidates = (set(self._ready_indices()) if health._enabled
-                      else None)
+        candidates = (set(self._ready_indices(engines))
+                      if health._enabled else None)
+        matches = [e.prefix_match_len(prompt) for e in engines]
+        best = max(matches)
+        # affinity tier: longest usable match wins outright (the fork it
+        # unlocks is worth far more than perfect load spread); load (and
+        # the rotation) break ties and order the no-match fallback
         order = sorted(range(n),
-                       key=lambda i: (self._engines[(i + k) % n].load, i))
+                       key=lambda i: (-matches[(i + k) % n],
+                                      engines[(i + k) % n].load, i))
         last_exc = None
         for i in order:
-            if candidates is not None and (i + k) % n not in candidates:
+            j = (i + k) % n
+            if candidates is not None and j not in candidates:
                 continue
-            eng = self._engines[(i + k) % n]
+            eng = engines[j]
             try:
                 stream = eng.submit(prompt, **kwargs)
-            except QueueFullError as e:
+            except (QueueFullError, ServerClosedError) as e:
+                # ServerClosedError: the snapshot can race a concurrent
+                # scale_to shrink — a replica mid-drain must fail over
+                # like a full one, not surface to the caller while
+                # healthy replicas have capacity
                 last_exc = e
                 continue
             if telemetry._enabled:
                 telemetry.counter("serving.generation.routed").inc()
+                if best > 0 and matches[j] == best:
+                    telemetry.counter(
+                        "serving.generation.routed_affinity").inc()
+                else:
+                    telemetry.counter(
+                        "serving.generation.routed_load").inc()
+            if health._enabled and best > 0 and matches[j] == best:
+                health.event("router_affinity", engine=eng.health_name,
+                             matched=int(matches[j]),
+                             prompt_tokens=int(len(prompt)))
             return stream
         raise last_exc if last_exc is not None else QueueFullError(
             "every generation replica is saturated")
@@ -119,12 +189,92 @@ class GenerationRouter:
         """Blocking convenience: route, then collect the full token list."""
         return list(self.submit(prompt, **kwargs))
 
+    # -- autoscale actuator --------------------------------------------------
+
+    def scale_to(self, n, reason="manual", warm=True):
+        """Resize the fleet to ``n`` replicas (clamped to
+        ``[min_engines, max_engines]``). Growth constructs engines from
+        the registered ``factory`` (and warms them, so a scaled-up
+        replica never cold-compiles under traffic); shrink pops the
+        newest replicas, stops placing on them immediately and drains
+        them in a background thread (``close()`` — live AND queued
+        sessions finish, zero drops). Returns the new fleet size.
+        Journaled as ``autoscale_actuate`` health events. A closed
+        router refuses to scale (returns the current size) — a late
+        autoscale signal must never resurrect a shut-down fleet."""
+        if self._closed:
+            return len(self.engines)
+        n = max(int(n), self._min)
+        if self._max is not None:
+            n = min(n, self._max)
+        grown, drained = [], []
+        with self._scale_lock:
+            with self._lock:
+                need = n - len(self._engines)
+            if need > 0 and self._factory is None:
+                raise MXNetError(
+                    "GenerationRouter.scale_to needs an engine "
+                    "factory to grow the fleet")
+            for _ in range(max(need, 0)):
+                # construct AND warm before publishing: an unwarmed
+                # replica sorts first by load and a submit racing the
+                # grow would pay its cold compiles on the serving path
+                eng = self._factory()
+                if warm:
+                    eng.warm()
+                grown.append(eng)
+            with self._lock:
+                self._engines.extend(grown)
+                while len(self._engines) > n:
+                    drained.append(self._engines.pop())
+        for eng in drained:
+            t = threading.Thread(target=eng.close, daemon=True,
+                                 name="mxnet_tpu.serving.generation.drain")
+            t.start()
+            with self._lock:
+                self._draining.append((eng, t))
+        if grown or drained:
+            if telemetry._enabled:
+                telemetry.gauge("serving.generation.replicas").set(n)
+            if health._enabled:
+                health.event("autoscale_actuate", replicas=n,
+                             grown=len(grown), drained=len(drained),
+                             reason=reason)
+        # reap finished drain threads (bounded: one entry per shrink)
+        with self._lock:
+            self._draining = [(e, t) for e, t in self._draining
+                              if t.is_alive()]
+        return n
+
+    def bind_autoscale(self):
+        """Wire :func:`mxnet_tpu.health.on_autoscale` to
+        :meth:`scale_to`: whenever the computed ``desired_engines``
+        changes, the fleet actually grows or drains (single-host
+        actuator; the callback runs on the SLO evaluation thread —
+        growth warms synchronously there, off every serving path).
+        The hook holds the router WEAKLY and goes inert once the router
+        closes or is collected — `health.on_autoscale` has no removal
+        API and its callback list outlives any one fleet, so a strong
+        closure would both leak the router and let a post-shutdown
+        signal construct fresh engines nobody ever closes. Returns the
+        callback for tests/bookkeeping."""
+        wr = weakref.ref(self)
+
+        def _actuate(desired, info):
+            router = wr()
+            if router is not None and not router._closed:
+                router.scale_to(desired, reason="signal")
+
+        return health.on_autoscale(_actuate)
+
+    # -- lifecycle -----------------------------------------------------------
+
     def warm(self, buckets=None):
         """Warm every replica (each compiles its own executables); sums
         the compile counts — ``serving.warmup`` reports through this."""
         out = {"buckets": None, "compiles": 0, "seconds": 0.0,
                "cache_entries": 0}
-        for e in self._engines:
+        for e in self.engines:
             w = e.warm(buckets)
             out["buckets"] = w["buckets"]
             out["compiles"] += w["compiles"]
@@ -133,8 +283,13 @@ class GenerationRouter:
         return out
 
     def close(self, timeout=None):
-        for e in self._engines:
+        self._closed = True          # gates scale_to + the autoscale hook
+        for e in self.engines:
             e.close(timeout)
+        with self._lock:
+            draining, self._draining = self._draining, []
+        for _, t in draining:
+            t.join(timeout)
 
     def __enter__(self):
         return self
@@ -144,6 +299,7 @@ class GenerationRouter:
         return False
 
     def stats(self):
-        return {"replicas": len(self._engines),
-                "loads": self.loads(),
-                "engines": [e.stats() for e in self._engines]}
+        engines = self.engines
+        return {"replicas": len(engines),
+                "loads": [e.load for e in engines],
+                "engines": [e.stats() for e in engines]}

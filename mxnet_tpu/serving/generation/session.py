@@ -45,6 +45,11 @@ class GenerationStream:
         self.deadline = deadline
         self.submitted_at = time.monotonic()
         self.first_token_at = None
+        # set at admission when the engine forked a cached prompt prefix
+        # instead of running a full prefill: the number of prompt tokens
+        # whose K/V came from the prefix cache (0 = full prefill) — the
+        # client-visible "why was my TTFT fast" signal
+        self.cached_prefix_len = 0
 
     # -- engine side ---------------------------------------------------------
 

@@ -338,6 +338,27 @@ def snapshot():
         # KV slab is oversized for the arrival rate (padding compute on
         # dead slots; docs/faq/perf.md "Sizing the KV slab")
         out["derived"]["serving.generation.slot_fill_ratio"] = dtok / cap
+    prop = out["counters"].get("serving.generation.spec.proposed", 0)
+    if prop > 0:
+        # draft quality: accepted proposals over proposed — the lever
+        # behind tokens-per-tick (docs/faq/perf.md "Prefix caching and
+        # speculative decoding")
+        out["derived"]["serving.generation.spec.acceptance_ratio"] = \
+            out["counters"].get("serving.generation.spec.accepted", 0) / prop
+    vslots = out["counters"].get("serving.generation.spec.verified_slots", 0)
+    if vslots > 0:
+        # committed tokens per live slot per verify tick: 1.0 is the
+        # plain-decode floor, spec_k+1 the ceiling
+        out["derived"]["serving.generation.spec.accepted_tokens_per_tick"] = \
+            out["counters"].get("serving.generation.spec.committed", 0) \
+            / vslots
+    ph = out["counters"].get("serving.generation.prefix.hits", 0)
+    pm = out["counters"].get("serving.generation.prefix.misses", 0)
+    if ph + pm > 0:
+        # admissions served by a fork instead of a full prefill — a
+        # fleet sharing a system prompt should approach (N-1)/N
+        out["derived"]["serving.generation.prefix.hit_ratio"] = \
+            ph / (ph + pm)
     segs = out["counters"].get("lazy.segments", 0)
     if segs > 0:
         # fused ops per flushed lazy segment — near 1 means barriers fire

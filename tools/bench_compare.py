@@ -43,6 +43,11 @@ METRICS = [
     ("generation.ttft_p50_ms", "generation TTFT p50 ms", "down"),
     ("generation.ttft_p99_ms", "generation TTFT p99 ms", "down"),
     ("generation.cold_compile_s", "generation cold compile s", "down"),
+    ("generation.prefix_hit_ratio", "generation prefix hit ratio", "up"),
+    ("generation.prefix_ttft_p50_ms", "generation hit TTFT p50 ms", "down"),
+    ("generation.accepted_tokens_per_tick",
+     "generation accepted toks/tick", "up"),
+    ("generation.spec_vs_plain", "generation spec/plain speedup", "up"),
     ("lazy.lazy_vs_eager", "lazy/eager speedup", "up"),
     ("framework_module_compile_s", "module compile s", "down"),
 ]
@@ -51,6 +56,10 @@ METRICS = [
 INVARIANTS = [
     ("serving.steady_state_compiles", "serving steady-state compiles"),
     ("generation.steady_state_compiles", "generation steady-state compiles"),
+    ("generation.spec_steady_state_compiles",
+     "speculative steady-state compiles"),
+    ("generation.prefix_steady_state_compiles",
+     "prefix-cache steady-state compiles"),
     ("lazy.steady_state_compiles", "lazy steady-state compiles"),
 ]
 

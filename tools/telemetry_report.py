@@ -136,7 +136,29 @@ def main(argv=None):
         line += ("\n  (low slot fill = the KV slab outruns arrivals - "
                  "shrink MXNET_GENERATION_SLOTS or add replicas, "
                  "docs/faq/perf.md \"Sizing the KV slab\")\n")
-        sys.stdout.write(line)
+        ph = counters.get("serving.generation.prefix.hits", 0)
+        pm = counters.get("serving.generation.prefix.misses", 0)
+        if ph + pm:
+            line2 = (f"  prefix cache: {ph} hits / {pm} misses"
+                     f" (ratio {derived.get('serving.generation.prefix.hit_ratio', 0):.3f}),"
+                     f" {counters.get('serving.generation.prefix.forks', 0):.0f} forks,"
+                     f" {counters.get('serving.generation.prefix.inserts', 0):.0f} inserts,"
+                     f" {counters.get('serving.generation.prefix.evictions', 0):.0f} evictions,"
+                     f" {gauges.get('serving.generation.prefix.cached_tokens', 0):.0f} tokens cached\n")
+            sys.stdout.write(line + line2)
+            line = ""
+        prop = counters.get("serving.generation.spec.proposed", 0)
+        if prop:
+            line3 = (f"  speculative: {prop:.0f} proposed /"
+                     f" {counters.get('serving.generation.spec.accepted', 0):.0f} accepted"
+                     f" (ratio {derived.get('serving.generation.spec.acceptance_ratio', 0):.3f}),"
+                     f" {counters.get('serving.generation.spec.rolled_back', 0):.0f} rolled back;"
+                     f" {derived.get('serving.generation.spec.accepted_tokens_per_tick', 0):.2f} tokens/tick"
+                     " (plain floor 1.0)\n")
+            sys.stdout.write(line + line3)
+            line = ""
+        if line:
+            sys.stdout.write(line)
     pp_steps = counters.get("pipeline.steps", 0)
     if pp_steps:
         gauges = snap.get("gauges", {})
