@@ -38,6 +38,13 @@ build_native() {
 }
 
 unit() {
+  # tpulint FIRST and BLOCKING: the framework-invariant static gate
+  # (executable-cache / donation-persistence / gate-discipline /
+  # tracer-hygiene / env-var-registry). A violation fails CI before any
+  # test runs — cheaper to read one findings list than to bisect the
+  # suite failure it would eventually cause
+  log "tpulint gate (framework-invariant static analysis, blocking)"
+  python -m tools.tpulint mxnet_tpu tools bench.py --strict
   log "unit suite (includes the 4-process dist kvstore run and CI-guarded examples)"
   python -m pytest tests/python/unittest -q -x \
       --ignore=tests/python/unittest/test_resilience.py \
@@ -52,7 +59,8 @@ unit() {
       --ignore=tests/python/unittest/test_pipeline.py \
       --ignore=tests/python/unittest/test_elastic.py \
       --ignore=tests/python/unittest/test_lazy.py \
-      --ignore=tests/python/unittest/test_health.py
+      --ignore=tests/python/unittest/test_health.py \
+      --ignore=tests/python/unittest/test_tpulint.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
   # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
@@ -144,6 +152,26 @@ unit() {
   # attributed, not as a flaky assertion inside an unrelated suite
   log "health suite (SLO tracker, liveness/readiness, stall watchdog + capture, router drain, chaos acceptance)"
   python -m pytest tests/python/unittest/test_health.py -q
+  # analysis gate, standalone: the tpulint rule fixtures (each rule must
+  # trip on its positive fixture and stay quiet on the negative) and the
+  # MXNET_DEBUG_SYNC lock-order recorder unit tests (ABBA inversion,
+  # blocking hazards, zero-overhead-off subprocess pin) — a checker or
+  # recorder regression fails HERE, attributed
+  log "analysis suite (tpulint rule fixtures, lock-order recorder, zero-overhead pins)"
+  python -m pytest tests/python/unittest/test_tpulint.py -q
+  # lock-order race hunt: re-run the CONCURRENCY suites (threaded
+  # batcher, generation scheduler, lazy cross-thread, elastic heartbeats)
+  # under the runtime recorder. tests/conftest.py's sessionfinish hook
+  # fails the run on ANY lock-order inversion or blocking hazard the
+  # suites drove, with both stacks printed — the dynamic complement of
+  # the static tpulint gate (the PR 10 / PR 12 deadlock classes)
+  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/lazy/elastic)"
+  env MXNET_DEBUG_SYNC=1 python -m pytest \
+      tests/python/unittest/test_serving.py \
+      tests/python/unittest/test_generation.py \
+      tests/python/unittest/test_generation_scale.py \
+      tests/python/unittest/test_lazy.py \
+      tests/python/unittest/test_elastic.py -q
 }
 
 train() {

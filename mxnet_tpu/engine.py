@@ -21,6 +21,7 @@ import contextlib
 import threading
 import time
 
+from . import analysis
 from . import telemetry
 from . import tracing
 from .base import getenv
@@ -35,7 +36,7 @@ _var_pool = []
 # (safe: after wait_all no write is in flight, so remapping a var to a new
 # path cannot reorder anything)
 _PATH_VAR_CAP = 512
-_path_lock = threading.Lock()
+_path_lock = analysis.make_lock("engine.path_vars")
 # exceptions raised by async-pushed fns; re-raised at the next wait_all()
 # so failures are not silently swallowed (the reference engine aborts the
 # process on an op error — here the error surfaces at the sync point)
@@ -172,6 +173,10 @@ def wait_all():
     from . import lib
     from .ndarray import waitall
 
+    if analysis._enabled:
+        # draining the engine blocks on worker threads: holding any
+        # tracked lock here is a deadlock-in-waiting
+        analysis.check_blocking("engine.wait_all")
     eng = lib.native_engine()
     if eng is not None:
         eng.wait_all()

@@ -62,6 +62,7 @@ import time
 import traceback
 import weakref
 
+from . import analysis
 from . import telemetry
 from .base import getenv, register_env
 from .log import get_logger
@@ -127,7 +128,7 @@ register_env("MXNET_HEALTH_TARGET_FILL", 0.75,
 # before any other health work, including timestamps.
 _enabled = bool(getenv("MXNET_HEALTH"))
 
-_lock = threading.Lock()
+_lock = analysis.make_lock("health.registry")
 
 
 def _logger():
@@ -243,7 +244,7 @@ class Beacon:
     def __init__(self, name, owner=None):
         self.name = name
         self._owner = weakref.ref(owner) if owner is not None else None
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("health.beacon")
         self.last = None          # monotonic of the last progress
         self.active = False       # work pending (silence counts as stall)
         self.stalled = False      # set by the watchdog, cleared by touch()
@@ -625,7 +626,7 @@ class SloTracker:
                          for o in self.objectives}
         self._last_counters = {}
         self._last_ts = None
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("health.slo")
         self.evaluations = 0
         self.exhausted = False
 
@@ -905,7 +906,7 @@ def autoscale_signal(engines=None):
 
 _watchdog_thread = None
 _slo_thread = None
-_threads_lock = threading.Lock()
+_threads_lock = analysis.make_lock("health.threads")
 
 
 def _watchdog_loop():

@@ -50,6 +50,7 @@ import weakref
 import jax
 import jax.numpy as jnp
 
+from .. import analysis
 from .. import health
 from .. import telemetry
 from .. import tracing
@@ -65,7 +66,7 @@ __all__ = ["LazyArray", "LazyGraph", "enabled", "graph_for_thread",
 _UNJITTABLE = frozenset({"Custom"})
 
 _CACHE = None
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = analysis.make_lock("lazy.segment_cache")
 
 
 def _segment_cache():
@@ -254,7 +255,7 @@ class LazyGraph:
     """Per-thread pending dataflow segment + flush machinery."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = analysis.make_rlock("lazy.graph")
         self._nodes = []
         self._leaves = []         # concrete jax arrays, deduped by id
         self._leaf_index = {}     # id(array) -> leaf idx
@@ -418,6 +419,13 @@ class LazyGraph:
             self._n_slots = 0
             self._gen += 1
             try:
+                if analysis._enabled:
+                    # the flush compiles + runs a whole XLA program;
+                    # holding any OTHER tracked lock across it is the
+                    # PR 10 cross-graph deadlock class (the graph's own
+                    # per-thread lock is the design, hence exempt)
+                    analysis.check_blocking("lazy.flush",
+                                            exempt=(self._lock,))
                 self._flush_nodes(nodes, leaves, reason)
             finally:
                 self._flushing = False
@@ -634,7 +642,7 @@ def _make_replay(specs, out_spec):
 
 _tls = threading.local()
 _graphs = weakref.WeakSet()
-_graphs_lock = threading.Lock()
+_graphs_lock = analysis.make_lock("lazy.graphs")
 
 
 def graph_for_thread():

@@ -42,6 +42,7 @@ from __future__ import annotations
 import threading
 import weakref
 
+from . import analysis
 from . import telemetry
 
 __all__ = ["CATEGORIES", "track", "track_transient", "register_provider",
@@ -50,7 +51,7 @@ __all__ = ["CATEGORIES", "track", "track_transient", "register_provider",
 CATEGORIES = ("weights", "optimizer_state", "gradients", "serving_batches",
               "kv_cache")
 
-_lock = threading.Lock()
+_lock = analysis.make_lock("memory.census")
 # category -> list of weakref.ref to NDArray / jax array (long-lived)
 _tracked = {c: [] for c in CATEGORIES}
 # category -> list of (weakref to owner, getter(owner) -> iterable of arrays)

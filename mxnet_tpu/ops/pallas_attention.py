@@ -34,7 +34,16 @@ except Exception:  # noqa: BLE001
     pl = None
     HAVE_PALLAS = False
 
+from ..compile_cache import CompileCache
+
 __all__ = ["flash_attention", "reference_attention", "HAVE_PALLAS"]
+
+# one custom_vjp-wrapped kernel per (config) — named so
+# `compile_cache.named_stats("pallas")` attributes kernel rebuilds the
+# way every other executable cache does (these were anonymous lru_caches).
+# track_memory=False: entries are custom_vjp callables with no .lower(),
+# so aval recording could never yield a memory row anyway
+_pallas_cache = CompileCache("pallas", track_memory=False)
 
 _NEG_INF = -1e30
 
@@ -115,25 +124,28 @@ def _pallas_forward(q, k, v, scale, causal, block_q, block_k, interpret):
     return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
 
 
-@functools.lru_cache(maxsize=None)
 def _make_fa(scale, causal, block_q, block_k, interpret):
-    @jax.custom_vjp
-    def fa(q, k, v):
-        return _pallas_forward(q, k, v, scale, causal, block_q, block_k,
-                               interpret)
+    def build():
+        @jax.custom_vjp
+        def fa(q, k, v):
+            return _pallas_forward(q, k, v, scale, causal, block_q,
+                                   block_k, interpret)
 
-    def fwd(q, k, v):
-        return fa(q, k, v), (q, k, v)
+        def fwd(q, k, v):
+            return fa(q, k, v), (q, k, v)
 
-    def bwd(res, do):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal,
-                                                   scale=scale), q, k, v)
-        return vjp(do)
+        def bwd(res, do):
+            q, k, v = res
+            _, vjp = jax.vjp(
+                lambda q_, k_, v_: reference_attention(
+                    q_, k_, v_, causal=causal, scale=scale), q, k, v)
+            return vjp(do)
 
-    fa.defvjp(fwd, bwd)
-    return fa
+        fa.defvjp(fwd, bwd)
+        return fa
+
+    return _pallas_cache.get_or_build(
+        ("fa", scale, causal, block_q, block_k, interpret), build)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
@@ -268,31 +280,35 @@ def flash_block_partials(q, k, v, bias=None, scale=None, block_q=128,
     return o, m, l
 
 
-@functools.lru_cache(maxsize=None)
 def _make_partials_vjp(scale, block_q, block_k, interpret):
     """Differentiable partials: forward is the fused kernel, backward is
     the vjp of the plain-XLA `_block_attn` (same math recomputed) — the
     ring loop stays end-to-end differentiable with the kernel inside."""
-    from ..parallel.ring_attention import _block_attn
+    def build():
+        from ..parallel.ring_attention import _block_attn
 
-    @jax.custom_vjp
-    def partials(q, k, v, bias):
-        return flash_block_partials(q, k, v, bias=bias, scale=scale,
-                                    block_q=block_q, block_k=block_k,
-                                    interpret=interpret)
+        @jax.custom_vjp
+        def partials(q, k, v, bias):
+            return flash_block_partials(q, k, v, bias=bias, scale=scale,
+                                        block_q=block_q, block_k=block_k,
+                                        interpret=interpret)
 
-    def fwd(q, k, v, bias):
-        return partials(q, k, v, bias), (q, k, v, bias)
+        def fwd(q, k, v, bias):
+            return partials(q, k, v, bias), (q, k, v, bias)
 
-    def bwd(res, cts):
-        q, k, v, bias = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _block_attn(q_, k_, v_, bias, scale), q, k, v)
-        dq, dk, dv = vjp(cts)
-        return dq, dk, dv, jnp.zeros_like(bias)
+        def bwd(res, cts):
+            q, k, v, bias = res
+            _, vjp = jax.vjp(
+                lambda q_, k_, v_: _block_attn(q_, k_, v_, bias, scale),
+                q, k, v)
+            dq, dk, dv = vjp(cts)
+            return dq, dk, dv, jnp.zeros_like(bias)
 
-    partials.defvjp(fwd, bwd)
-    return partials
+        partials.defvjp(fwd, bwd)
+        return partials
+
+    return _pallas_cache.get_or_build(
+        ("partials", scale, block_q, block_k, interpret), build)
 
 
 def _divisor_block(n, target=128):

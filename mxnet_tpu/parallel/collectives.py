@@ -12,8 +12,6 @@ reduce trees (`comm.h:451`, `comm_tree.h:50`), and ps-lite RPC
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -42,6 +40,7 @@ def shard_map(f, **kwargs):
     return _jax_shard_map(f, **kwargs)
 
 
+from ..compile_cache import CompileCache
 from .mesh import default_mesh
 
 
@@ -94,14 +93,24 @@ def ring_shift(x, axis_name, axis_size, shift=1):
 
 # -- eager (NDArray / host level) -------------------------------------------
 
-@functools.lru_cache(maxsize=None)
+# the eager-collective programs, named so `named_stats("collectives")`
+# attributes wire recompiles (was an anonymous lru_cache — the class
+# tpulint's executable-cache rule now flags); track_memory=False — tiny
+# one-op reduce programs, no /memory insight worth an AOT recompile
+_eager_cache = CompileCache("collectives", track_memory=False)
+
+
 def _eager_allreduce_fn(mesh, axis, op):
-    spec = P(axis)
+    def build():
+        spec = P(axis)
 
-    def body(x):
-        return all_reduce(x, axis, op)
+        def body(x):
+            return all_reduce(x, axis, op)
 
-    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec))
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                                 out_specs=spec))
+
+    return _eager_cache.get_or_build((mesh, axis, op), build)
 
 
 def _flat_collective_mesh(mesh):
@@ -140,6 +149,14 @@ def barrier(mesh=None):
     """Block until all devices reach this point (reference
     `KVStore::Barrier`, `kvstore_dist.h:105`): a tiny psum over the mesh."""
     import numpy as _np
+
+    from .. import analysis
+
+    if analysis._enabled:
+        # a barrier parks this thread until every peer arrives: any
+        # tracked lock held here can deadlock the whole fleet (the
+        # assist-vs-worker class from PR 12)
+        analysis.check_blocking("collective.barrier")
 
     mesh = mesh or default_mesh()
     mesh, axis = _flat_collective_mesh(mesh)

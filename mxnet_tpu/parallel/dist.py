@@ -43,8 +43,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import default_mesh
 from .. import telemetry
+from ..base import getenv, register_env
+from ..compile_cache import CompileCache
 from ..kvstore import KVStoreBase
 from . import collectives as coll
+
+register_env("MXNET_UPDATE_AGGREGATION_SIZE", 0,
+             "max KEYS fused into one dist-push collective bucket (the "
+             "reference's update aggregation, kvstore_nccl.h); 0 = no "
+             "key cap, element-size capping only")
+
+# the in-store collective programs (sum/gather/fused-dequant), named so
+# `named_stats("dist")` attributes wire recompiles (were anonymous
+# lru_caches — the class tpulint's executable-cache rule now flags).
+# track_memory=False: one tiny program per bucket layout — the /memory
+# scrape's per-entry AOT analysis would re-pay a compile each
+_dist_cache = CompileCache("dist", track_memory=False)
 
 _initialized = False
 
@@ -148,23 +162,32 @@ def _collective_mesh():
     return Mesh(np.array(jax.devices()), ("procdev",))
 
 
-@functools.lru_cache(maxsize=None)
 def _sum_over_devices_fn():
     # jit caches per input shape/dtype; one wrapper suffices for all keys
-    mesh = _collective_mesh()
-    return jax.jit(lambda x: x.sum(axis=0),
-                   out_shardings=NamedSharding(mesh, P()))
+    def build():
+        mesh = _collective_mesh()
+        return jax.jit(lambda x: x.sum(axis=0),
+                       out_shardings=NamedSharding(mesh, P()))
+
+    return _dist_cache.get_or_build(("sum",), build)
 
 
-@functools.lru_cache(maxsize=None)
 def _gather_fn():
     """Replicate a device-sharded stack everywhere (AllGather)."""
-    mesh = _collective_mesh()
-    return jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+    def build():
+        mesh = _collective_mesh()
+        return jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+
+    return _dist_cache.get_or_build(("gather",), build)
 
 
-@functools.lru_cache(maxsize=None)
 def _dequant_sum_fn(segments, threshold, dtype_str):
+    return _dist_cache.get_or_build(
+        ("dequant", segments, threshold, dtype_str),
+        lambda: _build_dequant_sum(segments, threshold, dtype_str))
+
+
+def _build_dequant_sum(segments, threshold, dtype_str):
     """One fused program: dequantize every worker's packed 2-bit words for a
     whole key bucket and sum over workers. ``segments`` is a static tuple of
     (word_start, word_count, shape) per key."""
@@ -401,11 +424,16 @@ class KVStoreDistTPUSync(KVStoreBase):
         for i in order:
             k, a = keys[i], arrs[i]
             groups.setdefault(str(a.dtype), []).append((k, a))
+        # reference key-batching knob: cap KEYS per fused collective
+        # too (kvstore_nccl.h update aggregation); 0 = elements only.
+        # Read once per push — not per dtype group on the sync hot path
+        key_cap = int(getenv("MXNET_UPDATE_AGGREGATION_SIZE", 0))
         for _, ka in groups.items():
             cap = _bucket_cap_elems(ka[0][1].dtype.itemsize)
             cur_k, cur_a, cur_n = [], [], 0
             for k, a in ka:
-                if cur_k and cur_n + a.size > cap:
+                if cur_k and (cur_n + a.size > cap
+                              or (key_cap and len(cur_k) >= key_cap)):
                     buckets.append((cur_k, cur_a))
                     cur_k, cur_a, cur_n = [], [], 0
                 cur_k.append(k)

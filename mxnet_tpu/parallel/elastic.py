@@ -47,6 +47,7 @@ import sys
 import threading
 import time
 
+from .. import analysis
 from .. import health
 from .. import telemetry
 from .. import tracing
@@ -441,7 +442,7 @@ class ElasticRuntime:
 # ---------------------------------------------------------------------------
 
 _runtime = None
-_runtime_lock = threading.Lock()
+_runtime_lock = analysis.make_lock("elastic.runtime")
 
 
 def runtime():

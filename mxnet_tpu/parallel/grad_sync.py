@@ -142,7 +142,9 @@ def _cache():
     order-couple module imports."""
     from ..compile_cache import CompileCache
 
-    return CompileCache("grad_sync", maxsize=256)
+    # track_memory=False: hundreds of tiny pack/unpack programs — the
+    # /memory scrape's per-entry AOT analysis would re-pay a compile each
+    return CompileCache("grad_sync", maxsize=256, track_memory=False)
 
 
 def _pack_fn(shapes, dtype):

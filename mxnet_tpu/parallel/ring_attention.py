@@ -14,8 +14,6 @@ is the eager/sharded convenience wrapper.
 """
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 
 import jax
@@ -23,8 +21,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compile_cache import CompileCache
 from . import mesh as mesh_mod
 from .mesh import AXIS_SP, default_mesh
+
+# one jitted shard_map program per (mesh, axis, size, causal, scale) —
+# named so `compile_cache.named_stats("ring_attention")` answers "did a
+# long-sequence step recompile?" (this was an anonymous lru_cache, the
+# exact silent-recompile class tpulint's executable-cache rule now flags)
+_ring_cache = CompileCache("ring_attention")
 
 
 def _block_attn(q, k, v, bias=None, scale=None):
@@ -181,14 +186,19 @@ def _full_causal_bias(lq, lk):
     return jnp.where(mask, 0.0, -1e30)[None, None]
 
 
-@functools.lru_cache(maxsize=None)
 def _sharded_ring_fn(mesh, axis_name, axis_size, causal, scale):
-    from .collectives import shard_map
+    def build():
+        from .collectives import shard_map
 
-    spec = P(None, axis_name)
+        spec = P(None, axis_name)
 
-    def body(q, k, v):
-        return ring_attention(q, k, v, axis_name, axis_size, causal, scale)
+        def body(q, k, v):
+            return ring_attention(q, k, v, axis_name, axis_size, causal,
+                                  scale)
 
-    return jax.jit(shard_map(body, mesh=mesh,
-                             in_specs=(spec, spec, spec), out_specs=spec))
+        return jax.jit(shard_map(body, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec))
+
+    return _ring_cache.get_or_build(
+        (mesh, axis_name, axis_size, causal, scale), build)

@@ -31,6 +31,7 @@ import collections
 import threading
 import time
 
+from .. import analysis
 from .. import health
 from .. import telemetry
 from ..base import MXNetError, getenv, register_env
@@ -125,7 +126,7 @@ class AdmissionQueue:
                              f"{self._max_depth}")
         self._q = collections.deque()
         self._rows = 0
-        self._cond = threading.Condition()
+        self._cond = analysis.make_condition(f"{metric_prefix}.admission")
         self._closed = False
         # set (by the batcher, under its assist lock) while a blocking
         # caller is draining inline: put() then skips the worker wakeup —

@@ -35,6 +35,7 @@ import threading
 import time
 from concurrent.futures import Future
 
+from .. import analysis
 from .. import ndarray as nd
 from .. import telemetry
 from .. import tracing
@@ -83,8 +84,8 @@ class DynamicBatcher:
         # one assisting caller at a time; piece reassembly of split
         # requests is then reachable from two runner threads, so delivery
         # state is guarded by _result_lock
-        self._assist = threading.Lock()
-        self._result_lock = threading.Lock()
+        self._assist = analysis.make_lock("serving.batcher.assist")
+        self._result_lock = analysis.make_lock("serving.batcher.result")
         self._worker = threading.Thread(
             target=self._loop, daemon=True, name="mxnet_tpu.serving.batcher")
         self._worker.start()

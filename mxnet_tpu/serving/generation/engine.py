@@ -68,6 +68,7 @@ import time
 
 import numpy as np
 
+from ... import analysis
 from ... import health
 from ... import memory
 from ... import telemetry
@@ -227,8 +228,8 @@ class GenerationEngine:
 
         self._queue = AdmissionQueue(max_queue,
                                      metric_prefix="serving.generation")
-        self._tick_lock = threading.Lock()
-        self._work = threading.Condition()
+        self._tick_lock = analysis.make_lock("generation.tick")
+        self._work = analysis.make_condition("generation.work")
         self._closed = False
         self._tokens_window = 0
         self._rate_t0 = time.monotonic()

@@ -39,6 +39,7 @@ import time
 
 import numpy as np
 
+from ... import analysis
 from ... import health
 from ... import telemetry
 
@@ -83,7 +84,7 @@ class RadixPrefixCache:
     def __init__(self, metric_prefix="serving.generation", owner=""):
         self._root = _Node(np.zeros(0, np.int32), None, 0)
         self._slots = {}              # slot -> payload _Node
-        self._lock = threading.RLock()
+        self._lock = analysis.make_rlock("generation.prefix_cache")
         self._prefix = metric_prefix
         self._owner = owner
 

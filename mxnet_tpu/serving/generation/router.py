@@ -49,6 +49,7 @@ import itertools
 import threading
 import weakref
 
+from ... import analysis
 from ... import health
 from ... import telemetry
 from ...base import MXNetError
@@ -82,8 +83,8 @@ class GenerationRouter:
         self._min = max(int(min_engines), 1)
         self._max = None if max_engines is None else int(max_engines)
         self._rr = itertools.count()
-        self._lock = threading.Lock()       # engine-list mutation
-        self._scale_lock = threading.Lock()  # serializes scale_to calls
+        self._lock = analysis.make_lock("generation.router.engines")
+        self._scale_lock = analysis.make_lock("generation.router.scale")
         self._ready_state = {}      # engine health_name -> last ready bool
         self._all_unready = False
         self._draining = []         # (engine, closer thread) during shrink

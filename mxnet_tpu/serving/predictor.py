@@ -37,6 +37,7 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import analysis
 from .. import memory
 from .. import ndarray as nd
 from .. import telemetry
@@ -199,7 +200,7 @@ class Predictor:
         self._buckets = bucket_ladder(buckets)
         self._cache = CompileCache("serving")
         self._execs = {}
-        self._lock = threading.RLock()
+        self._lock = analysis.make_rlock("serving.predictor")
         # fleet health: /readyz reports warmup state per predictor
         # (serving.warmup sets _warmed; registration is weakly held)
         self._warmed = False

@@ -55,6 +55,7 @@ import random
 import threading
 import time
 
+from . import analysis
 from .base import getenv, register_env
 
 __all__ = ["Span", "span", "emit_span", "begin", "inject", "attach",
@@ -74,9 +75,10 @@ register_env("MXNET_TRACING_MAX_EVENTS", 1 << 19,
 # memoized buffer cap — _push() runs under the global lock on every
 # event, so it must not re-parse the environment there; keying the memo
 # on the raw env string keeps runtime changes honored at the cost of one
-# dict lookup per event
-_max_memo = (os.environ.get("MXNET_TRACING_MAX_EVENTS"),
-             int(getenv("MXNET_TRACING_MAX_EVENTS")))
+# dict lookup per event. The sentinel first entry (False is never a raw
+# env value) defers the first parse to first use — import stays
+# side-effect-free (tpulint gate-discipline)
+_max_memo = (False, 0)
 
 
 def _max_events():
@@ -98,7 +100,7 @@ _ctx = contextvars.ContextVar("mxnet_tpu_trace", default=None)
 _events = []
 _dropped = 0
 _unmirrored = 0  # drops not yet flushed into the telemetry counter
-_lock = threading.Lock()
+_lock = analysis.make_lock("tracing.events")
 _rand = random.Random()
 
 
@@ -446,7 +448,7 @@ class FlightRecorder:
     ``/trace`` serves it)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = analysis.make_lock("tracing.flight")
         self._worst = None
         self._count = 0
 

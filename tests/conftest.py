@@ -49,3 +49,21 @@ def _seed():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Under MXNET_DEBUG_SYNC=1 (the ci/run.sh lock-order rerun of the
+    concurrency suites) the whole session doubles as a race hunt: any
+    lock-order inversion or blocking hazard the suites drove fails the
+    run here with both stacks, even when every assertion passed."""
+    if os.environ.get("MXNET_DEBUG_SYNC") != "1":
+        return
+    from mxnet_tpu import analysis
+
+    rep = analysis.report()
+    if rep["inversions"] or rep["hazards"]:
+        print("\n" + analysis.format_report(rep))
+        session.exitstatus = max(int(exitstatus) or 0, 1)
+    else:
+        print(f"\nlock-order analysis clean: {len(rep['locks'])} locks, "
+              f"{len(rep['edges'])} order edges, 0 inversions, 0 hazards")

@@ -189,3 +189,27 @@ def test_partials_reject_per_head_bias():
     per_head = jnp.zeros((2, 4, 32, 32), jnp.float32)
     with pytest.raises(ValueError):
         flash_block_partials(q, k, v, bias=per_head, interpret=True)
+
+
+@pallas
+def test_pallas_compile_cache_miss_pinning():
+    """Kernel factories live in CompileCache("pallas") (were anonymous
+    lru_caches): one miss per distinct (scale, causal, blocks, interpret)
+    config, pure hits on replay — named_stats deltas, the repo rule."""
+    from mxnet_tpu import compile_cache
+
+    q, k, v = _qkv(l=32)
+    cfg = dict(causal=True, block_q=16, block_k=16, interpret=True)
+    before = compile_cache.named_stats("pallas")
+    flash_attention(q, k, v, **cfg)
+    mid = compile_cache.named_stats("pallas")
+    assert mid["misses"] - before["misses"] in (0, 1)  # warm if reused cfg
+    flash_attention(q, k, v, **cfg)
+    after = compile_cache.named_stats("pallas")
+    assert after["misses"] - mid["misses"] == 0        # steady state
+    assert after["hits"] - mid["hits"] >= 1
+    # distinct config -> distinct executable: exactly one more miss max
+    flash_attention(q, k, v, causal=False, block_q=16, block_k=16,
+                    interpret=True)
+    end = compile_cache.named_stats("pallas")
+    assert end["misses"] - after["misses"] <= 1

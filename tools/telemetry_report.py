@@ -203,6 +203,20 @@ def main(argv=None):
         line += ("\n  (read /slo and /events for the full picture - "
                  "docs/faq/perf.md \"Operating a fleet\")\n")
         sys.stdout.write(line)
+    inversions = counters.get("analysis.lock_inversions", 0)
+    hazards = counters.get("analysis.blocking_hazards", 0)
+    edges = gauges.get("analysis.lock_edges", 0)
+    if inversions or hazards or edges:
+        line = (f"\nanalysis: {edges:.0f} lock-order edges, "
+                f"{inversions} inversion(s), {hazards} blocking hazard(s)")
+        if inversions or hazards:
+            line += ("\n  DEADLOCK RISK: re-run under MXNET_DEBUG_SYNC=1 "
+                     "and read analysis.report() for both stacks - "
+                     "docs/faq/perf.md \"Machine-checked invariants\"")
+        else:
+            line += (" (MXNET_DEBUG_SYNC recorder was on and the run "
+                     "stayed clean)")
+        sys.stdout.write(line + "\n")
     lost = counters.get("elastic.lost_workers", 0)
     shrinks = counters.get("elastic.shrinks", 0)
     gen = snap.get("gauges", {}).get("elastic.generation", 0)
