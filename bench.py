@@ -820,6 +820,125 @@ def _measure_lazy(on_tpu):
     return out
 
 
+def _measure_spmd(on_tpu):
+    """spmd lane: the GSPMD-sharded fused step (MXNET_SPMD,
+    parallel/spmd.py) vs the replicated one on a small all-divisible MLP.
+    Needs >= 2 devices (the CI bench smoke runs single-device and records
+    the skip); picks tp=2 at 2-3 devices, tp=2,fsdp=2 at >= 4. Reports
+    measured per-device param+optimizer-state bytes vs the replicated
+    total (the 1/N capability claim), steady-state step time both ways,
+    whole-run parity, cold compile seconds separated, and asserts zero
+    steady-state compiles on the "spmd" cache. CAVEAT on virtual-CPU
+    meshes: every "device" is a host thread, so spmd_vs_replicated < 1
+    is expected — the load-bearing numbers are the byte ratio and the
+    compile invariant (the MULTICHIP_r08 caveat)."""
+    import numpy as np
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_cache
+    from mxnet_tpu.parallel.partition import nbytes_on_device
+
+    ndev = jax.device_count()
+    if ndev < 2:
+        return {"skipped": f"needs >= 2 devices, have {ndev}"}
+    spec = "tp=2,fsdp=2" if ndev >= 4 else "tp=2"
+    batch, dim, hidden, classes = 32, 64, 128, 8
+    steps = max(6, int(os.environ.get("BENCH_ITERS", "3")) * 2)
+
+    def mlp():
+        n = mx.sym.Variable("data")
+        for i in range(3):
+            n = mx.sym.FullyConnected(n, num_hidden=hidden,
+                                      name=f"bspmd_fc{i}")
+            n = mx.sym.Activation(n, act_type="relu")
+        n = mx.sym.FullyConnected(n, num_hidden=classes, name="bspmd_out")
+        return mx.sym.SoftmaxOutput(n, name="softmax")
+
+    class _Batch:
+        def __init__(self, X, Y):
+            self.data = [mx.nd.array(X)]
+            self.label = [mx.nd.array(Y)]
+
+    def drive(spmd_spec):
+        saved = {k: os.environ.get(k)
+                 for k in ("MXNET_SPMD", "MXNET_SPMD_FSDP_MIN_SIZE",
+                           "MXNET_FUSED_STEP")}
+        if spmd_spec:
+            os.environ["MXNET_SPMD"] = spmd_spec
+            os.environ["MXNET_SPMD_FSDP_MIN_SIZE"] = "1"
+        else:
+            os.environ.pop("MXNET_SPMD", None)
+        os.environ["MXNET_FUSED_STEP"] = "1"
+        try:
+            mx.random.seed(5)
+            rng = np.random.RandomState(0)
+            m = mx.mod.Module(mlp(), context=mx.Context("cpu"))
+            m.bind([("data", (batch, dim))],
+                   [("softmax_label", (batch,))])
+            m.init_params(initializer=mx.init.Xavier())
+            m.init_optimizer(kvstore=None, optimizer="sgd",
+                             optimizer_params=(("learning_rate", 0.05),
+                                               ("momentum", 0.9)))
+            X = rng.uniform(-1, 1, (batch, dim)).astype(np.float32)
+            Y = rng.randint(0, classes, (batch,)).astype(np.float32)
+            cold0 = compile_cache.named_stats("spmd")
+            t0 = time.perf_counter()
+            assert m.fused_step(_Batch(X, Y)), "fused step fell back"
+            cold_s = time.perf_counter() - t0
+            warm0 = compile_cache.named_stats("spmd")
+            times = []
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                assert m.fused_step(_Batch(X, Y))
+                for w in m._exec.arg_dict.values():
+                    w.wait_to_read()
+                times.append(time.perf_counter() - t0)
+            warm1 = compile_cache.named_stats("spmd")
+            if spmd_spec:
+                assert m._spmd is not None and not m._spmd_failed, \
+                    "spmd path did not engage"
+            per_dev = total = 0
+            for name in m._param_names:
+                a = m._exec.arg_dict[name]._data
+                per_dev += nbytes_on_device(a)
+                total += int(a.size) * a.dtype.itemsize
+            arg_p, _ = m.get_params()
+            steady = sorted(times)[len(times) // 2]
+            return ({k: v.asnumpy() for k, v in arg_p.items()}, steady,
+                    per_dev, total, cold_s,
+                    warm0["compile_seconds"] - cold0["compile_seconds"],
+                    warm1["misses"] - warm0["misses"])
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    w_rep, t_rep, _, total, _, _, _ = drive("")
+    w_sh, t_sh, per_dev, total, cold_wall, cold_compile, steady = \
+        drive(spec)
+    assert steady == 0, f"spmd steady state compiled {steady} programs"
+    parity = max(float(np.abs(w_sh[k] - w_rep[k]).max() /
+                       max(np.abs(w_rep[k]).max(), 1e-8)) for k in w_rep)
+    return {
+        "basis": f"module_fused MXNET_SPMD={spec} vs replicated "
+                 f"({ndev} devices)",
+        "spec": spec,
+        "step_time_replicated_s": round(t_rep, 5),
+        "step_time_spmd_s": round(t_sh, 5),
+        "spmd_vs_replicated": round(t_rep / max(t_sh, 1e-9), 3),
+        "param_bytes_per_device": per_dev,
+        "param_bytes_replicated": total,
+        "param_bytes_ratio": round(per_dev / max(total, 1), 4),
+        "parity_rel": parity,
+        "cold_wall_s": round(cold_wall, 3),
+        "cold_compile_s": round(cold_compile, 3),
+        "steady_state_compiles": steady,
+    }
+
+
 def _pct(sorted_vals, q):
     """Nearest-rank percentile of an ascending-sorted list (shared by the
     serving and generation probes so their p50/p99 are comparable)."""
@@ -1301,6 +1420,15 @@ def main():
                 result["lazy"] = _measure_lazy(on_tpu)
         except Exception:  # noqa: BLE001
             result["lazy_error"] = \
+                traceback.format_exc(limit=3).strip().splitlines()[-1]
+        try:
+            # the spmd plane: GSPMD-sharded fused step (MXNET_SPMD) vs
+            # replicated — measured 1/N param residency + compile
+            # invariant; skips (recorded) on single-device runs
+            with _phase_scope("spmd"):
+                result["spmd"] = _measure_spmd(on_tpu)
+        except Exception:  # noqa: BLE001
+            result["spmd_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
         try:
             import jax

@@ -60,7 +60,8 @@ unit() {
       --ignore=tests/python/unittest/test_elastic.py \
       --ignore=tests/python/unittest/test_lazy.py \
       --ignore=tests/python/unittest/test_health.py \
-      --ignore=tests/python/unittest/test_tpulint.py
+      --ignore=tests/python/unittest/test_tpulint.py \
+      --ignore=tests/python/unittest/test_spmd.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
   # slow-marked kill-and-resume convergence case; the ROADMAP tier-1
@@ -152,6 +153,15 @@ unit() {
   # attributed, not as a flaky assertion inside an unrelated suite
   log "health suite (SLO tracker, liveness/readiness, stall watchdog + capture, router drain, chaos acceptance)"
   python -m pytest tests/python/unittest/test_health.py -q
+  # spmd gate, standalone: these tests flip MXNET_SPMD / MXNET_ZERO1 /
+  # MXNET_PIPELINE_* and pin sharded-vs-replicated whole-run parity,
+  # MEASURED 1/N per-device param+state residency, tp x fsdp x pp x
+  # zero1 composition, checkpoint interchange with replicated runs,
+  # exact CompileCache("spmd") accounting, sharded serving/generation
+  # binds and every fallback trigger — a planner, placement or
+  # constraint regression fails HERE, attributed
+  log "spmd suite (GSPMD sharding parity, 1/N residency, compositions, serving bind, fallbacks)"
+  python -m pytest tests/python/unittest/test_spmd.py -q
   # analysis gate, standalone: the tpulint rule fixtures (each rule must
   # trip on its positive fixture and stay quiet on the negative) and the
   # MXNET_DEBUG_SYNC lock-order recorder unit tests (ABBA inversion,
@@ -250,6 +260,33 @@ print("pipeline smoke OK:", {s: (r["bubble_ratio"], r["error_vs_unpipelined"])
                              for s, r in sweep.items()})
 PY
   rm -f /tmp/ci_pp_bw.jsonl
+
+  log "SPMD sharding smoke (8 virtual devices, measure.py --tp/--fsdp)"
+  # weight/activation-sharding regressions fail fast without TPUs: the
+  # sweep must complete with whole-run parity vs the replicated fused
+  # step (< 1e-5 asserted), the MEASURED per-device param+state bytes
+  # must be ~1/N, and the 'spmd' cache must stay steady-state cold
+  env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      timeout 600 python tools/bandwidth/measure.py \
+      --network mobilenet0.25 --image-shape 3,32,32 --num-classes 10 \
+      --ndev 8 --kv-store device --num-batches 1 --test-results 0 \
+      --tp 2,4 --fsdp 2,4 --json-out /tmp/ci_spmd_bw.jsonl
+  python - <<'PY'
+import json
+rec = json.loads(open("/tmp/ci_spmd_bw.jsonl").read().strip().splitlines()[-1])
+sweep = rec["spmd_sweep"]
+assert set(sweep) == {"tp", "fsdp"}, sweep
+for axis, runs in sweep.items():
+    assert set(runs) == {"2", "4"}, (axis, runs)
+    for n, r in runs.items():
+        assert r["error_vs_replicated"] < 1e-5, (axis, n, r)
+        assert abs(r["param_state_ratio"] - 1.0 / int(n)) < 0.02, (axis, n, r)
+        assert r["steady_state_compiles"] == 0, (axis, n, r)
+print("spmd smoke OK:", {ax: {n: round(r["param_state_ratio"], 3)
+                              for n, r in runs.items()}
+                         for ax, runs in sweep.items()})
+PY
+  rm -f /tmp/ci_spmd_bw.jsonl
 
   log "bench smoke (CPU, reduced steps)"
   # fresh compile cache: XLA:CPU AOT entries are machine-feature-pinned,

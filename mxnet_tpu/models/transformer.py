@@ -27,8 +27,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..parallel.collectives import sharding_constraint
 from ..parallel.mesh import default_mesh
 from ..parallel.ring_attention import ring_attention
+from ..parallel.spmd import model_mesh
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,7 +84,10 @@ class TransformerLM:
 
     def __init__(self, config, mesh=None):
         self.cfg = config
-        self.mesh = mesh or default_mesh()
+        # model_mesh: the MXNET_SPMD mesh when that gate is on (serving/
+        # generation weights and the KV slab shard without plumbing),
+        # else the ambient/default mesh — `default_mesh` semantics
+        self.mesh = mesh or model_mesh()
 
     def _is_moe(self, i):
         c = self.cfg
@@ -329,13 +334,29 @@ class TransformerLM:
     # Both are pure functions of (params, cache, ...) so the serving engine
     # can jit them once per shape with the cache buffers donated.
 
+    def _slab_sharding(self):
+        """The KV slab's layout: heads axis over 'tp' when the mesh has a
+        real tp axis that divides n_heads (the serving twin of the SPMD
+        weight sharding — per-head attention is independent, so the slab
+        shards cleanly on heads and decode K/V writes stay local), else
+        replicated. Every slab allocation AND every cache-returning
+        method pins this layout, so the donated decode/prefill buffers
+        alias across ticks."""
+        c = self.cfg
+        tp = self.mesh.shape.get("tp", 1)
+        if tp > 1 and c.n_heads % tp == 0:
+            return NamedSharding(self.mesh, P(None, None, "tp", None, None))
+        return NamedSharding(self.mesh, P())
+
     def init_cache(self, max_slots, max_len=None):
         """Allocate the slot-based KV slab: two arrays (keys, values) of
         shape ``[max_slots, n_layers, n_heads, max_len, head_dim]`` in the
-        compute dtype, zeroed, replicated on the model's mesh. Slot
-        contents are garbage until a `prefill` claims the slot; reads are
-        always masked by the slot's current length, so stale rows from a
-        previous occupant are never attended."""
+        compute dtype, zeroed, laid out per :meth:`_slab_sharding` on the
+        model's mesh (heads over 'tp' when present — the slab stops being
+        replicated under `MXNET_SPMD=tp=K`). Slot contents are garbage
+        until a `prefill` claims the slot; reads are always masked by the
+        slot's current length, so stale rows from a previous occupant are
+        never attended."""
         c = self.cfg
         max_len = c.max_len if max_len is None else int(max_len)
         if max_len > c.max_len:
@@ -343,10 +364,10 @@ class TransformerLM:
                              f"positional range {c.max_len}")
         hd = c.d_model // c.n_heads
         shape = (int(max_slots), c.n_layers, c.n_heads, max_len, hd)
-        repl = NamedSharding(self.mesh, P())
+        sh = self._slab_sharding()
         dt = jnp.dtype(c.dtype)
-        return (jax.device_put(jnp.zeros(shape, dt), repl),
-                jax.device_put(jnp.zeros(shape, dt), repl))
+        return (jax.device_put(jnp.zeros(shape, dt), sh),
+                jax.device_put(jnp.zeros(shape, dt), sh))
 
     def _head(self, params):
         return (params["embed"].T if self.cfg.tie_embeddings
@@ -416,7 +437,9 @@ class TransformerLM:
         h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
         last = lax.dynamic_slice_in_dim(h, length - 1, 1, axis=0)    # [1,D]
         logits = (last @ self._head(params).astype(dt)).astype(jnp.float32)
-        return logits[0], cache_k, cache_v
+        sh = self._slab_sharding()
+        return (logits[0], sharding_constraint(cache_k, sh),
+                sharding_constraint(cache_v, sh))
 
     def prefill_at(self, params, cache_k, cache_v, tokens, length, slot,
                    offset):
@@ -499,7 +522,9 @@ class TransformerLM:
         h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
         last = lax.dynamic_slice_in_dim(h, length - 1, 1, axis=0)    # [1,D]
         logits = (last @ self._head(params).astype(dt)).astype(jnp.float32)
-        return logits[0], cache_k, cache_v
+        sh = self._slab_sharding()
+        return (logits[0], sharding_constraint(cache_k, sh),
+                sharding_constraint(cache_v, sh))
 
     def decode_step(self, params, cache_k, cache_v, tokens, positions):
         """One fused incremental step over the WHOLE slot slab: each slot
@@ -574,7 +599,9 @@ class TransformerLM:
                 h = h + ff @ params[f"l{i}.w2"] + params[f"l{i}.b2"].astype(dt)
         h = self._ln(h, params["ln_f_scale"], params["ln_f_bias"])
         logits = (h @ self._head(params).astype(dt)).astype(jnp.float32)
-        return logits, cache_k, cache_v
+        sh = self._slab_sharding()
+        return (logits, sharding_constraint(cache_k, sh),
+                sharding_constraint(cache_v, sh))
 
     def verify_step(self, params, cache_k, cache_v, tokens, positions):
         """Speculative-decoding verify: advance every slot by ``K = k + 1``

@@ -74,6 +74,8 @@ class Module(BaseModule):
         self._zero1_failed = False  # zero1 trace failed — stay replicated
         self._pipeline = None  # GPipe schedule ctx (MXNET_PIPELINE_STAGES)
         self._pipeline_failed = False  # plan/trace failed — stay unpipelined
+        self._spmd = None  # SPMD sharding plan (MXNET_SPMD)
+        self._spmd_failed = False  # plan/trace failed — stay replicated
 
     # -- properties ----------------------------------------------------------
 
@@ -423,19 +425,62 @@ class Module(BaseModule):
             return False
         feed = self._make_feed(data_batch)
         self._exec.set_args(**feed)
+        # SPMD one-mesh composition: when MXNET_SPMD is set, the schedule
+        # and the sharding plan must share ONE device assignment — resolve
+        # the spec's mesh up front and hand it to the pipeline planner
+        spmd_mesh_hint = None
+        if not self._spmd_failed:
+            from ..parallel.spmd import SpmdFallback, spmd_enabled, spmd_mesh
+
+            if spmd_enabled():
+                try:
+                    spmd_mesh_hint = spmd_mesh()
+                except SpmdFallback as e:
+                    self._spmd_failed = True
+                    self.logger.warning(
+                        "SPMD sharding unavailable (%s); using the "
+                        "replicated fused step", e)
         pl = None
         if not self._pipeline_failed:
             from ..parallel.pipeline import (PipelineContext,
                                              PipelineFallback,
                                              pipeline_enabled)
+            from ..parallel import mesh as _mesh_mod
 
             if pipeline_enabled():
+                pp_mesh_arg = None
+                if spmd_mesh_hint is not None:
+                    S = int(getenv("MXNET_PIPELINE_STAGES") or 0)
+                    pp_sz = _mesh_mod.axis_size(spmd_mesh_hint,
+                                                _mesh_mod.AXIS_PP)
+                    if pp_sz == S:
+                        pp_mesh_arg = spmd_mesh_hint
+                    else:
+                        # the schedule and the sharding plan must share
+                        # ONE mesh; an MXNET_SPMD spec whose pp axis is
+                        # absent or mismatched drops the SPMD plan (the
+                        # pipeline keeps its own mesh) rather than
+                        # putting two meshes in one program
+                        self._spmd_failed = True
+                        spmd_mesh_hint = None
+                        if self._spmd is not None:
+                            # an earlier sharded step placed 1/N buffers;
+                            # the replicated step must not inherit them
+                            self._spmd.unplace(self._exec, self._updater)
+                            self._spmd = None
+                        self.logger.warning(
+                            "MXNET_SPMD mesh has pp=%d but "
+                            "MXNET_PIPELINE_STAGES=%d; using the "
+                            "replicated fused step under the pipeline "
+                            "schedule", pp_sz, S)
                 if self._pipeline is None or \
-                        not self._pipeline.matches(self._exec):
+                        not self._pipeline.matches(self._exec) or \
+                        (pp_mesh_arg is not None
+                         and self._pipeline.mesh is not pp_mesh_arg):
                     try:
                         self._pipeline = PipelineContext.build(
                             self._symbol, self._exec, self._data_names,
-                            self._label_names)
+                            self._label_names, mesh=pp_mesh_arg)
                     except Exception as e:  # noqa: BLE001 — a plan
                         # failure is PipelineFallback, but bad env (e.g.
                         # a malformed MXNET_MESH_SHAPE the unpipelined
@@ -451,29 +496,57 @@ class Module(BaseModule):
                 pl = self._pipeline
             elif self._pipeline is not None:
                 self._pipeline = None  # gate flipped off between fits
+        sp = None
+        if not self._spmd_failed and spmd_mesh_hint is not None:
+            from ..parallel.spmd import SpmdContext, SpmdFallback
+
+            pl_active = pl is not None
+            if self._spmd is not None and \
+                    not self._spmd.matches(self._exec,
+                                           pipeline_active=pl_active):
+                self._spmd = None
+            if self._spmd is None:
+                try:
+                    self._spmd = SpmdContext.build(
+                        self._symbol, self._exec, self._data_names,
+                        self._label_names, pipeline=pl_active)
+                except Exception as e:  # noqa: BLE001 — a plan failure
+                    # is SpmdFallback, but bad env/graph edge cases must
+                    # take the same graceful replicated fallback
+                    self._spmd_failed = True
+                    self.logger.warning(
+                        "SPMD sharding plan unavailable (%s); using the "
+                        "replicated fused step",
+                        e if isinstance(e, SpmdFallback) else repr(e))
+            sp = self._spmd
+        elif self._spmd is not None:
+            # gate flipped off (or the spec went unsatisfiable) between
+            # fits: re-replicate the placed buffers so the replicated
+            # step sees the layouts it would without the gate
+            self._spmd.unplace(self._exec, self._updater)
+            self._spmd = None
         z1 = None
         if not self._zero1_failed:
             from ..parallel.zero1 import zero1_enabled
 
+            # the update must shard over the SAME mesh as the schedule/
+            # sharding plan — two meshes in one program would conflict
+            shared_mesh = pl.mesh if pl is not None else (
+                sp.mesh if sp is not None else None)
             if zero1_enabled():
-                if self._zero1 is not None and pl is not None and \
-                        self._zero1.mesh is not pl.mesh:
-                    # a pipeline context appeared (or was rebuilt) after
-                    # this ctx was created on another mesh — the update
-                    # must shard over the SAME mesh as the schedule.
-                    # Gather the live shards first (they are the only
-                    # copy), then rebuild on the pipeline's mesh below.
+                if self._zero1 is not None and shared_mesh is not None and \
+                        self._zero1.mesh is not shared_mesh:
+                    # a pipeline/spmd context appeared (or was rebuilt)
+                    # after this ctx was created on another mesh. Gather
+                    # the live shards first (they are the only copy),
+                    # then rebuild on the shared mesh below.
                     self._zero1.export_to_updater(self._updater)
                     self._zero1 = None
                 if self._zero1 is None:
                     from ..parallel.zero1 import Zero1Context
 
                     try:
-                        # under a pipeline schedule the update shards over
-                        # the SAME mesh (its pp axis is the shard group) —
-                        # two meshes in one program would conflict
-                        self._zero1 = Zero1Context(
-                            mesh=pl.mesh if pl is not None else None)
+                        self._zero1 = Zero1Context(mesh=shared_mesh)
                     except Exception as e:  # noqa: BLE001 — bad mesh/env
                         # (e.g. MXNET_ZERO1_NDEV > device count): same
                         # graceful fallback as the Updater path
@@ -516,15 +589,28 @@ class Module(BaseModule):
             self._exec.fused_step(self._optimizer, self._updater,
                                   self._param_names,
                                   grad_sync_fn=gs_fn, grad_sync_key=gs_key,
-                                  zero1=z1, pipeline=pl)
+                                  zero1=z1, pipeline=pl, spmd=sp)
         except MXNetError:
             raise  # donation failure / graph error the eager path shares
         except Exception as e:
-            # blame order when both are active: drop ZeRO-1 FIRST (the
-            # pre-existing fallback precedence) and retry with the
-            # pipeline still on — a zero1-side trace failure must not
-            # cost the pipeline too; if the schedule was the real culprit
-            # the retried step fails again and lands in the branch below
+            # blame order when several are active: drop ZeRO-1 FIRST (the
+            # pre-existing fallback precedence), then the SPMD plan, then
+            # the pipeline schedule — each retry keeps the outer features
+            # on; if one of those was the real culprit the retried step
+            # fails again and lands in the next branch down
+            if sp is not None and z1 is None:
+                # the sharded step failed to trace/compile with buffers
+                # intact (counts already restored): retry THIS step
+                # replicated (still fused) and stay replicated from now on
+                self._spmd_failed = True
+                self._spmd = None
+                # the replicated retry must see replicated buffers — a
+                # failed sharded attempt must not leave 1/N layouts behind
+                sp.unplace(self._exec, self._updater)
+                self.logger.warning(
+                    "SPMD sharded step failed to build (%r); falling "
+                    "back to the replicated fused step", e)
+                return self.fused_step(data_batch)
             if pl is not None and z1 is None:
                 # the schedule failed to trace/compile with buffers intact
                 # (counts already restored): retry THIS step unpipelined

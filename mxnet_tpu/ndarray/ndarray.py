@@ -456,9 +456,18 @@ class NDArray:
     # -- indexing -----------------------------------------------------------
 
     def __getitem__(self, key):
-        key = _convert_index(key)
         from .. import autograd
 
+        if not autograd.is_recording():
+            # lazy capture (MXNET_LAZY=1): basic int/slice reads record a
+            # `slice` node into the pending segment instead of forcing a
+            # flush — optimizer/eval code that slices mid-loop keeps its
+            # whole segment fused (ROADMAP lazy item; segments-unchanged
+            # + bit-parity pinned by test_lazy.py)
+            lazied = self._lazy_basic_getitem(key)
+            if lazied is not None:
+                return lazied
+        key = _convert_index(key)
         if autograd.is_recording():
             # recorded read: gradients must flow through slicing
             # (`ops/indexing._ag_getitem`; scatter-add back into the
@@ -469,10 +478,68 @@ class NDArray:
         out = self._data[key]
         return NDArray(out, self._ctx)
 
+    def _basic_slice_key(self, key):
+        """Normalize a basic int/slice key into (begin, end, step,
+        int_axes) over explicit leading axes, or None for anything the
+        slice/scatter ops cannot express statically (arrays, bools,
+        Ellipsis, newaxis, negative steps)."""
+        keys = key if isinstance(key, tuple) else (key,)
+        if len(keys) > self.ndim or not all(
+                isinstance(k, (slice, int, _np.integer))
+                and not isinstance(k, (bool, _np.bool_)) for k in keys):
+            # bools subclass int but mean mask/new-axis semantics, not a
+            # position — they (and arrays/Ellipsis/None) stay eager
+            return None
+        begin, end, step, int_axes = [], [], [], []
+        for d, k in enumerate(keys):
+            if isinstance(k, (int, _np.integer)):
+                k = int(k)
+                if k < 0:
+                    k += self.shape[d]
+                if not 0 <= k < self.shape[d]:
+                    return None  # out of range: the eager path raises
+                begin.append(k); end.append(k + 1); step.append(1)
+                int_axes.append(d)
+            else:
+                if k.step is not None and int(k.step) < 0:
+                    return None  # negative-step windows stay eager
+                # resolve to concrete ints (python slice semantics over
+                # the STATIC shape) — the slice/scatter op attr parsers
+                # take int tuples, not Nones
+                b, e, s = k.indices(self.shape[d])
+                begin.append(b); end.append(e); step.append(s)
+        return tuple(begin), tuple(end), tuple(step), tuple(int_axes)
+
+    def _lazy_basic_getitem(self, key):
+        """The captured rendering of a basic read: `slice` (+ `reshape`
+        to drop integer axes) recorded into the owning segment. Returns
+        None when capture is off or the key is not basic — caller runs
+        the eager path (which flushes a pending segment)."""
+        from ..lazy import graph as _lazy
+
+        if not _lazy.enabled():
+            return None
+        basic = self._basic_slice_key(key)
+        if basic is None:
+            return None
+        begin, end, step, int_axes = basic
+        from .register import invoke_nd
+
+        if int_axes and len(int_axes) == self.ndim:
+            return None  # scalar read — about to escape anyway; stay eager
+        out = invoke_nd("slice", self, begin=begin, end=end, step=step)
+        if int_axes:
+            shape = tuple(s for d, s in enumerate(out.shape)
+                          if d not in set(int_axes))
+            out = invoke_nd("reshape", out, shape=shape)
+        return out
+
     def __setitem__(self, key, value):
         from .. import autograd
 
         if autograd.is_recording() and self._recorded_setitem(key, value):
+            return
+        if not autograd.is_recording() and self._lazy_basic_setitem(key, value):
             return
         if isinstance(value, NDArray):
             value = value._data
@@ -483,6 +550,41 @@ class NDArray:
             return
         key = _convert_index(key)
         self._data = self._data.at[key].set(value.astype(self.dtype) if hasattr(value, "astype") else value)
+
+    def _lazy_basic_setitem(self, key, value):
+        """The captured rendering of a basic write: `_slice_assign(_scalar)`
+        recorded into the pending segment, the result's buffer swapped in
+        (the swap IS the version bump — nodes that recorded the old value
+        keep referencing it). Returns False when capture is off / the key
+        or value is not basic — caller runs the eager scatter (which
+        flushes a pending segment)."""
+        from ..lazy import graph as _lazy
+
+        if not _lazy.enabled():
+            return False
+        basic = self._basic_slice_key(key)
+        if basic is None:
+            return False
+        begin, end, step, _int_axes = basic
+        from .register import invoke_nd
+
+        if isinstance(value, numeric_types):
+            out = invoke_nd("_slice_assign_scalar", self, begin=begin,
+                            end=end, step=step, scalar=float(value))
+        else:
+            if not isinstance(value, NDArray):
+                try:
+                    value = NDArray(jnp.asarray(value, dtype=self.dtype),
+                                    self._ctx)
+                except (TypeError, ValueError):
+                    return False
+            out = invoke_nd("_slice_assign", self, value,
+                            begin=begin, end=end, step=step)
+        # share the PENDING buffer (out._buf) instead of reading
+        # out._data — reading it would flush the very segment the write
+        # just joined (the PR 10 out= precedent)
+        self._buf = out._buf
+        return True
 
     def _recorded_setitem(self, key, value):
         """Differentiable sliced write (`nd[a:b] = v` inside autograd.record).
@@ -499,8 +601,11 @@ class NDArray:
         Returns True when the write was handled (basic int/slice keys);
         advanced (array) keys fall back to the raw in-place path."""
         keys = key if isinstance(key, tuple) else (key,)
-        if not all(isinstance(k, (slice, int, _np.integer)) for k in keys) \
+        if not all(isinstance(k, (slice, int, _np.integer))
+                   and not isinstance(k, (bool, _np.bool_)) for k in keys) \
                 or len(keys) > self.ndim:
+            # bools subclass int but mean mask/new-axis semantics, not a
+            # position (the _basic_slice_key guard) — raw path handles them
             return False
         begin, end, step = [], [], []
         for k in keys:

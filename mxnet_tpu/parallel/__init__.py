@@ -37,6 +37,8 @@ from .partition import (
 from .ring_attention import ring_attention, ring_self_attention
 from .pipeline import (pipeline_step, partition_stages, PipelineContext,
                        PipelineFallback, pipeline_enabled)
+from .spmd import (SpmdContext, SpmdFallback, spmd_enabled, spmd_mesh,
+                   model_mesh)
 from .elastic import ElasticRuntime, elastic_enabled
 from .launcher import initialize_from_env
 
@@ -58,6 +60,8 @@ __all__ = [
     "ring_attention", "ring_self_attention",
     "pipeline_step", "partition_stages", "PipelineContext",
     "PipelineFallback", "pipeline_enabled",
+    "SpmdContext", "SpmdFallback", "spmd_enabled", "spmd_mesh",
+    "model_mesh",
     "ElasticRuntime", "elastic_enabled",
     "initialize_from_env",
 ]

@@ -92,7 +92,14 @@ def nbytes_on_device(arr, device=None):
 
 
 def infer_param_sharding(mesh, name, shape, fsdp_min_size=2 ** 16):
-    """Default sharding policy for a parameter:
+    """Shape-only sharding heuristic for ONE parameter (this module's
+    original rule-table companion). The fused-step/serving planner is
+    the GRAPH-AWARE `parallel.spmd.infer_param_sharding` (same policy
+    intent, but it walks the symbol's matmul topology for the Megatron
+    column/row alternation and returns a {name: PartitionSpec} plan) —
+    prefer it whenever a Symbol is available.
+
+    Default sharding policy for a parameter:
 
     * 'tp' in mesh: matmul weights (2-D) split on the output dim for
       column-parallel layers (Megatron-style; rule tables override for

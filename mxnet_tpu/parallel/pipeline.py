@@ -375,6 +375,19 @@ class PipelineContext:
         import zlib
 
         self._sym_crc = zlib.crc32(symbol.tojson().encode())
+        # the schedule's shard_map is manual over EVERY mesh axis but only
+        # 'pp' differentiates the work: compute replicates across the
+        # other axes, and the vjp transpose SUMS those identical
+        # per-coordinate cotangent contributions — gradients come back
+        # scaled by the product of the extra axis sizes. The fused step
+        # divides this back out (exact for power-of-2 meshes). Latent on
+        # pure-pp meshes (factor 1); real for the documented
+        # MXNET_MESH_SHAPE='dp=2,pp=2' composition and every MXNET_SPMD
+        # mesh carrying pp beside fsdp/tp.
+        self.grad_correction = 1
+        for ax, sz in mesh.shape.items():
+            if ax != self.axis:
+                self.grad_correction *= int(sz)
         s, m = plan.num_stages, self.microbatches
         self.bubble_ratio = (s - 1) / (m + s - 1)
         costs = plan.stage_costs
@@ -398,9 +411,12 @@ class PipelineContext:
     # -- construction --------------------------------------------------------
 
     @staticmethod
-    def build(symbol, executor, data_names, label_names):
+    def build(symbol, executor, data_names, label_names, mesh=None):
         """Plan the schedule for a bound executor, or raise
-        :class:`PipelineFallback` with the reason."""
+        :class:`PipelineFallback` with the reason. ``mesh``: an explicit
+        mesh carrying the 'pp' axis (the SPMD context's one-mesh
+        composition — `Module` passes `spmd.mesh` so the schedule and
+        the sharding plan live on the SAME device assignment)."""
         S = int(getenv("MXNET_PIPELINE_STAGES") or 0)
         M = int(getenv("MXNET_PIPELINE_MICROBATCHES") or 0) or 2 * S
         batch_names = tuple(n for n in list(data_names) + list(label_names)
@@ -411,7 +427,13 @@ class PipelineContext:
         if M > B:
             raise PipelineFallback(
                 f"{M} micro-batches but only {B} batch rows")
-        mesh = _resolve_mesh(S)
+        if mesh is not None:
+            if mesh_mod.axis_size(mesh, mesh_mod.AXIS_PP) != S:
+                raise PipelineFallback(
+                    f"explicit mesh {dict(mesh.shape)} does not carry a "
+                    f"'pp' axis of size {S}")
+        else:
+            mesh = _resolve_mesh(S)
         mb = -(-B // M)
         input_specs = {}
         for n in executor._arg_names:
@@ -477,11 +499,20 @@ class PipelineContext:
 
     # -- the traced forward --------------------------------------------------
 
-    def wrap(self, executor):
+    def wrap(self, executor, spmd=None):
         """The pipelined graph function with `Executor._fn(True)`'s
         contract — ``fn(key, args, auxs) -> (outputs, aux_updates)`` — so
         `Executor.fused_step` vjps and composes grad-sync/ZeRO-1/optimizer
-        around it unchanged."""
+        around it unchanged.
+
+        ``spmd`` (a ``parallel.spmd.SpmdContext`` in pipeline mode):
+        placed parameters ENTER the shard_map at their residency specs
+        (each device holds 1/S of the parameter bytes between steps)
+        and are all-gathered just-in-time at the top of the traced
+        schedule — ``lax.all_gather``'s transpose reduce-scatters the
+        accumulated micro-batch gradients straight back to the owning
+        shards. Inside the schedule every mesh axis is manual, so this
+        is residency placement, not propagated compute sharding."""
         from jax.sharding import PartitionSpec as P
 
         plan = self.plan
@@ -497,8 +528,28 @@ class PipelineContext:
                                  if not n.is_variable)
         perm = [(i, (i + 1) % S) for i in range(S)]
         max_flat = plan.max_flat
+        # residency-placed params (SPMD composition): arg position ->
+        # PartitionSpec; gathered once per step at the top of the traced
+        # schedule, NOT per tick (the scan closes over the gathered value)
+        placed = {}
+        if spmd is not None:
+            for pos, nm in enumerate(arg_names):
+                spec = spmd.pp_spec(nm)
+                if spec is not None and pos not in batch_pos:
+                    placed[pos] = spec
 
-        def spmd(key, *args):
+        def _gather_full(x, spec):
+            for d, ax in enumerate(tuple(spec)):
+                if ax is not None:
+                    x = lax.all_gather(x, ax, axis=d, tiled=True)
+            return x
+
+        def sched(key, *args):
+            if placed:
+                args = list(args)
+                for pos, spec in placed.items():
+                    args[pos] = _gather_full(args[pos], spec)
+                args = tuple(args)
             idx = lax.axis_index(axis)
 
             def make_branch(si):
@@ -607,9 +658,10 @@ class PipelineContext:
             return tuple(lax.psum(jnp.where(idx == S - 1, o, 0 * o), axis)
                          for o in outs)
 
-        n_in = 1 + len(arg_names)
-        fn = shard_map(spmd, mesh=self.mesh,
-                       in_specs=(P(),) * n_in,
+        in_specs = (P(),) + tuple(placed.get(i, P())
+                                  for i in range(len(arg_names)))
+        fn = shard_map(sched, mesh=self.mesh,
+                       in_specs=in_specs,
                        out_specs=tuple(P() for _ in out_entries),
                        check_vma=False)
 

@@ -1,0 +1,623 @@
+"""One mesh, one program: full SPMD parameter + activation sharding for
+the fused train step and the serving bind (GSPMD, arXiv:2105.04663).
+
+Everything the parallelism substrate shipped so far shards SOMETHING —
+ZeRO-1 the optimizer update (`parallel/zero1.py`), the GPipe schedule the
+compute-in-time dimension (`parallel/pipeline.py`), grad sync the wire
+(`parallel/grad_sync.py`) — but WEIGHTS stayed fully replicated on every
+device, so no model bigger than one replica's HBM was trainable or
+servable. GSPMD says closing that is one refactor, not four: assign every
+parameter a `PartitionSpec` over ONE mesh with named axes and let XLA's
+SPMD partitioner propagate the layout through the already-jitted step.
+This module is that planner plus the context the executor threads it
+with:
+
+* :func:`infer_param_sharding` — the partition planner. Matmul/conv
+  weights alternate column-/row-parallel over ``tp`` along the graph's
+  topo order (the Megatron pattern: activations stay sharded between a
+  col→row pair, XLA inserts exactly one reduce per block instead of one
+  per matmul); large parameters shard their biggest free dimension over
+  ``fsdp`` (params all-gathered just-in-time inside the step, grads
+  reduce-scattered back — composing with, not duplicating, the ZeRO-1
+  update sharding); everything else replicates.
+* :class:`SpmdContext` — owns the mesh (``MXNET_SPMD=tp=2,fsdp=2``
+  style spec, axis order dp → pp → fsdp → tp so tp rides the shortest
+  ICI hops), the per-parameter specs, batch sharding over ``dp``(+
+  ``fsdp`` when divisible) INSIDE the fused program, placement of the
+  bound buffers (`jax.device_put` once; steady state is a no-op), the
+  in-trace constraints that keep gradients/updated weights/optimizer
+  state at the planned layout (so donation aliases and state bytes
+  follow the weight's 1/N), and the named ``CompileCache("spmd")`` every
+  sharded step compiles under.
+
+Composition:
+
+* **ZeRO-1** — `Zero1Context.traced_update(unpack_shardings=...)`
+  unpacks the updated flat buckets straight back to each parameter's
+  planned sharding instead of replicating, so tp/fsdp weight sharding
+  and dp update sharding live in the same program.
+* **Pipeline** — inside the GPipe ``shard_map`` the mesh axes are
+  manual, so GSPMD cannot propagate; placement there is residency-style:
+  each placed parameter enters the schedule sharded (one mesh axis per
+  dimension, ``pp`` first) and is all-gathered just-in-time at the top
+  of the traced schedule (`lax.all_gather`; its transpose reduce-
+  scatters the gradients back). Each device then HOLDS 1/S of the
+  parameters between steps — the per-stage weight-placement memory
+  claim — while the schedule's compute stays per-device.
+* **Serving** — `place_params` is reused by `serving.Predictor` (bound
+  weights sharded across the mesh, shared by every bucket executor) and
+  `models.transformer` shards the generation KV slab's heads axis over
+  ``tp`` (`model_mesh` makes `MXNET_SPMD` reach `TransformerLM`).
+
+Gate: ``MXNET_SPMD`` (empty = off). Any plan or trace failure falls back
+to the replicated fused step (`Module._spmd_failed`) — replicated
+execution stays the correctness reference; sharded parity is ulp-level
+(the PR 6 FMA-contraction precedent), rel <= 1e-5 over whole runs
+(pinned by tests/python/unittest/test_spmd.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import telemetry
+from ..base import getenv, register_env
+from . import mesh as mesh_mod
+from .collectives import sharding_constraint
+from .partition import nbytes_on_device
+
+__all__ = ["SpmdContext", "SpmdFallback", "spmd_enabled", "spmd_mesh",
+           "model_mesh", "infer_param_sharding", "parse_spmd_spec"]
+
+register_env("MXNET_SPMD", "",
+             "SPMD parameter+activation sharding spec for the fused step "
+             "and serving bind, as 'axis=size' pairs over dp/pp/fsdp/tp "
+             "(e.g. 'tp=2,fsdp=2'; '-1' once absorbs the rest); empty = "
+             "off (fully-replicated weights, the correctness reference). "
+             "Plan/trace failures auto-fall back to the replicated step")
+register_env("MXNET_SPMD_FSDP_MIN_SIZE", 65536,
+             "smallest parameter (elements) the 'fsdp' axis shards; "
+             "smaller ones replicate (gather overhead beats the bytes)")
+
+_MATMUL_OPS = ("FullyConnected", "Convolution")
+
+
+class SpmdFallback(Exception):
+    """The spec/graph cannot run the sharded step; the caller should use
+    the replicated fused step. Carries the reason — Module logs it once."""
+
+
+def spmd_enabled():
+    return bool(str(getenv("MXNET_SPMD") or "").strip())
+
+
+def parse_spmd_spec(spec=None):
+    """``MXNET_SPMD`` (or an explicit string) -> ordered {axis: size}.
+    Axis order is forced to dp, pp, fsdp, tp (outermost -> innermost:
+    jax.devices() enumeration is torus-contiguous on TPU, so the
+    trailing axis gets the shortest ICI hops — tp innermost)."""
+    spec = str(getenv("MXNET_SPMD") if spec is None else spec).strip()
+    if not spec:
+        return {}
+    axes = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue  # tolerate trailing/doubled commas
+        name, eq, size = part.partition("=")
+        name = name.strip()
+        try:
+            if not eq or not name:
+                raise ValueError
+            axes[name] = int(size)
+        except ValueError:
+            raise SpmdFallback(
+                "MXNET_SPMD: expected 'axis=size' pairs like 'tp=2,fsdp=2'"
+                f", got {part!r} in {spec!r}") from None
+    order = (mesh_mod.AXIS_DP, mesh_mod.AXIS_PP, mesh_mod.AXIS_FSDP,
+             mesh_mod.AXIS_TP)
+    unknown = [a for a in axes if a not in order]
+    if unknown:
+        raise SpmdFallback(
+            f"MXNET_SPMD: unknown axes {unknown} (supported: {list(order)})")
+    return {a: axes[a] for a in order if a in axes}
+
+
+# (spec string, device ids) -> Mesh — matches() consults the mesh per
+# step, and create_mesh is not free; keyed like mesh.default_mesh so a
+# spec edit or device change invalidates instead of silently reusing
+_mesh_memo = {}
+
+
+def spmd_mesh(spec=None, devices=None):
+    """The one mesh of the spec (a fully-fixed shape smaller than the
+    device count takes the FIRST matching devices, like
+    `mesh_from_env`). Raises :class:`SpmdFallback` on an unsatisfiable
+    spec — the caller's cue to stay replicated."""
+    if spec is None and devices is None:
+        key = (str(getenv("MXNET_SPMD") or ""),
+               tuple(d.id for d in jax.devices()))
+        mesh = _mesh_memo.get(key)
+        if mesh is None:
+            mesh = _build_spmd_mesh(None, None)
+            _mesh_memo.clear()  # one live entry: env edits invalidate
+            _mesh_memo[key] = mesh
+        return mesh
+    return _build_spmd_mesh(spec, devices)
+
+
+def _build_spmd_mesh(spec, devices):
+    axes = parse_spmd_spec(spec)
+    if not axes:
+        raise SpmdFallback("MXNET_SPMD is empty")
+    devices = list(devices if devices is not None else jax.devices())
+    if -1 not in axes.values():
+        total = int(np.prod(list(axes.values())))
+        if total > len(devices):
+            raise SpmdFallback(
+                f"MXNET_SPMD={axes} needs {total} devices, "
+                f"only {len(devices)} available")
+        devices = devices[:total]
+    try:
+        return mesh_mod.create_mesh(devices=devices, **axes)
+    except AssertionError as e:
+        raise SpmdFallback(f"MXNET_SPMD mesh unsatisfiable: {e}") from e
+
+
+def model_mesh():
+    """The mesh functional models (`models.transformer.TransformerLM`)
+    bind to by default: the `MXNET_SPMD` mesh when the gate is on (so
+    serving/generation weights and the KV slab shard without plumbing),
+    else the ambient/default mesh. Falls back to `default_mesh` when the
+    spec is unsatisfiable — a model constructor must never die on a bad
+    env var."""
+    if spmd_enabled():
+        try:
+            return spmd_mesh()
+        except SpmdFallback:
+            pass
+    return mesh_mod.default_mesh()
+
+
+# ---------------------------------------------------------------------------
+# The partition planner
+# ---------------------------------------------------------------------------
+
+def _axsz(mesh, ax):
+    return mesh_mod.axis_size(mesh, ax)
+
+
+def _matmul_params(symbol):
+    """Walk the graph in topo order and yield (weight_name, bias_name)
+    per matmul-like node (FullyConnected / Convolution) — the layer
+    sequence the Megatron column/row alternation follows."""
+    from ..symbol.symbol import _topo_order
+
+    out = []
+    for node in _topo_order([n for n, _ in symbol._outputs]):
+        if node.is_variable or node.op not in _MATMUL_OPS:
+            continue
+        w = b = None
+        for child, _oi in node.inputs:
+            if not child.is_variable:
+                continue
+            if child.name.endswith("weight"):
+                w = child.name
+            elif child.name.endswith("bias"):
+                b = child.name
+        if w is not None:
+            out.append((w, b))
+    return out
+
+
+def infer_param_sharding(mesh, symbol, param_shapes, fsdp_min_size=None,
+                         residency_axes=None):
+    """Partition specs for every parameter of ``symbol``:
+    ``{name: PartitionSpec}`` over ``mesh``'s named axes.
+
+    ``param_shapes``: {name: shape} of the bound parameters.
+
+    Default (GSPMD) mode — tp column/row alternation along the topo
+    order of matmul/conv nodes (col: weight dim 0 = the output features,
+    and its bias, over 'tp'; row: weight dim 1 = the input features over
+    'tp', bias replicated — activations stay tp-sharded between the pair
+    and XLA inserts ONE reduce per block), then an fsdp pass sharding
+    the largest still-free divisible dim of every parameter with >=
+    ``fsdp_min_size`` elements (``MXNET_SPMD_FSDP_MIN_SIZE``). A layer
+    whose weight doesn't divide by tp replicates and RESTARTS the
+    alternation (the next matmul is column-parallel again).
+
+    ``residency_axes`` (the pipeline-schedule mode): skip the Megatron
+    alternation — inside the GPipe ``shard_map`` every axis is manual,
+    so sharding is residency-only (params enter sharded, the traced
+    schedule all-gathers them just-in-time). Shard each parameter's
+    largest divisible dims over the given axes in order (one axis per
+    dim, 'pp' first), same ``fsdp_min_size`` floor.
+    """
+    if fsdp_min_size is None:
+        fsdp_min_size = int(getenv("MXNET_SPMD_FSDP_MIN_SIZE"))
+    specs = {name: [None] * len(shape)
+             for name, shape in param_shapes.items()}
+
+    if residency_axes is not None:
+        axes = [a for a in residency_axes if _axsz(mesh, a) > 1]
+        for name, shape in param_shapes.items():
+            if int(np.prod(shape) if shape else 1) < fsdp_min_size:
+                continue
+            parts = specs[name]
+            for ax in axes:
+                n = _axsz(mesh, ax)
+                # largest still-free dim divisible by this axis
+                cand = [d for d in range(len(shape))
+                        if parts[d] is None and shape[d] % n == 0
+                        and shape[d] >= n]
+                if not cand:
+                    continue
+                parts[max(cand, key=lambda d: shape[d])] = ax
+        return {n: P(*p) for n, p in specs.items()}
+
+    tp = _axsz(mesh, mesh_mod.AXIS_TP)
+    if tp > 1:
+        col = True  # alternation state: column-parallel first
+        for w, b in _matmul_params(symbol):
+            shape = param_shapes.get(w)
+            if shape is None or len(shape) < 2:
+                continue
+            dim = 0 if col else 1
+            if shape[dim] % tp != 0:
+                col = True  # broken chain: restart the alternation
+                continue
+            specs[w][dim] = mesh_mod.AXIS_TP
+            if col and b is not None and b in param_shapes and \
+                    param_shapes[b] and param_shapes[b][0] % tp == 0:
+                # column-parallel bias lives on the sharded output dim
+                specs[b][0] = mesh_mod.AXIS_TP
+            col = not col
+
+    fsdp = _axsz(mesh, mesh_mod.AXIS_FSDP)
+    if fsdp > 1:
+        for name, shape in param_shapes.items():
+            if int(np.prod(shape) if shape else 1) < fsdp_min_size:
+                continue
+            parts = specs[name]
+            cand = [d for d in range(len(shape))
+                    if parts[d] is None and shape[d] % fsdp == 0
+                    and shape[d] >= fsdp]
+            if cand:
+                parts[max(cand, key=lambda d: shape[d])] = \
+                    mesh_mod.AXIS_FSDP
+    return {n: P(*p) for n, p in specs.items()}
+
+
+# ---------------------------------------------------------------------------
+# The context the fused step threads
+# ---------------------------------------------------------------------------
+
+class SpmdContext:
+    """One module's sharding plan: the mesh, per-parameter specs, batch
+    sharding, buffer placement and the in-trace constraints. Owned by
+    `Module` (the `Zero1Context`/`PipelineContext` lifecycle: built
+    lazily at the first fused step, `matches()` re-validated per step,
+    any failure falls back to the replicated fused step)."""
+
+    def __init__(self, mesh, specs, batch_dims, arg_names,
+                 pipeline_mode=False):
+        self.mesh = mesh
+        self.specs = dict(specs)               # param name -> PartitionSpec
+        self.batch_dims = dict(batch_dims)     # batch input name -> spec
+        self.pipeline_mode = bool(pipeline_mode)
+        self._arg_names = tuple(arg_names)
+        self.repl = NamedSharding(mesh, P())
+        self._shardings = {}                   # name -> NamedSharding memo
+        # the named cache every sharded-step executable compiles under —
+        # PER CONTEXT, not process-global (the PipelineContext precedent:
+        # the jitted step closes over the executor, and a global cache
+        # would pin every module it served alive); the monotonic
+        # named_stats("spmd") totals still aggregate across contexts
+        from ..compile_cache import CompileCache
+
+        self.cache = CompileCache("spmd", maxsize=8)
+        # measured (per_device, total) param bytes — the layouts are
+        # invariant per plan, so the addressable_shards walk happens once
+        # (lazily, after the first placed step), not per record_step
+        self._param_bytes = None
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def build(symbol, executor, data_names, label_names, pipeline=False):
+        """Plan the sharding for a bound executor, or raise
+        :class:`SpmdFallback` with the reason."""
+        mesh = spmd_mesh()
+        if all(s <= 1 for s in mesh.shape.values()):
+            raise SpmdFallback("MXNET_SPMD resolves to a 1-device mesh")
+        arg_names = executor._arg_names
+        batch_names = [n for n in list(data_names) + list(label_names)
+                       if n in executor.arg_dict]
+        param_shapes = {n: tuple(executor.arg_dict[n].shape)
+                        for n in arg_names if n not in batch_names}
+        if pipeline:
+            specs = infer_param_sharding(
+                mesh, symbol, param_shapes,
+                residency_axes=(mesh_mod.AXIS_PP, mesh_mod.AXIS_FSDP,
+                                mesh_mod.AXIS_TP))
+        else:
+            specs = infer_param_sharding(mesh, symbol, param_shapes)
+        # batch sharding over dp (+fsdp when divisible) INSIDE the fused
+        # program — the in-program data parallelism that used to exist
+        # only as cross-process grad sync. Pipeline mode keeps the batch
+        # replicated: the schedule's micro-batch split owns that dim.
+        batch_dims = {}
+        if not pipeline:
+            for n in batch_names:
+                shape = tuple(executor.arg_dict[n].shape)
+                axes = []
+                div = 1
+                for ax in (mesh_mod.AXIS_DP, mesh_mod.AXIS_FSDP):
+                    sz = _axsz(mesh, ax)
+                    if sz > 1 and shape and \
+                            shape[0] % (div * sz) == 0:
+                        axes.append(ax)
+                        div *= sz
+                if axes:
+                    parts = [tuple(axes) if len(axes) > 1 else axes[0]]
+                    parts += [None] * (len(shape) - 1)
+                    batch_dims[n] = P(*parts)
+        sharded_any = any(a is not None
+                          for s in specs.values() for a in tuple(s))
+        if not sharded_any and not batch_dims:
+            raise SpmdFallback(
+                "no parameter or batch dimension divides the "
+                f"MXNET_SPMD mesh {dict(mesh.shape)}")
+        ctx = SpmdContext(mesh, specs, batch_dims, arg_names,
+                          pipeline_mode=pipeline)
+        ctx._bound_sig = SpmdContext._exec_sig(executor)
+        return ctx
+
+    @staticmethod
+    def _exec_sig(executor):
+        return tuple((n, tuple(executor.arg_dict[n].shape),
+                      str(executor.arg_dict[n].dtype))
+                     for n in executor._arg_names)
+
+    def matches(self, executor, pipeline_active=False):
+        """Whether this plan still fits the executor's bound layout, the
+        env spec, and the pipeline gate (a pipeline appearing or
+        disappearing flips the planner mode, so the plan rebuilds)."""
+        if bool(pipeline_active) != self.pipeline_mode:
+            return False
+        try:
+            if spmd_mesh() is not self.mesh and \
+                    mesh_mod.devices_key(spmd_mesh()) != \
+                    mesh_mod.devices_key(self.mesh):
+                return False
+        except SpmdFallback:
+            return False
+        try:
+            return SpmdContext._exec_sig(executor) == self._bound_sig
+        except KeyError:
+            return False
+
+    def key(self):
+        """Compile-cache key component: everything that changes the
+        sharded step's layout."""
+        return ("spmd", mesh_mod.devices_key(self.mesh),
+                tuple(sorted((n, tuple(s)) for n, s in self.specs.items())),
+                tuple(sorted((n, tuple(s))
+                             for n, s in self.batch_dims.items())),
+                self.pipeline_mode)
+
+    # -- shardings -----------------------------------------------------------
+
+    def sharding(self, name, shape=None):
+        """The planned NamedSharding of one bound argument (params by
+        spec, batch inputs by batch spec, everything else replicated)."""
+        s = self._shardings.get(name)
+        if s is None:
+            if name in self.specs:
+                spec = self.specs[name]
+            elif name in self.batch_dims:
+                spec = self.batch_dims[name]
+            else:
+                spec = P()
+            s = NamedSharding(self.mesh, spec)
+            self._shardings[name] = s
+        return s
+
+    def pp_spec(self, name):
+        """The residency spec the pipeline schedule gathers from (None
+        for replicated params — they enter the shard_map with P())."""
+        spec = self.specs.get(name)
+        if spec is None or not any(a is not None for a in tuple(spec)):
+            return None
+        return spec
+
+    def put(self, name, x):
+        """Commit one bound argument onto the mesh at its planned
+        sharding. Steady state is a no-op (weights/state come back from
+        the previous step already placed); per-step feeds transfer once
+        here."""
+        arr = x if isinstance(x, jax.Array) or not hasattr(x, "_data") \
+            else x._data
+        tgt = self.sharding(name)
+        try:
+            if getattr(arr, "sharding", None) == tgt:
+                return arr
+        except Exception:  # noqa: BLE001 — fall through to device_put
+            pass
+        return jax.device_put(arr, tgt)
+
+    def put_replicated(self, x):
+        arr = x if isinstance(x, jax.Array) or not hasattr(x, "_data") \
+            else x._data
+        try:
+            if getattr(arr, "sharding", None) == self.repl:
+                return arr
+        except Exception:  # noqa: BLE001
+            pass
+        return jax.device_put(arr, self.repl)
+
+    def place_params(self, names, weights):
+        """One-time physical placement of bound parameter NDArrays (the
+        per-device residency drop to ~1/N happens HERE, before the first
+        sharded step, so donation aliases from step one)."""
+        for n, w in zip(names, weights):
+            w._data = self.put(n, w._data)
+
+    def place_state_trees(self, names, state_trees):
+        """Place per-parameter optimizer-state NDArray leaves at the
+        owning parameter's sharding (a state leaf shaped like the weight
+        shards with it — Adam moments, momentum, fp32 master weights;
+        anything else replicates). Optimizer-state bytes then follow the
+        parameter's 1/N."""
+        for n, st in zip(names, state_trees):
+            if st is None:
+                continue
+            for leaf in _state_nd_leaves(st):
+                tgt = self.sharding(n) \
+                    if tuple(leaf.shape) == self._param_shape(n) \
+                    else self.repl
+                try:
+                    if getattr(leaf._data, "sharding", None) == tgt:
+                        continue
+                except Exception:  # noqa: BLE001
+                    pass
+                leaf._data = jax.device_put(leaf._data, tgt)
+
+    def _param_shape(self, name):
+        sig = getattr(self, "_bound_sig", ())
+        for n, shape, _dt in sig:
+            if n == name:
+                return shape
+        return None
+
+    # -- in-trace constraints ------------------------------------------------
+
+    def constrain(self, name, x):
+        return sharding_constraint(x, self.sharding(name))
+
+    def constrain_grads(self, names, grads):
+        """Pin each gradient to its parameter's layout (with the
+        upstream batch-sharded sum this lowers to the fsdp
+        reduce-scatter; tp grads stay tp-local)."""
+        return tuple(self.constrain(n, g) for n, g in zip(names, grads))
+
+    def constrain_params(self, names, ws):
+        return tuple(self.constrain(n, w) for n, w in zip(names, ws))
+
+    def constrain_state_trees(self, names, state_trees):
+        """Pin updated state leaves to the owning parameter's layout
+        (leaves shaped like the weight; others replicated)."""
+        from jax import tree_util as jtu
+
+        out = []
+        for n, st in zip(names, state_trees):
+            shape = self._param_shape(n)
+
+            def pin(leaf, n=n, shape=shape):
+                if hasattr(leaf, "shape") and tuple(leaf.shape) == shape:
+                    return sharding_constraint(leaf, self.sharding(n))
+                return leaf
+
+            out.append(jtu.tree_map(pin, st))
+        return out
+
+    def param_shardings(self, names):
+        return [self.sharding(n) for n in names]
+
+    def unplace(self, executor, updater=None):
+        """Re-replicate every buffer `place_params`/`place_state_trees`
+        sharded (called on the fallback path: the replicated fused step
+        must see the same layouts it would without the gate — a failed
+        sharded attempt must not leave 1/N buffers behind)."""
+        for nd_ in list(executor.arg_dict.values()) + \
+                list(executor.aux_dict.values()):
+            try:
+                if getattr(nd_._data, "sharding", None) != self.repl:
+                    nd_._data = jax.device_put(nd_._data, self.repl)
+            except Exception:  # noqa: BLE001 — best effort, never fatal
+                pass
+        if updater is not None:
+            for st in updater.states.values():
+                for leaf in _state_nd_leaves(st):
+                    try:
+                        if getattr(leaf._data, "sharding", None) != \
+                                self.repl:
+                            leaf._data = jax.device_put(leaf._data,
+                                                        self.repl)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    # -- accounting ----------------------------------------------------------
+
+    def param_bytes_per_device(self, names, weights):
+        """Measured parameter bytes resident on ONE device (physical
+        shard residency, not the annotation) vs the replicated total."""
+        per_dev = 0
+        total = 0
+        for n, w in zip(names, weights):
+            arr = w._data if hasattr(w, "_data") else w
+            per_dev += nbytes_on_device(arr)
+            total += int(arr.size) * arr.dtype.itemsize
+        return per_dev, total
+
+    def record_step(self, names=None, weights=None):
+        """Per-step telemetry (called by `Executor.fused_step` after a
+        successful sharded dispatch — the gauges re-set here so
+        telemetry enabled mid-run still reports the mesh next to the
+        counters)."""
+        if not telemetry._enabled:
+            return
+        telemetry.counter("spmd.steps").inc()
+        for ax in (mesh_mod.AXIS_DP, mesh_mod.AXIS_TP, mesh_mod.AXIS_FSDP,
+                   mesh_mod.AXIS_PP):
+            telemetry.gauge(f"spmd.{ax}").set(_axsz(self.mesh, ax))
+        if names is not None and weights is not None:
+            if self._param_bytes is None:
+                self._param_bytes = \
+                    self.param_bytes_per_device(names, weights)
+            per_dev, total = self._param_bytes
+            telemetry.gauge("spmd.param_bytes_per_device").set(per_dev)
+            telemetry.gauge("spmd.param_bytes_total").set(total)
+
+
+def place_serving_params(symbol, arg_params, aux_params=None):
+    """Shard a serving checkpoint's bound weights over the `MXNET_SPMD`
+    mesh (the Predictor bind path): plan specs with
+    :func:`infer_param_sharding` and `jax.device_put` each parameter
+    NDArray in place — every bucket executor then binds the SAME sharded
+    buffers, so serving weights stop being replicated (per-device
+    residency ~1/N, measured by the census). Aux states replicate on the
+    mesh. Inference jits pick the layout up from the committed inputs
+    and GSPMD propagates — no executor change needed. Returns
+    ``(mesh, specs)``; raises :class:`SpmdFallback` when the spec is
+    unsatisfiable (caller stays replicated)."""
+    mesh = spmd_mesh()
+    if all(s <= 1 for s in mesh.shape.values()):
+        raise SpmdFallback("MXNET_SPMD resolves to a 1-device mesh")
+    shapes = {n: tuple(a.shape) for n, a in arg_params.items()}
+    specs = infer_param_sharding(mesh, symbol, shapes)
+    repl = NamedSharding(mesh, P())
+    for n, a in arg_params.items():
+        a._data = jax.device_put(a._data, NamedSharding(mesh, specs[n]))
+    for a in (aux_params or {}).values():
+        a._data = jax.device_put(a._data, repl)
+    if telemetry._enabled:
+        per_dev = sum(nbytes_on_device(a._data)
+                      for a in arg_params.values())
+        telemetry.gauge("spmd.serving_param_bytes_per_device").set(per_dev)
+    return mesh, specs
+
+
+def _state_nd_leaves(st):
+    """NDArray leaves of one optimizer-state tree (the
+    `_state_to_jax` structure walk, yielding the mutable wrappers)."""
+    if st is None:
+        return
+    if isinstance(st, (tuple, list)):
+        for x in st:
+            yield from _state_nd_leaves(x)
+    elif hasattr(st, "_data"):
+        yield st

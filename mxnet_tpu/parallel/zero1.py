@@ -396,7 +396,7 @@ class Zero1Context:
         return jnp.repeat(sel, reps, total_repeat_length=plan.nelem)
 
     def traced_update(self, optimizer, params, grads, flat_states,
-                      lrs, wds, rescale):
+                      lrs, wds, rescale, unpack_shardings=None):
         """The sharded weight update, traceable inside the fused step:
         per bucket, pack → constrain grads+weights to the dp-sharded
         layout (with an upstream cross-replica sum this lowers to
@@ -404,26 +404,53 @@ class Zero1Context:
         (the bucket is ONE 'parameter' with vector lr/wd — bit-identical
         element math to the replicated path), constrain updated weights
         back to replicated (AllGather), unpack. Returns
-        ``(new_params_list, new_flat_states)``."""
+        ``(new_params_list, new_flat_states)``.
+
+        ``unpack_shardings`` (aligned with ``params``, from the SPMD
+        context when `MXNET_SPMD` composes with ZeRO-1): each unpacked
+        parameter is constrained to ITS planned layout instead of
+        replicated — the allgather only rebuilds what the tp/fsdp plan
+        keeps on each device, and sharded weights persist at 1/N."""
         from jax import tree_util as jtu
 
         new_params = list(params)
         new_states = []
+
+        def pack(arrs, plan):
+            flat = _pack_flat(arrs, plan)
+            if unpack_shardings is not None:
+                # SPMD composition: the bucket concatenates MIXED-sharded
+                # operands (tp/fsdp params next to replicated biases).
+                # jax 0.4.x's SPMD partitioner miscompiles a concat of
+                # mixed-sharded operands partitioned straight to the flat
+                # dp layout — values interleave by shard stride
+                # (reproduced on 0.4.37; see test_spmd.py). Pinning the
+                # concat result REPLICATED first, then sharding, is the
+                # correct lowering the partitioner does handle; it trades
+                # the fused reduce-scatter for gather+slice on this lane
+                flat = sharding_constraint(flat, self.repl)
+            return sharding_constraint(flat, self.shard)
+
         for bi, plan in enumerate(self.plans):
-            w_flat = sharding_constraint(
-                _pack_flat([params[k] for k in plan.keys], plan), self.shard)
-            g_flat = sharding_constraint(
-                _pack_flat([grads[k] for k in plan.keys], plan), self.shard)
+            w_flat = pack([params[k] for k in plan.keys], plan)
+            g_flat = pack([grads[k] for k in plan.keys], plan)
             lr_vec = self._seg_vec(lrs, plan)
             wd_vec = self._seg_vec(wds, plan)
             new_w, new_s = optimizer.fused_update(
                 [w_flat], [g_flat], [flat_states[bi]],
                 [lr_vec], [wd_vec], rescale)
+            # replicate-first on BOTH lanes: the unpack slices the flat
+            # bucket into per-param pieces, and slicing the dp-sharded
+            # flat straight into mixed target layouts trips the same
+            # partitioner hazard as the pack-side concat
             full = sharding_constraint(new_w[0], self.repl)
             off = 0
             for k, shape, size in zip(plan.keys, plan.shapes, plan.sizes):
-                new_params[k] = full[off:off + size].reshape(shape).astype(
+                new_p = full[off:off + size].reshape(shape).astype(
                     params[k].dtype)
+                if unpack_shardings is not None:
+                    new_p = sharding_constraint(new_p, unpack_shardings[k])
+                new_params[k] = new_p
                 off += size
             new_states.append(jtu.tree_map(
                 lambda a: sharding_constraint(a, self.shard), new_s[0]))

@@ -197,6 +197,29 @@ class Predictor:
                 "not data inputs; pass them in arg_params (weights) or "
                 "label_shapes (dummy label inputs)")
 
+        # SPMD serving bind (MXNET_SPMD, parallel/spmd.py): the bound
+        # weights are sharded IN PLACE over the one mesh before any
+        # bucket executor binds them — every bucket shares the same
+        # 1/N-resident buffers, GSPMD propagates the layout through the
+        # for_training=False jits. Plan failure logs and stays
+        # replicated (the serving twin of Module's _spmd_failed)
+        self._spmd_mesh = None
+        self._spmd_specs = None
+        from ..parallel.spmd import spmd_enabled
+
+        if spmd_enabled():
+            from ..log import get_logger
+            from ..parallel.spmd import place_serving_params
+
+            try:
+                self._spmd_mesh, self._spmd_specs = place_serving_params(
+                    symbol, self._arg_params, self._aux_params)
+            except Exception as e:  # noqa: BLE001 — bad spec/graph must
+                # serve replicated, never fail the bind
+                get_logger("mxnet_tpu.serving").warning(
+                    "SPMD serving bind unavailable (%r); serving "
+                    "replicated weights", e)
+
         self._buckets = bucket_ladder(buckets)
         self._cache = CompileCache("serving")
         self._execs = {}

@@ -324,7 +324,7 @@ class Executor:
 
     def fused_step(self, optimizer, updater, param_names,
                    grad_sync_fn=None, grad_sync_key=None, zero1=None,
-                   pipeline=None):
+                   pipeline=None, spmd=None):
         """ONE training step — forward, backward (ones cotangents, the
         `backward(out_grads=None)` convention), gradient rescale/clip and
         the optimizer update for every parameter — as a single jitted XLA
@@ -374,6 +374,21 @@ class Executor:
         composes unchanged. Pipelined executables compile under the named
         CompileCache("pipeline") so accounting stays pinned per
         (symbol, shapes, stages, microbatches) key.
+
+        ``spmd`` (a ``parallel.spmd.SpmdContext``, from Module when
+        `MXNET_SPMD` is set) shards the program itself per GSPMD: bound
+        weights are committed at their planned PartitionSpecs (tp
+        column/row alternation, fsdp largest-dim — physical per-device
+        residency ~1/N), the batch enters dp(+fsdp)-sharded so data
+        parallelism lives INSIDE the program, gradients / updated
+        weights / optimizer state are constrained to the same layouts
+        (fsdp grads lower to ReduceScatter, state bytes follow the
+        weight's 1/N), and XLA's SPMD partitioner propagates the rest —
+        forward AND backward are sharded, not just the update. Composes
+        with ``zero1`` (the flat update unpacks straight back to the
+        planned layouts) and ``pipeline`` (residency placement gathered
+        just-in-time inside the schedule). Sharded steps compile under
+        the context's named CompileCache("spmd").
         """
         from .. import random as _random
         from ..ndarray import NDArray
@@ -388,6 +403,11 @@ class Executor:
         names = [n for _, n in upd]
         name_set = set(names)
         weights = [self.arg_dict[n] for n in names]
+        if spmd is not None:
+            # one-time physical placement: the bound weight buffers drop
+            # to their planned 1/N residency HERE, so the first sharded
+            # step already aliases its donated inputs
+            spmd.place_params(names, weights)
         if zero1 is not None:
             # sharded state lives in the context (1/N per replica); the
             # per-parameter updater states are not materialized
@@ -400,6 +420,10 @@ class Executor:
         lrs, wds = optimizer._fused_hyperparams(indices)
         if zero1 is None:
             states = [updater.states[i] for i in indices]
+            if spmd is not None:
+                # state leaves shaped like the weight shard with it —
+                # per-device optimizer-state bytes follow the same 1/N
+                spmd.place_state_trees(names, states)
             state_sig = tuple(_state_sig(s) for s in states)
             states_arg = [_state_to_jax(s) for s in states]
         else:
@@ -419,10 +443,11 @@ class Executor:
                state_sig,
                optimizer._fused_static_key(),
                grad_sync_key,
-               pipeline.key() if pipeline is not None else None)
+               pipeline.key() if pipeline is not None else None,
+               spmd.key() if spmd is not None else None)
 
         def build():
-            base = pipeline.wrap(self) if pipeline is not None \
+            base = pipeline.wrap(self, spmd=spmd) if pipeline is not None \
                 else self._fn(True)
             arg_pos = {n: i for i, n in enumerate(self._arg_names)}
             param_pos = [arg_pos[n] for n in names]
@@ -448,21 +473,42 @@ class Executor:
                 outputs, vjp, aux_new = jax.vjp(f, *params, has_aux=True)
                 cts = tuple(jnp.ones(o.shape, o.dtype) for o in outputs)
                 grads = vjp(cts)
+                if pipeline is not None and \
+                        getattr(pipeline, "grad_correction", 1) > 1:
+                    # undo the shard_map replication over non-pp mesh
+                    # axes (PipelineContext.grad_correction): the vjp
+                    # transpose summed identical per-coordinate copies
+                    inv = 1.0 / pipeline.grad_correction
+                    grads = tuple(g * jnp.asarray(inv, g.dtype)
+                                  for g in grads)
                 if grad_sync_fn is not None:
                     # cross-replica gradient sync traced into the step
                     # (bucketed flat psum — KVStore.fused_grad_sync_fn)
                     grads = grad_sync_fn(tuple(grads))
+                if spmd is not None:
+                    # pin gradients to the planned weight layouts: with
+                    # the batch-sharded sum upstream the fsdp constraint
+                    # lowers to ReduceScatter (parallel/spmd.py)
+                    grads = spmd.constrain_grads(names, grads)
                 if zero1 is not None:
                     # sharded weight update: grads constrained to the
                     # dp-sharded flat buckets (sum+constraint lowers to
                     # ReduceScatter), 1/N-shard optimizer step, weights
-                    # allgathered back replicated (parallel/zero1.py)
+                    # allgathered back replicated — or straight back to
+                    # the spmd layouts when both compose
                     new_ws, new_ss = zero1.traced_update(
                         opt, list(params), list(grads), ss,
-                        lrs_, wds_, rescale)
+                        lrs_, wds_, rescale,
+                        unpack_shardings=(spmd.param_shardings(names)
+                                          if spmd is not None else None))
                 else:
                     new_ws, new_ss = opt.fused_update(
                         list(params), list(grads), ss, lrs_, wds_, rescale)
+                    if spmd is not None:
+                        # updated weights/state persist at the planned
+                        # layouts: donation aliases, residency stays 1/N
+                        new_ws = spmd.constrain_params(names, new_ws)
+                        new_ss = spmd.constrain_state_trees(names, new_ss)
                 return outputs, tuple(new_ws), new_ss, aux_new
 
             return jax.jit(step, donate_argnums=(1, 3, 4))
@@ -470,15 +516,35 @@ class Executor:
         # persistent=False: donated programs must stay OUT of the on-disk
         # XLA cache (deserialized aliasing corrupts the heap — see
         # CompileCache.get_or_build). Pipelined steps compile under the
-        # named "pipeline" cache so per-config accounting is assertable.
-        cache = pipeline.cache if pipeline is not None else self._cache
+        # named "pipeline" cache, sharded ones under "spmd" (spmd wins
+        # when both compose), so per-config accounting is assertable.
+        if spmd is not None:
+            cache = spmd.cache
+        elif pipeline is not None:
+            cache = pipeline.cache
+        else:
+            cache = self._cache
         fn = cache.get_or_build(("fused_step", sig), build,
                                 persistent=False)
         call_args = [key, params, others, auxs, states_arg,
                      jnp.asarray(lrs, jnp.float32),
                      jnp.asarray(wds, jnp.float32),
                      jnp.float32(optimizer.rescale_grad)]
-        if zero1 is not None:
+        if spmd is not None:
+            # params/feeds/state onto the mesh at their PLANNED layouts
+            # (steady state is a no-op — they come back placed); the
+            # zero1 flat state is already dp-sharded and rides untouched
+            call_args[1] = tuple(spmd.put(n, a)
+                                 for n, a in zip(names, params))
+            call_args[2] = tuple(spmd.put(n, a)
+                                 for n, a in zip(other_names, others))
+            call_args[3] = tuple(spmd.put_replicated(a) for a in auxs)
+            # (non-zero1 state leaves were already device_put at the
+            # weight's layout by place_state_trees above)
+            for i in (0, 5, 6, 7):
+                call_args[i] = jax.tree_util.tree_map(spmd.put_replicated,
+                                                      call_args[i])
+        elif zero1 is not None:
             # everything but the (already-sharded) state enters the mesh
             # replicated; steady state is a no-op for weights/aux (they
             # come back replicated), feeds broadcast here once per step
@@ -534,6 +600,8 @@ class Executor:
         self.outputs = [NDArray(o) for o in outputs]
         if pipeline is not None:
             pipeline.record_step()
+        if spmd is not None:
+            spmd.record_step(names, weights)
         return self.outputs
 
     def copy_params_from(self, arg_params, aux_params=None,

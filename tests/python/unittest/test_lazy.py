@@ -229,6 +229,86 @@ def test_metadata_queries_do_not_flush(lazy):
     assert lazy_graph.pending_ops() == 0
 
 
+def test_basic_slicing_captures_without_flush(lazy):
+    """Basic int/slice `__getitem__`/`__setitem__` record slice/scatter
+    nodes into the pending segment instead of forcing a flush (the
+    ROADMAP lazy item): the segment keeps growing across reads AND
+    writes, and only a concrete-value escape materializes it."""
+    x = _x((4, 6))
+    y = x * 2.0 + 1.0
+    n0 = lazy_graph.pending_ops()
+    s = y[1:3]             # basic slice read: slice node, no flush
+    row = y[1]             # int axis: slice + reshape nodes, no flush
+    assert lazy_graph.pending_ops() > n0, "slice read flushed the segment"
+    assert type(s._buf).__name__ == "LazyArray"
+    n1 = lazy_graph.pending_ops()
+    y[0:2] = 5.0           # scalar window write: scatter node, no flush
+    y[2:3] = x[0:1]        # tensor window write: scatter node, no flush
+    assert lazy_graph.pending_ops() > n1, "slice write flushed the segment"
+    assert type(y._buf).__name__ == "LazyArray"
+    z = s + row
+    z.asnumpy()
+    y.asnumpy()
+    assert lazy_graph.pending_ops() == 0
+
+
+def test_basic_slicing_bit_parity_vs_eager():
+    """The captured slice/scatter rendering is BIT-EXACT vs the eager
+    jnp indexing path — reads (slices, int axes, strides, negatives),
+    writes (scalar/tensor windows) and the values computed from them."""
+    def chain():
+        x = _x((4, 6), seed=3)
+        a = x[1:3]
+        b = x[2]
+        c = x[::2, 1:5:2]
+        d = x[-1]
+        x[0:2] = 5.0
+        x[2:3] = a[0:1]
+        x[1, 2:4] = -1.5
+        return [a, b, c, d, x, a + b, (c * 2.0).relu()]
+
+    lazy_out = _run(chain, True)
+    eager_out = _run(chain, False)
+    for i, (l, e) in enumerate(zip(lazy_out, eager_out)):
+        np.testing.assert_array_equal(l, e, err_msg=f"output {i}")
+
+
+def test_advanced_indexing_still_escapes(lazy):
+    """Array keys / unsupported patterns keep the eager semantics (and
+    flush) — the capture only claims basic int/slice keys."""
+    x = _x((4, 6))
+    y = x + 1.0
+    idx = np.array([0, 2])
+    got = y[idx]                       # numpy fancy index: eager path
+    assert got.shape == (2, 6)
+    ref = (np.asarray(x.asnumpy()) + 1.0)[idx]
+    np.testing.assert_array_equal(got.asnumpy(), ref)
+
+
+def test_bool_keys_keep_eager_semantics(lazy):
+    """REGRESSION: bool subclasses int, but `y[True]` is new-axis/mask
+    semantics, not position 1 — the capture must refuse bool keys (a
+    captured int-1 read returned the wrong row; a captured `z[False] =
+    v` overwrote row 0 instead of writing nothing)."""
+    x = _x((4, 6))
+    y = x + 0.0
+    got = y[True]
+    assert got.shape == (1, 4, 6), got.shape  # eager new-axis semantics
+    z = x + 0.0
+    before = z.asnumpy().copy()
+    z[False] = 9.0                      # empty mask: writes nothing
+    np.testing.assert_array_equal(z.asnumpy(), before)
+    # same guard on the autograd-recorded fast path (_recorded_setitem)
+    r = _x((3, 4))
+    r.attach_grad()
+    with autograd.record():
+        ref = r.asnumpy().copy()
+        r[False] = 9.0
+        np.testing.assert_array_equal(r.asnumpy(), ref)
+        r[True] = 7.0
+        assert (r.asnumpy() == 7.0).all()
+
+
 def test_every_value_escape_flushes(lazy):
     def fresh():
         return (_x((2, 2)) + 1.0) * 2.0

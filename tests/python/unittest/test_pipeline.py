@@ -181,6 +181,25 @@ def test_grad_accumulation_uneven_microbatches():
     _assert_parity(ref, got, what="uneven M=3 over B=8")
 
 
+def test_parity_on_multi_axis_mesh():
+    """REGRESSION (latent until the SPMD PR): on a mesh with an extra
+    axis beside 'pp' (the documented `MXNET_MESH_SHAPE='dp=2,pp=2'`
+    composition) the schedule's shard_map replicates compute over the
+    extra axis and the vjp transpose SUMS the identical per-coordinate
+    cotangents — gradients came back scaled by the extra axis product.
+    `PipelineContext.grad_correction` divides it back out; parity must
+    hold on the 2-axis mesh."""
+    import jax
+
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    _, ref = _fit(0)
+    m, got = _fit(2, 4, MXNET_MESH_SHAPE="dp=2,pp=2")
+    assert m._pipeline is not None and not m._pipeline_failed
+    assert m._pipeline.grad_correction == 2
+    _assert_parity(ref, got, what="pipeline on dp=2,pp=2 mesh")
+
+
 def test_parity_composed_with_zero1():
     """ZeRO-1 shards the update over the pipeline's own mesh axis (one
     mesh per program); parity must hold with both engaged."""

@@ -49,6 +49,10 @@ METRICS = [
      "generation accepted toks/tick", "up"),
     ("generation.spec_vs_plain", "generation spec/plain speedup", "up"),
     ("lazy.lazy_vs_eager", "lazy/eager speedup", "up"),
+    ("spmd.spmd_vs_replicated", "spmd/replicated step speedup", "up"),
+    ("spmd.param_bytes_ratio", "spmd param bytes ratio (1/N)", "down"),
+    ("spmd.parity_rel", "spmd whole-run parity rel", "down"),
+    ("spmd.cold_compile_s", "spmd cold compile s", "down"),
     ("framework_module_compile_s", "module compile s", "down"),
 ]
 
@@ -61,6 +65,7 @@ INVARIANTS = [
     ("generation.prefix_steady_state_compiles",
      "prefix-cache steady-state compiles"),
     ("lazy.steady_state_compiles", "lazy steady-state compiles"),
+    ("spmd.steady_state_compiles", "spmd steady-state compiles"),
 ]
 
 

@@ -174,6 +174,23 @@ def main(argv=None):
         line += ("\n  (high bubble = raise MXNET_PIPELINE_MICROBATCHES - "
                  "docs/faq/perf.md \"Choosing micro-batch count\")\n")
         sys.stdout.write(line)
+    spmd_steps = counters.get("spmd.steps", 0)
+    if spmd_steps:
+        gauges = snap.get("gauges", {})
+        mesh = "x".join(
+            f"{ax}={gauges.get(f'spmd.{ax}', 1):.0f}"
+            for ax in ("dp", "pp", "fsdp", "tp")
+            if gauges.get(f"spmd.{ax}", 1) > 1) or "1-device"
+        line = f"\nspmd: {spmd_steps} sharded steps on mesh {mesh}"
+        per_dev = gauges.get("spmd.param_bytes_per_device")
+        total = gauges.get("spmd.param_bytes_total")
+        if per_dev is not None and total:
+            line += (f", param bytes/device {per_dev / 1e6:.2f} MB of "
+                     f"{total / 1e6:.2f} MB total "
+                     f"(ratio {per_dev / max(total, 1):.3f})")
+        line += ("\n  (ratio should track 1/N of the sharded axes - "
+                 "docs/faq/perf.md \"One mesh, one program\")\n")
+        sys.stdout.write(line)
     gauges = snap.get("gauges", {})
     slo_keys = sorted({k[len("slo."):-len(".ok")]
                        for k in gauges if k.startswith("slo.")
