@@ -905,10 +905,26 @@ def _measure_spmd(on_tpu):
                 total += int(a.size) * a.dtype.itemsize
             arg_p, _ = m.get_params()
             steady = sorted(times)[len(times) // 2]
+            inventory = None
+            if spmd_spec:
+                # hlolint collective inventory of the COMPILED sharded
+                # step (AOT re-lower while the per-context cache is
+                # alive) — tools/bench_compare.py treats per-step
+                # collective bytes growing >10% at the same mesh spec as
+                # a hard regression
+                from mxnet_tpu import analysis
+
+                inv = analysis.cache_inventory("spmd")
+                inventory = {
+                    "mesh": spmd_spec,
+                    "collective_bytes": inv["collective_bytes"],
+                    "collectives": {k: v["bytes"]
+                                    for k, v in inv["collectives"].items()},
+                }
             return ({k: v.asnumpy() for k, v in arg_p.items()}, steady,
                     per_dev, total, cold_s,
                     warm0["compile_seconds"] - cold0["compile_seconds"],
-                    warm1["misses"] - warm0["misses"])
+                    warm1["misses"] - warm0["misses"], inventory)
         finally:
             for k, v in saved.items():
                 if v is None:
@@ -916,9 +932,9 @@ def _measure_spmd(on_tpu):
                 else:
                     os.environ[k] = v
 
-    w_rep, t_rep, _, total, _, _, _ = drive("")
-    w_sh, t_sh, per_dev, total, cold_wall, cold_compile, steady = \
-        drive(spec)
+    w_rep, t_rep, _, total, _, _, _, _ = drive("")
+    w_sh, t_sh, per_dev, total, cold_wall, cold_compile, steady, \
+        inventory = drive(spec)
     assert steady == 0, f"spmd steady state compiled {steady} programs"
     parity = max(float(np.abs(w_sh[k] - w_rep[k]).max() /
                        max(np.abs(w_rep[k]).max(), 1e-8)) for k in w_rep)
@@ -936,6 +952,7 @@ def _measure_spmd(on_tpu):
         "cold_wall_s": round(cold_wall, 3),
         "cold_compile_s": round(cold_compile, 3),
         "steady_state_compiles": steady,
+        "hlolint": inventory,
     }
 
 

@@ -45,6 +45,11 @@ unit() {
   # suite failure it would eventually cause
   log "tpulint gate (framework-invariant static analysis, blocking)"
   python -m tools.tpulint mxnet_tpu tools bench.py --strict
+  # hlolint dump dir: the suites below that warm the audited caches
+  # (serving/generation/zero1/pipeline/lazy/spmd) run with
+  # MXNET_HLOLINT_DUMP set, so each process writes its compiled-program
+  # summaries at exit; the blocking contract gate audits them afterwards
+  hlolint_dump="$(mktemp -d)"
   log "unit suite (includes the 4-process dist kvstore run and CI-guarded examples)"
   python -m pytest tests/python/unittest -q -x \
       --ignore=tests/python/unittest/test_resilience.py \
@@ -89,14 +94,16 @@ unit() {
   # counts (warmup-then-serve must compile zero at steady state), so a
   # batching, admission or warmup regression fails HERE, attributed
   log "serving suite (predictor parity, micro-batching, admission control, warmup compile pinning)"
-  python -m pytest tests/python/unittest/test_serving.py -q
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_serving.py -q
   # generation gate, standalone: these tests spin engine scheduler
   # threads, flip the telemetry registry and pin EXACT generation
   # compile-cache miss counts (continuous batching must never recompile
   # mid-stream) plus continuous-vs-sequential BIT-EXACT token parity — a
   # scheduler, KV-slab or compile-discipline regression fails HERE
   log "generation suite (slot KV-cache sessions, continuous batching parity, streaming deadlines, router)"
-  python -m pytest tests/python/unittest/test_generation.py -q
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_generation.py -q
   # generation-scale gate, standalone: these tests pin spec-vs-plain
   # greedy BIT-EXACT parity, fork isolation (no KV bleed after the
   # source prefix evicts), refcount-safe LRU eviction under slot
@@ -106,13 +113,15 @@ unit() {
   # prefix-cache, draft, verify-lane or fleet-routing regression fails
   # HERE, attributed
   log "generation-scale suite (radix prefix cache + KV forking, speculative decoding, fleet affinity/autoscale)"
-  python -m pytest tests/python/unittest/test_generation_scale.py -q
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_generation_scale.py -q
   # zero1 gate, standalone: these tests flip MXNET_ZERO1/MXNET_ZERO1_NDEV
   # and pin sharding invariance, 1/N state allocation, checkpoint
   # round-trips and exact compile-cache miss counts — a sharded-update
   # regression fails HERE, attributed
   log "ZeRO-1 suite (sharded-vs-replicated update parity, 1/N state, checkpoint round-trip)"
-  python -m pytest tests/python/unittest/test_zero1.py -q
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_zero1.py -q
   # tracing gate, standalone: these tests flip the process-global tracing
   # and telemetry state and assert exact span-tree shapes, so an
   # instrumentation or propagation regression fails HERE, attributed. The
@@ -127,7 +136,8 @@ unit() {
   # miss counts, bubble-ratio math and every fallback trigger — a
   # schedule, partition or masking regression fails HERE, attributed
   log "pipeline suite (GPipe parity, stage balance, compile pinning, fallbacks)"
-  python -m pytest tests/python/unittest/test_pipeline.py -q
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_pipeline.py -q
   # elastic gate, standalone: these tests spin heartbeat/guard threads and
   # the slow case runs 2 REAL workers (tools/launch.py --restart-policy
   # shrink), SIGKILLs one mid-epoch and asserts detection-within-grace,
@@ -144,7 +154,8 @@ unit() {
   # with Monitor attached (the fused step's forced-eager-fallback path)
   # under MXNET_LAZY=1, parity-checked against eager
   log "lazy suite (deferred capture parity, barrier sweep, zero-steady-state compiles, fit+Monitor e2e)"
-  python -m pytest tests/python/unittest/test_lazy.py -q
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_lazy.py -q
   # health gate, standalone: these tests flip the process-global health/
   # telemetry/tracing state, spin engine scheduler threads and the
   # telemetry HTTP endpoint, and drive deterministic watchdog sweeps
@@ -161,7 +172,23 @@ unit() {
   # binds and every fallback trigger — a planner, placement or
   # constraint regression fails HERE, attributed
   log "spmd suite (GSPMD sharding parity, 1/N residency, compositions, serving bind, fallbacks)"
-  python -m pytest tests/python/unittest/test_spmd.py -q
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_spmd.py -q
+  # hlolint gate, BLOCKING: audit the compiled programs the suites above
+  # actually warmed (dumped at each process's exit) against the
+  # checked-in contract registry — donation aliasing (every declared
+  # donation >= the byte floor must carry an input_output_alias),
+  # collective discipline (zero cross-device collectives in a tp=1
+  # decode, no full-bucket all-reduce in a zero1 step, only the declared
+  # kinds elsewhere), and sharding residency (a 1/N plan must be visible
+  # in the compiled input layout). --require fails the gate if a suite
+  # silently stopped warming its cache; --explain prints the offending
+  # executable's collective inventory under each finding
+  log "hlolint gate (compiled-program contract audit over the warmed caches, blocking)"
+  python -m tools.hlolint check "$hlolint_dump" \
+      --require spmd,zero1,pipeline,serving,generation,lazy \
+      --strict --explain
+  rm -rf "$hlolint_dump"
   # analysis gate, standalone: the tpulint rule fixtures (each rule must
   # trip on its positive fixture and stay quiet on the negative) and the
   # MXNET_DEBUG_SYNC lock-order recorder unit tests (ABBA inversion,

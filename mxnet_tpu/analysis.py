@@ -40,6 +40,19 @@ off): the gate is evaluated when a lock is *created* — :func:`make_lock`
 literally nothing, not even a flag check per acquire (pinned by
 ``test_tpulint.py`` in a fresh subprocess). :func:`check_blocking` call
 sites gate on ``analysis._enabled`` (one attribute read) themselves.
+
+Second runtime-analysis half (PR 15): **compiled-program summaries** —
+:func:`program_summary` AOT-lowers a cached executable from its recorded
+aval skeleton and parses the lowered StableHLO + post-optimization HLO
+into a structured record: collective inventory (all-reduce / all-gather /
+reduce-scatter / collective-permute counts and byte volumes), donation
+audit (which ``tf.aliasing_output``-declared arguments actually got
+``input_output_alias`` entries in the compiled module), and per-input
+residency (global vs per-device local bytes from the compiled input
+shardings). ``tools/hlolint`` enforces per-cache contracts over these
+summaries (the blocking CI gate); ``CompileCache`` dumps them at exit
+when ``MXNET_HLOLINT_DUMP`` is set. The parsers are pure text analysis —
+no jax needed to *read* a summary, only to produce one.
 """
 from __future__ import annotations
 
@@ -51,7 +64,11 @@ from .base import MXNetError, getenv, register_env
 
 __all__ = ["enabled", "enable", "make_lock", "make_rlock", "make_condition",
            "check_blocking", "report", "assert_clean", "reset",
-           "format_report"]
+           "format_report",
+           # compiled-program summaries (the hlolint substrate)
+           "program_summary", "summarize_hlo_text", "parse_donated_args",
+           "parse_io_aliases", "parse_collectives", "parse_num_partitions",
+           "cache_inventory"]
 
 register_env("MXNET_DEBUG_SYNC", False,
              "record lock acquisition order + blocking hazards; zero cost "
@@ -459,3 +476,312 @@ def reset():
         _hazards.clear()
         _haz_seen.clear()
         _locks_seen.clear()
+
+
+# ===========================================================================
+# Compiled-program summaries — the hlolint substrate (PR 15)
+# ===========================================================================
+#
+# tpulint checks what we WROTE; these helpers check what XLA actually
+# COMPILED. The repo's two worst recent bugs (the jax-0.4.37
+# mixed-sharded-concat miscompile and the pipeline grad-scaling bug)
+# lived exclusively in the lowered program, and every 1/N-bytes claim in
+# ROADMAP is asserted by measuring buffers — a program summary makes the
+# same contracts checkable from the executable itself.
+
+import re as _re
+
+# dtype token -> bytes per element, the HLO shape-token vocabulary
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                     "collective-permute", "all-to-all")
+
+# `%x = f32[64,8]{1,0} all-gather(...)` or a tuple-shaped result
+# `%x = (f32[64,8]{1,0}, f32[4]{0}) all-reduce-start(...)`. The optional
+# -start suffix counts the async form once; -done deliberately does not
+# match (it would double-count).
+_COLL_RE = _re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start)?\(")
+
+_SHAPE_RE = _re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+
+# one `{out_index}: (param, {param_index}, kind)` pair in the HloModule
+# header's input_output_alias map
+_ALIAS_RE = _re.compile(
+    r"\{([0-9, ]*)\}:\s*\(([0-9]+),\s*\{[0-9, ]*\},\s*([a-z-]+)\)")
+
+# `%arg3: tensor<8x4xf32> {tf.aliasing_output = 0 : i32}` in the
+# lowered StableHLO @main signature (the tensor type is captured so the
+# donation audit can size each declared argument WITHOUT trusting any
+# aval alignment — jax drops unused args from the lowering, which shifts
+# every later index). The attr-dict matcher must cross braces inside
+# QUOTED values: a donated arg with an explicit layout lowers as
+# `{mhlo.sharding = "{devices=[4,1]<=[4]}", tf.aliasing_output = 0 :
+# i32}`, and a naive [^{}]* group would drop the donation marker of
+# exactly the sharded programs the audit exists to protect.
+_STABLEHLO_ARG_RE = _re.compile(
+    r"%arg(\d+):\s*tensor<([^>]*)>\s*(\{(?:[^{}\"]+|\"[^\"]*\")*\})?")
+
+_MLIR_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "f8E4M3FN": 1, "f8E5M2": 1,
+}
+
+
+def _mlir_tensor_bytes(type_str):
+    """Byte size of one MLIR tensor type string (``8x4xf32`` -> 128;
+    scalar ``f32`` -> 4; unknown/dynamic dims count large so a failed
+    parse is never silently excused)."""
+    parts = type_str.strip().split("x")
+    dtype = parts[-1]
+    n = 1
+    for d in parts[:-1]:
+        if not d.isdigit():
+            return 1 << 62
+        n *= int(d)
+    return n * _MLIR_DTYPE_BYTES.get(dtype, 4)
+
+
+def _shape_token_bytes(token):
+    """Byte size of one HLO shape token (``f32[64,8]{1,0}`` -> 2048;
+    tuples sum their components; unknown dtypes count 4)."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(token):
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                if d.strip():
+                    n *= int(d)
+        total += n * _HLO_DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+def parse_collectives(hlo_text, max_lines=24):
+    """Collective inventory of one post-optimization HLO module:
+    ``{kind: {"count": n, "bytes": total}}`` plus up to ``max_lines``
+    trimmed op lines (the ``--explain`` evidence). Bytes are the op's
+    RESULT shape — the per-participant payload the collective moves."""
+    kinds = {}
+    lines = []
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None:
+            continue
+        shape_tok, kind = m.group(1), m.group(2)
+        ent = kinds.setdefault(kind, {"count": 0, "bytes": 0})
+        ent["count"] += 1
+        ent["bytes"] += _shape_token_bytes(shape_tok)
+        if len(lines) < max_lines:
+            lines.append(line.strip()[:240])
+    return kinds, lines
+
+
+def parse_io_aliases(hlo_text):
+    """The compiled module's ``input_output_alias`` entries from the
+    HloModule header line: ``[{"output": "0", "param": 2, "kind":
+    "may-alias"}, ...]`` — the ground truth of which donations actually
+    aliased."""
+    for line in hlo_text.splitlines():
+        if "input_output_alias=" not in line:
+            continue
+        start = line.index("input_output_alias=")
+        return [{"output": out.strip(), "param": int(param), "kind": kind}
+                for out, param, kind in _ALIAS_RE.findall(line[start:])]
+    return []
+
+
+def parse_donated_args(stablehlo_text):
+    """Declared donations in the lowered StableHLO ``@main`` signature:
+    ``{arg_index: {"output": aliased_output_or_None, "bytes": n}}``.
+    ``tf.aliasing_output`` marks an argument jax pre-matched to an
+    output; ``jax.buffer_donor`` marks a donated buffer left for XLA to
+    alias at compile time. A donation that produced NEITHER marker was
+    dropped at lowering (the silent 2x-memory case); whether a marked one
+    actually aliased is answered by the compiled module's
+    ``input_output_alias`` header (:func:`parse_io_aliases`)."""
+    start = stablehlo_text.find("@main(")
+    if start < 0:
+        return {}
+    end = stablehlo_text.find(" {\n", start)
+    region = stablehlo_text[start:end if end > 0 else len(stablehlo_text)]
+    out = {}
+    for idx, type_str, attrs in _STABLEHLO_ARG_RE.findall(region):
+        if not attrs:
+            continue
+        m = _re.search(r"tf\.aliasing_output\s*=\s*(\d+)", attrs)
+        if m is not None:
+            out[int(idx)] = {"output": int(m.group(1)),
+                             "bytes": _mlir_tensor_bytes(type_str)}
+        elif "jax.buffer_donor" in attrs:
+            out[int(idx)] = {"output": None,
+                             "bytes": _mlir_tensor_bytes(type_str)}
+    return out
+
+
+def summarize_hlo_text(stablehlo_text, hlo_text):
+    """Structured summary of one lowered+compiled program (pure text
+    parsing — callable on dumped artifacts without jax)."""
+    collectives, lines = parse_collectives(hlo_text)
+    declared = parse_donated_args(stablehlo_text)
+    aliased = parse_io_aliases(hlo_text)
+    aliased_params = {a["param"] for a in aliased}
+    unaliased = sorted(i for i in declared if i not in aliased_params)
+    return {
+        "collectives": collectives,
+        "collective_bytes": sum(v["bytes"] for v in collectives.values()),
+        "collective_lines": lines,
+        "donation": {
+            "declared": sorted(declared),
+            # JSON object keys are strings — keep them so a dumped
+            # summary and a live one read identically
+            "declared_bytes": {str(i): d["bytes"]
+                               for i, d in declared.items()},
+            "aliased": aliased,
+            "unaliased": unaliased,
+        },
+    }
+
+
+def _input_rows(avals, shardings):
+    """Per-input residency rows: global bytes from the recorded aval
+    skeleton, replication + per-device local bytes from the compiled
+    input shardings (aligned leaf-by-leaf over the SAME tree structure;
+    an UNSPECIFIED sharding is ``None``, which is a pytree-empty value —
+    it must be kept as a leaf or every later input's sharding shifts).
+    A residual mismatch degrades to global-only rows."""
+    import jax
+
+    def keep(x):
+        # None (unspecified sharding / empty state slot) stays positional
+        return x is None or not isinstance(x, (list, tuple, dict))
+
+    aval_all = jax.tree_util.tree_leaves(avals, is_leaf=keep)
+    shard_leaves = []
+    if shardings is not None:
+        try:
+            shard_leaves = jax.tree_util.tree_leaves(shardings,
+                                                     is_leaf=keep)
+        except Exception:  # noqa: BLE001 — residency rows are best-effort
+            shard_leaves = []
+    if len(shard_leaves) != len(aval_all):
+        shard_leaves = [None] * len(aval_all)
+    pairs = [(a, s) for a, s in zip(aval_all, shard_leaves)
+             if hasattr(a, "shape") and hasattr(a, "dtype")]
+    rows = []
+    for a, s in pairs:
+        n = 1
+        for d in a.shape:
+            n *= int(d)
+        nbytes = n * a.dtype.itemsize
+        row = {"shape": tuple(int(d) for d in a.shape),
+               "dtype": str(a.dtype), "bytes": int(nbytes)}
+        if s is not None and hasattr(s, "device_set"):
+            try:
+                row["replicated"] = bool(s.is_fully_replicated)
+                local = s.shard_shape(a.shape)
+                ln = 1
+                for d in local:
+                    ln *= int(d)
+                row["local_bytes"] = int(ln * a.dtype.itemsize)
+                row["devices"] = len(s.device_set)
+            except Exception:  # noqa: BLE001 — exotic sharding types
+                pass
+        rows.append(row)
+    return rows
+
+
+_NUM_PARTITIONS_RE = _re.compile(r"num_partitions\s*=\s*(\d+)")
+
+
+def parse_num_partitions(stablehlo_text):
+    """The SPMD partition count from the lowered module's
+    ``mhlo.num_partitions`` attribute (1 when absent) — the authoritative
+    device count of the compiled program, independent of input-sharding
+    introspection."""
+    m = _NUM_PARTITIONS_RE.search(stablehlo_text)
+    return int(m.group(1)) if m else 1
+
+
+def program_summary(fn, avals):
+    """AOT-lower one cached executable from its recorded aval skeleton
+    and summarize the compiled program: collective inventory, donation
+    audit, per-input residency, device count. ``fn`` may be the
+    ``CompileCache`` first-call wrapper (its ``_fn`` is unwrapped) or a
+    bare ``jax.jit`` callable; ``avals`` is ``(args, kwargs)`` of
+    ``ShapeDtypeStruct``\\ s.
+
+    NOTE the lowering is a FULL recompile for donated entries (they are
+    deliberately excluded from jax's on-disk cache — PR 3), so this never
+    runs on a step path: only the ``MXNET_HLOLINT_DUMP`` exit hook, the
+    bench inventory stamp, and tests call it."""
+    from . import compile_cache as _cc
+
+    target = getattr(fn, "_fn", fn)
+    if not hasattr(target, "lower"):
+        return {"error": "unlowerable (no .lower on target)"}
+    args, kwargs = avals
+    with _cc.donation_warnings_suppressed():
+        with _cc._persistent_cache_paused():
+            lowered = target.lower(*args, **kwargs)
+            stablehlo_text = lowered.as_text()
+            compiled = lowered.compile()
+            hlo_text = compiled.as_text()
+    summary = summarize_hlo_text(stablehlo_text, hlo_text)
+    shardings = None
+    try:
+        shardings = compiled.input_shardings
+    except Exception:  # noqa: BLE001 — residency degrades, audit survives
+        pass
+    summary["inputs"] = _input_rows((args, kwargs), shardings)
+    summary["num_devices"] = max(
+        [parse_num_partitions(stablehlo_text)]
+        + [r.get("devices", 1) for r in summary["inputs"]])
+    return summary
+
+
+def cache_inventory(name):
+    """Aggregate collective inventory over every LIVE
+    :class:`~mxnet_tpu.compile_cache.CompileCache` named ``name``, from
+    each entry's recorded first-call avals (``track_memory=True`` caches
+    only). Re-lowers (and for donated entries recompiles) each program —
+    bench/report tooling, never a step path. Returns ``{"entries": n,
+    "collective_bytes": total, "collectives": {kind: {count, bytes}},
+    "errors": n}``."""
+    from . import compile_cache as _cc
+
+    agg, total, entries, errors = {}, 0, 0, 0
+    for cache in _cc.all_caches():
+        if cache.name != name:
+            continue
+        for key in list(cache._entry_stats):
+            st = cache._entry_stats.get(key)
+            fn = cache._entries.get(key)
+            if st is None or fn is None:
+                continue
+            try:
+                summary = program_summary(fn, st["avals"])
+            except Exception:  # noqa: BLE001 — inventory is best-effort
+                errors += 1
+                continue
+            if "error" in summary:
+                errors += 1
+                continue
+            entries += 1
+            total += summary["collective_bytes"]
+            for kind, v in summary["collectives"].items():
+                ent = agg.setdefault(kind, {"count": 0, "bytes": 0})
+                ent["count"] += v["count"]
+                ent["bytes"] += v["bytes"]
+    return {"entries": entries, "collective_bytes": total,
+            "collectives": agg, "errors": errors}

@@ -41,7 +41,7 @@ from .base import getenv, register_env
 
 __all__ = ["CompileCache", "persistent_cache_dir", "stats", "named_stats",
            "name_totals", "all_caches", "donation_warnings_suppressed",
-           "trace_salt"]
+           "trace_salt", "dump_audit", "audit_ledger"]
 
 register_env("MXNET_FUSED_STEP", True,
              "fuse forward+backward+optimizer update into one jitted XLA "
@@ -49,9 +49,32 @@ register_env("MXNET_FUSED_STEP", True,
 register_env("MXNET_COMPILE_CACHE_DIR", "",
              "directory for jax's persistent on-disk XLA compilation cache "
              "(compile once per program across processes)")
+register_env("MXNET_HLOLINT_DUMP", "",
+             "directory for compiled-program audit dumps: at process exit "
+             "every audited cache entry's program summary (collective "
+             "inventory, donation aliasing, residency) is written as JSON "
+             "for the tools/hlolint contract gate")
+register_env("MXNET_HLOLINT_CACHES", "spmd,zero1,pipeline,serving,"
+             "generation,lazy",
+             "comma-separated audit tags recorded for the hlolint dump "
+             "(a cache entry's tag is its get_or_build audit= label, "
+             "defaulting to the cache name)")
+register_env("MXNET_HLOLINT_MAX_ENTRIES", 16,
+             "per-tag cap on audited entries in one process (each dump "
+             "entry re-lowers — and for donated programs recompiles — "
+             "the executable at exit)")
 
 _caches = weakref.WeakSet()
 _caches_lock = threading.Lock()
+
+# hlolint audit ledger (MXNET_HLOLINT_DUMP): strong refs to the first
+# MXNET_HLOLINT_MAX_ENTRIES executables per audit tag, recorded at first
+# call so the exit hook can AOT-lower them after the suites that warmed
+# them have let their per-context caches die. Empty (and never appended
+# to) when the env var is unset — steady state pays one getenv per MISS.
+_audit_lock = threading.Lock()
+_audit_ledger = {}   # (tag, repr(key)) -> {cache, tag, key, fn, avals}
+_audit_hooked = [False]
 
 # monotonic per-NAME hit/miss/compile-time totals, surviving cache GC —
 # `named_stats("serving")` must answer "did steady state compile anything?"
@@ -197,7 +220,7 @@ class CompileCache:
     def keys(self):
         return list(self._entries.keys())
 
-    def get_or_build(self, key, build, persistent=True):
+    def get_or_build(self, key, build, persistent=True, audit=None):
         """The cached callable for ``key``; on miss, ``build()`` makes one
         (typically a ``jax.jit`` closure) and its first invocation is timed
         into ``compile.seconds``.
@@ -208,6 +231,12 @@ class CompileCache:
         invocation (reproduced: 'corrupted double-linked list' on the second
         process reusing MXNET_COMPILE_CACHE_DIR). The fused train-step and
         fused optimizer-update programs pass False; everything else persists.
+
+        ``audit`` names the hlolint contract row this entry is audited
+        under (``MXNET_HLOLINT_DUMP`` / ``tools/hlolint``); it defaults to
+        the cache name. The fused train step passes the composition that
+        actually built the program ("spmd"/"pipeline"/"zero1"/
+        "fused_step") since those share the executor-side caches.
         """
         fn = self._entries.get(key)
         if fn is not None:
@@ -231,7 +260,12 @@ class CompileCache:
             self.misses += 1
             self._name_totals["misses"] += 1
             telemetry.counter("compile.cache_misses").inc()
-            fn = self._wrap_first_call(build(), persistent, key)
+            if self.hits > 0 and self._entries:
+                # a STEADY-STATE miss: this cache has already served hits,
+                # so a new key means something about the workload changed —
+                # blame the axis instead of burning the budget silently
+                _blame_miss(self.name, key, self._entries)
+            fn = self._wrap_first_call(build(), persistent, key, audit)
             if self.maxsize is not None and len(self._entries) >= self.maxsize:
                 # drop the least-recently-used entry — executables are
                 # re-buildable, never precious
@@ -326,7 +360,7 @@ class CompileCache:
                 rows.append(dict(mem, key=repr(key)))
         return rows
 
-    def _wrap_first_call(self, fn, persistent=True, key=None):
+    def _wrap_first_call(self, fn, persistent=True, key=None, audit=None):
         cache = self
 
         class _Timed:
@@ -361,6 +395,9 @@ class CompileCache:
                     self._first = False
                     if key is not None and cache.track_memory:
                         cache._record_avals(key, args, kwargs)
+                    if key is not None and getenv("MXNET_HLOLINT_DUMP"):
+                        _audit_record(cache, audit or cache.name, key,
+                                      self, args, kwargs)
                     dt = time.perf_counter() - t0
                     cache.compile_seconds += dt
                     cache._name_totals["compile_seconds"] += dt
@@ -434,6 +471,256 @@ def named_stats(name):
             "misses": totals["misses"],
             "compile_seconds": totals["compile_seconds"],
             "caches": len(per)}
+
+
+# ---------------------------------------------------------------------------
+# steady-state recompile blamer
+# ---------------------------------------------------------------------------
+#
+# The zero-steady-compile SLO (PR 11: compile.cache_misses rate <= 0 after
+# the warmup grace) can only say THAT a warmed cache missed, not WHY. The
+# blamer structurally diffs the missing key against its nearest existing
+# neighbor and names the axis that changed — shape (batch vs inner dim),
+# dtype, optimizer hyperparam, sharding plan, or attr — as a
+# `compile_blame` health-journal event and `compile.blamed_misses` /
+# `compile.blame_axis.*` counters. "Why did steady state recompile?"
+# becomes a named diagnosis instead of folklore debugging.
+
+_BLAME_NEIGHBORS = 64      # newest keys considered as nearest-neighbor
+_BLAME_AXES_MAX = 4        # axes reported per event
+
+_DTYPE_NAMES = frozenset(
+    "float16 float32 float64 bfloat16 int8 int16 int32 int64 uint8 uint16 "
+    "uint32 uint64 bool complex64 complex128".split())
+
+_SHARD_SPEC_RE = None  # compiled lazily (re import stays off the hot path)
+
+
+def _is_dtype_leaf(v):
+    if hasattr(v, "itemsize") and hasattr(v, "name"):     # np.dtype
+        return True
+    if isinstance(v, type) and getattr(v, "__name__", "") in _DTYPE_NAMES:
+        return True
+    return isinstance(v, str) and v in _DTYPE_NAMES
+
+
+def _is_shard_leaf(v, parent):
+    """A sharding-plan component: a spec string (`tp=2,fsdp=4`) or any
+    leaf of a tuple tagged by its subsystem ("zero1"/"spmd"/"mesh"...)."""
+    global _SHARD_SPEC_RE
+    if isinstance(parent, tuple) and parent and isinstance(parent[0], str) \
+            and parent[0] in ("zero1", "spmd", "mesh", "pipeline"):
+        return True
+    if not isinstance(v, str):
+        return False
+    if _SHARD_SPEC_RE is None:
+        import re as _re
+
+        _SHARD_SPEC_RE = _re.compile(r"(^|[,(])\s*(tp|fsdp|dp|pp|sp|ep)=")
+    return bool(_SHARD_SPEC_RE.search(v))
+
+
+def _flatten_key(k, path=(), parent=None, out=None):
+    """Leaf list [(path, parent_container, value)] of one cache key —
+    keys are nested tuples by convention (shape signatures, static
+    config), so tuple/list are the only containers walked."""
+    if out is None:
+        out = []
+    if isinstance(k, (tuple, list)):
+        for i, v in enumerate(k):
+            _flatten_key(v, path + (i,), k, out)
+        if not k:
+            out.append((path, parent, k))
+    else:
+        out.append((path, parent, k))
+    return out
+
+
+def _axis_of(path, parent, old, new):
+    """Name the key axis a differing leaf belongs to."""
+    if _is_dtype_leaf(old) or _is_dtype_leaf(new):
+        return "dtype"
+    if _is_shard_leaf(old, parent) or _is_shard_leaf(new, parent):
+        return "sharding"
+    if isinstance(old, bool) or isinstance(new, bool):
+        return "attr"
+    if isinstance(old, int) and isinstance(new, int):
+        if isinstance(parent, (tuple, list)) and parent and all(
+                isinstance(x, int) and not isinstance(x, bool)
+                for x in parent):
+            # an all-int tuple in a cache key is a shape by convention
+            # (executor._sig, serving bucket sigs, slab geometry)
+            dim = path[-1] if path else 0
+            return "shape(batch)" if dim == 0 else f"shape(dim{dim})"
+        return "attr"
+    if isinstance(old, float) and isinstance(new, float):
+        return "hyperparam"
+    return "attr"
+
+
+def _key_distance(a_flat, b_map):
+    """(score, diffs): structural mismatches weigh 1000, each differing
+    leaf 1, with a <1 numeric-closeness tiebreak so batch 9 blames the
+    size-8 bucket, not the size-4 one."""
+    diffs = []
+    score = 0.0
+    seen = set()
+    for path, parent, v in a_flat:
+        seen.add(path)
+        if path not in b_map:
+            score += 1000.0
+            continue
+        bparent, bv = b_map[path]
+        eq = False
+        try:
+            eq = bool(v == bv) and type(v) is type(bv)
+        except Exception:  # noqa: BLE001 — exotic leaf comparisons
+            eq = v is bv
+        if eq:
+            continue
+        score += 1.0
+        if isinstance(v, (int, float)) and isinstance(bv, (int, float)) \
+                and not isinstance(v, bool) and not isinstance(bv, bool):
+            denom = abs(float(v)) + abs(float(bv)) + 1e-9
+            score += min(1.0, abs(float(v) - float(bv)) / denom) * 0.5
+        diffs.append((path, parent, bv, v))  # (path, parent, old, new)
+    score += 1000.0 * sum(1 for p in b_map if p not in seen)
+    return score, diffs
+
+
+def _blame_miss(cache_name, key, entries):
+    """Diff ``key`` against its nearest neighbor among ``entries`` and
+    publish the diagnosis. Called under the cache lock on a steady-state
+    miss — rare by contract, and cheap next to the compile that follows."""
+    try:
+        new_flat = _flatten_key(key)
+        best = None
+        for old_key in list(entries)[-_BLAME_NEIGHBORS:]:
+            b_map = {p: (parent, v)
+                     for p, parent, v in _flatten_key(old_key)}
+            score, diffs = _key_distance(new_flat, b_map)
+            if best is None or score < best[0]:
+                best = (score, old_key, diffs)
+        if best is None:
+            return
+        _, nearest, diffs = best
+        axes = []
+        for path, parent, old, new in diffs[:_BLAME_AXES_MAX]:
+            axes.append({"axis": _axis_of(path, parent, old, new),
+                         "path": "/".join(str(p) for p in path),
+                         "old": repr(old)[:80], "new": repr(new)[:80]})
+        if not axes:
+            # same leaves, different structure (rank change, extra input)
+            axes.append({"axis": "structure", "path": "",
+                         "old": repr(nearest)[:120],
+                         "new": repr(key)[:120]})
+        primary = axes[0]["axis"]
+        telemetry.counter("compile.blamed_misses").inc()
+        safe = primary.replace("(", "_").replace(")", "")
+        telemetry.counter(f"compile.blame_axis.{safe}").inc()
+        try:
+            from . import health
+
+            if health._enabled:
+                health.event("compile_blame", cache=cache_name,
+                             axis=primary, axes=axes,
+                             key=repr(key)[:240],
+                             nearest=repr(nearest)[:240])
+        except Exception:  # noqa: BLE001 — the journal is additive
+            pass
+    except Exception:  # noqa: BLE001 — diagnosis must never break a build
+        pass
+
+
+# ---------------------------------------------------------------------------
+# hlolint audit ledger (MXNET_HLOLINT_DUMP)
+# ---------------------------------------------------------------------------
+
+
+def _audit_tags():
+    raw = str(getenv("MXNET_HLOLINT_CACHES") or "")
+    return {s.strip() for s in raw.split(",") if s.strip()}
+
+
+def _audit_record(cache, tag, key, timed, args, kwargs):
+    """Retain one first-called executable (strong ref + aval skeleton)
+    for the exit dump. Per-tag capped; dedupes by (tag, repr(key)) so the
+    same program warmed by many per-context caches is lowered once."""
+    try:
+        tags = _audit_tags()
+        if tags and tag not in tags:
+            return
+        import jax
+
+        def aval(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            return x
+
+        avals = jax.tree_util.tree_map(aval, (tuple(args), dict(kwargs)))
+        cap = int(getenv("MXNET_HLOLINT_MAX_ENTRIES"))
+        with _audit_lock:
+            lk = (tag, repr(key))
+            if lk in _audit_ledger:
+                return
+            if sum(1 for t, _ in _audit_ledger if t == tag) >= cap:
+                return
+            _audit_ledger[lk] = {"cache": cache.name, "tag": tag,
+                                 "key": repr(key), "fn": timed,
+                                 "avals": avals}
+            if not _audit_hooked[0]:
+                _audit_hooked[0] = True
+                import atexit
+
+                atexit.register(_dump_audit_atexit)
+    except Exception:  # noqa: BLE001 — auditing must never break a step
+        pass
+
+
+def audit_ledger():
+    """The recorded (tag, key) pairs — test/tooling introspection."""
+    with _audit_lock:
+        return sorted(_audit_ledger)
+
+
+def dump_audit(dirpath):
+    """Summarize every ledger entry (AOT lower + compile — seconds per
+    donated entry) and write one JSON dump into ``dirpath`` for
+    ``python -m tools.hlolint check``. Returns the file path or None when
+    the ledger is empty."""
+    from . import analysis
+
+    with _audit_lock:
+        recs = list(_audit_ledger.values())
+    if not recs:
+        return None
+    entries = []
+    for r in recs:
+        try:
+            summary = analysis.program_summary(r["fn"], r["avals"])
+        except Exception as e:  # noqa: BLE001 — one bad entry can't
+            summary = {"error": repr(e)[:240]}   # lose the whole dump
+        entries.append({"cache": r["cache"], "tag": r["tag"],
+                        "key": r["key"], "summary": summary})
+    import json
+
+    _os.makedirs(dirpath, exist_ok=True)
+    path = _os.path.join(
+        dirpath, f"hlolint-{_os.getpid()}-{_time.time_ns() % 10**9}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"pid": _os.getpid(), "entries": entries}, f, indent=1)
+    _os.replace(tmp, path)
+    return path
+
+
+def _dump_audit_atexit():
+    try:
+        d = getenv("MXNET_HLOLINT_DUMP")
+        if d:
+            dump_audit(d)
+    except Exception:  # noqa: BLE001 — exit hooks never raise
+        pass
 
 
 persistent_cache_dir()

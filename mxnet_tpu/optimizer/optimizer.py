@@ -1464,9 +1464,18 @@ class Updater:
                     return ctx.traced_update(opt, list(ws), list(gs), flat,
                                              lrs_, wds_, trace_salt(rescale))
 
-                return jax.jit(step, donate_argnums=(0, 2))
+                # donate only the flat sharded state: the updated weights
+                # are slices of one all-gathered bucket, which XLA cannot
+                # reliably alias into the k donated weight buffers (the
+                # hlolint donation audit showed it declining silently) —
+                # declared donations must actually alias
+                return jax.jit(step, donate_argnums=(2,))
 
-            fn = _updater_cache().get_or_build(key, build, persistent=False)
+            # audit="zero1": this is the gluon/aggregated rendering of the
+            # sharded update — same reduce-scatter/all-gather contract row
+            # as the executor-side fused step (tools/hlolint/contracts.py)
+            fn = _updater_cache().get_or_build(key, build, persistent=False,
+                                               audit="zero1")
             new_ws, new_flat = fn(
                 [ctx.put_replicated(w._data) for w in weights],
                 [ctx.put_replicated(g._data) for g in grads],

@@ -418,17 +418,24 @@ class Zero1Context:
 
         def pack(arrs, plan):
             flat = _pack_flat(arrs, plan)
-            if unpack_shardings is not None:
-                # SPMD composition: the bucket concatenates MIXED-sharded
-                # operands (tp/fsdp params next to replicated biases).
-                # jax 0.4.x's SPMD partitioner miscompiles a concat of
-                # mixed-sharded operands partitioned straight to the flat
-                # dp layout — values interleave by shard stride
-                # (reproduced on 0.4.37; see test_spmd.py). Pinning the
-                # concat result REPLICATED first, then sharding, is the
-                # correct lowering the partitioner does handle; it trades
-                # the fused reduce-scatter for gather+slice on this lane
-                flat = sharding_constraint(flat, self.repl)
+            # replicate-first on EVERY lane, for two audited reasons
+            # (tools/hlolint dumps of the compiled programs):
+            # * SPMD composition: the bucket concatenates MIXED-sharded
+            #   operands (tp/fsdp params next to replicated biases).
+            #   jax 0.4.x's SPMD partitioner miscompiles a concat of
+            #   mixed-sharded operands partitioned straight to the flat
+            #   dp layout — values interleave by shard stride (reproduced
+            #   on 0.4.37; canary-pinned in test_hlolint.py). Pinning the
+            #   concat result REPLICATED first, then sharding, is the
+            #   correct lowering the partitioner does handle.
+            # * plain lane: partitioning the concat of REPLICATED
+            #   operands straight to the dp layout lowers as
+            #   dynamic-update-slice + a FULL-BUCKET all-reduce per pack
+            #   (hlolint found two full-bucket all-reduces per step) —
+            #   replicate-first makes the shard constraint a local slice,
+            #   no collective at all. The element math is unchanged (a
+            #   layout pin on the same values).
+            flat = sharding_constraint(flat, self.repl)
             return sharding_constraint(flat, self.shard)
 
         for bi, plan in enumerate(self.plans):

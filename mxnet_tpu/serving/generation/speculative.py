@@ -195,7 +195,10 @@ class CheckpointDraft(Draft):
             return jax.jit(fn, donate_argnums=(1, 2))
 
         key = ("draft_prefill", bucket, self._eng.max_slots, self._slab_len)
-        return cache.get_or_build(key, build, persistent=False)
+        # audit="generation": the draft slab programs live in the engine's
+        # "generation" cache (passed in) — same hlolint contract row
+        return cache.get_or_build(key, build, persistent=False,
+                                  audit="generation")
 
     def _step_fn(self, k):
         model, cache = self._model, self._eng.cache
@@ -233,7 +236,8 @@ class CheckpointDraft(Draft):
             return jax.jit(fn, donate_argnums=(1, 2))
 
         key = ("draft_step", k, self._eng.max_slots, self._slab_len)
-        return cache.get_or_build(key, build, persistent=False)
+        return cache.get_or_build(key, build, persistent=False,
+                                  audit="generation")
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -511,21 +511,37 @@ class Executor:
                         new_ss = spmd.constrain_state_trees(names, new_ss)
                 return outputs, tuple(new_ws), new_ss, aux_new
 
-            return jax.jit(step, donate_argnums=(1, 3, 4))
+            # Donate exactly what will ALIAS (the hlolint donation audit
+            # enforces declared == aliased): params + auxs + states on the
+            # elementwise-update paths, but under ZeRO-1 the updated
+            # weights are SLICES of one all-gathered flat bucket — XLA
+            # cannot reliably alias k outputs carved from a single gather
+            # result into k separate donated buffers (dumps showed it
+            # silently declining for most params), so donating them only
+            # risked consuming buffers nothing aliased. The flat sharded
+            # state and the aux states update elementwise and alias.
+            donate = (3, 4) if zero1 is not None else (1, 3, 4)
+            return jax.jit(step, donate_argnums=donate)
 
         # persistent=False: donated programs must stay OUT of the on-disk
         # XLA cache (deserialized aliasing corrupts the heap — see
         # CompileCache.get_or_build). Pipelined steps compile under the
         # named "pipeline" cache, sharded ones under "spmd" (spmd wins
         # when both compose), so per-config accounting is assertable.
+        # The audit tag names the hlolint contract row for the
+        # COMPOSITION that actually shaped the program: a zero1 step in
+        # the generic executor cache is still audited against the
+        # reduce-scatter/all-gather contract (tools/hlolint/contracts.py).
         if spmd is not None:
-            cache = spmd.cache
+            cache, audit = spmd.cache, "spmd"
         elif pipeline is not None:
-            cache = pipeline.cache
+            cache, audit = pipeline.cache, "pipeline"
+        elif zero1 is not None:
+            cache, audit = self._cache, "zero1"
         else:
-            cache = self._cache
+            cache, audit = self._cache, "fused_step"
         fn = cache.get_or_build(("fused_step", sig), build,
-                                persistent=False)
+                                persistent=False, audit=audit)
         call_args = [key, params, others, auxs, states_arg,
                      jnp.asarray(lrs, jnp.float32),
                      jnp.asarray(wds, jnp.float32),

@@ -181,6 +181,114 @@ def clean(cache, sig):
 
 
 # ---------------------------------------------------------------------------
+# donation-aliasing: donate sites resolve to an hlolint contract row
+# ---------------------------------------------------------------------------
+
+_ALIAS_STRAY = """
+import jax
+
+step = jax.jit(lambda w, g: w - g, donate_argnums=(0,))
+"""
+
+_ALIAS_NO_ROW = """
+import jax
+from mxnet_tpu.compile_cache import CompileCache
+
+_cache = CompileCache("no-such-contract-row")
+
+def run(sig):
+    def build():
+        return jax.jit(lambda w: w * 2, donate_argnums=(0,))
+    return _cache.get_or_build(sig, build, persistent=False)
+"""
+
+_ALIAS_BAD_TAG = """
+import jax
+
+def run(cache, sig):
+    def build():
+        return jax.jit(lambda w: w * 2, donate_argnums=(0,))
+    return cache.get_or_build(sig, build, persistent=False,
+                              audit="no-such-contract-row")
+"""
+
+_ALIAS_UNRESOLVABLE = """
+import jax
+
+def run(cache, sig):
+    def build():
+        return jax.jit(lambda w: w * 2, donate_argnums=(0,))
+    return cache.get_or_build(sig, build, persistent=False)
+"""
+
+_ALIAS_GOOD_TAG = """
+import jax
+
+def run(cache, sig):
+    def build():
+        return jax.jit(lambda w: w * 2, donate_argnums=(0,))
+    return cache.get_or_build(sig, build, persistent=False,
+                              audit="zero1")
+"""
+
+_ALIAS_GOOD_NAME = """
+import jax
+from mxnet_tpu.compile_cache import CompileCache
+
+_cache = CompileCache("generation")
+
+def run(sig):
+    def build():
+        return jax.jit(lambda w: w * 2, donate_argnums=(0,))
+    return _cache.get_or_build(sig, build, persistent=False)
+"""
+
+
+def test_donation_aliasing_stray_donate_outside_builder():
+    got = lint_text(_ALIAS_STRAY, {"donation-aliasing"})
+    assert rules_of(got) == ["donation-aliasing"]
+    assert "outside" in got[0].message
+
+
+def test_donation_aliasing_missing_contract_row():
+    got = lint_text(_ALIAS_NO_ROW, {"donation-aliasing"})
+    assert rules_of(got) == ["donation-aliasing"]
+    assert "no contract row" in got[0].message
+
+
+def test_donation_aliasing_bad_audit_literal():
+    got = lint_text(_ALIAS_BAD_TAG, {"donation-aliasing"})
+    assert rules_of(got) == ["donation-aliasing"]
+    assert "names no contract row" in got[0].message
+
+
+def test_donation_aliasing_unresolvable_cache_requires_tag():
+    got = lint_text(_ALIAS_UNRESOLVABLE, {"donation-aliasing"})
+    assert rules_of(got) == ["donation-aliasing"]
+    assert 'audit="<row>"' in got[0].message
+
+
+def test_donation_aliasing_negative():
+    assert lint_text(_ALIAS_GOOD_TAG, {"donation-aliasing"}) == []
+    assert lint_text(_ALIAS_GOOD_NAME, {"donation-aliasing"}) == []
+    # a dynamic audit expression (the executor's composition dispatch)
+    # is sanctioned — the runtime gate audits the real tag
+    dynamic = _ALIAS_GOOD_TAG.replace('audit="zero1"', "audit=tag")
+    assert lint_text(dynamic, {"donation-aliasing"}) == []
+    # non-donating builders never trip the rule, wherever they compile
+    clean = _ALIAS_UNRESOLVABLE.replace(", donate_argnums=(0,)", "")
+    assert lint_text(clean, {"donation-aliasing"}) == []
+
+
+def test_donation_aliasing_disable_escape_hatch():
+    suppressed = _ALIAS_STRAY.replace(
+        "donate_argnums=(0,))",
+        "donate_argnums=(0,))  "
+        "# tpulint: disable=donation-aliasing (bench-local scratch)")
+    assert lint_text(suppressed, {"donation-aliasing"}) == []
+
+
+# ---------------------------------------------------------------------------
 # gate-discipline
 # ---------------------------------------------------------------------------
 

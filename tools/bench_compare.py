@@ -56,6 +56,58 @@ METRICS = [
     ("framework_module_compile_s", "module compile s", "down"),
 ]
 
+# hlolint collective inventories (bench.py stamps them per lane as
+# {"mesh": "<spec>", "collective_bytes": N, "collectives": {...}}): bytes
+# moved per step by cross-device collectives, from the COMPILED program.
+# Growth past this threshold at the SAME mesh spec is a hard regression
+# regardless of --threshold — wire bytes are a contract, not a trend.
+HLOLINT_HARD_THRESHOLD = 0.10
+
+
+def hlolint_sections(record):
+    """{cache_name: inventory} from a bench record — the spmd lane's
+    ``spmd.hlolint`` plus any top-level ``hlolint`` map."""
+    out = {}
+    spmd = record.get("spmd") or {}
+    if isinstance(spmd.get("hlolint"), dict):
+        out["spmd"] = spmd["hlolint"]
+    top = record.get("hlolint") or {}
+    if isinstance(top, dict):
+        for name, v in top.items():
+            if isinstance(v, dict):
+                out.setdefault(name, v)
+    return out
+
+
+def compare_hlolint(old, new, write):
+    """Direction-aware per-cache collective-bytes rows; returns the
+    regression list (bytes grew > HLOLINT_HARD_THRESHOLD at the same
+    mesh spec)."""
+    regressions = []
+    o_inv, n_inv = hlolint_sections(old), hlolint_sections(new)
+    for name in sorted(set(o_inv) & set(n_inv)):
+        o, n = o_inv[name], n_inv[name]
+        ob, nb = o.get("collective_bytes"), n.get("collective_bytes")
+        if not isinstance(ob, (int, float)) \
+                or not isinstance(nb, (int, float)):
+            continue
+        label = f"{name} collective bytes/step"
+        if o.get("mesh") != n.get("mesh"):
+            write(f"{label:<34}{'':>12}{'':>12}{'':>9}  skipped "
+                  f"(mesh {o.get('mesh')} -> {n.get('mesh')})\n")
+            continue
+        delta = 0.0 if ob == 0 and nb == 0 else \
+            (nb - ob) / abs(ob) if ob else float("inf")
+        bad = delta > HLOLINT_HARD_THRESHOLD
+        verdict = "REGRESSION (hard)" if bad else (
+            "improved" if delta < 0 else "ok")
+        write(f"{label:<34}{ob:>12.0f}{nb:>12.0f}"
+              f"{delta * 100:>8.1f}%  {verdict}\n")
+        if bad:
+            regressions.append((label, ob, nb, delta))
+    return regressions
+
+
 # nonzero in NEW = broken compile-once contract, whatever OLD said
 INVARIANTS = [
     ("serving.steady_state_compiles", "serving steady-state compiles"),
@@ -136,6 +188,7 @@ def main(argv=None):
             regressions.append((label, o, n, delta))
         sys.stdout.write(f"{label:<34}{o:>12.3f}{n:>12.3f}"
                          f"{delta * 100:>8.1f}%  {verdict}\n")
+    regressions.extend(compare_hlolint(old, new, sys.stdout.write))
     for path, label in INVARIANTS:
         n = get(new, path)
         if n is None:

@@ -1,0 +1,349 @@
+"""hlolint — compiled-program contract auditor for mxnet_tpu.
+
+``tools/tpulint`` checks what we *wrote*; hlolint checks what XLA
+actually *compiled*. The repo's two worst recent bugs (the jax-0.4.37
+mixed-sharded-concat miscompile and the pipeline grad-scaling bug, both
+PR 14) lived exclusively in the lowered program — no amount of source
+linting could see them — and every 1/N-bytes and zero-steady-compile
+claim in ROADMAP was asserted by *measuring buffers*, never by
+inspecting the program that produces them. hlolint closes that gap:
+
+* every named :class:`~mxnet_tpu.compile_cache.CompileCache` entry can
+  expose its lowered StableHLO + compiled HLO (``MXNET_HLOLINT_DUMP``
+  writes per-process JSON summaries at exit — see
+  :func:`mxnet_tpu.analysis.program_summary`);
+* the summary is a structured program record: **collective inventory**
+  (all-reduce / all-gather / reduce-scatter / collective-permute counts
+  and byte volumes), **donation audit** (which declared donations
+  actually got ``input_output_alias`` entries — a donation that silently
+  didn't alias is a 2x memory regression today), and **residency audit**
+  (per-input global vs per-device local bytes from the compiled input
+  shardings — no full-shape parameter in a steady-state program whose
+  plan says 1/N, modulo declared just-in-time gathers);
+* contracts are declared per audit tag in the checked-in registry
+  (:mod:`tools.hlolint.contracts`) and enforced by
+  ``python -m tools.hlolint check <dumpdir> --strict`` — the blocking
+  ``ci/run.sh`` gate that runs the existing suites' warmed
+  spmd/zero1/pipeline/serving/generation/lazy caches through the
+  auditor.
+
+The steady-state *recompile blamer* is the runtime twin (see
+``mxnet_tpu/compile_cache.py``): a named-cache miss after warmup diffs
+the new key against its nearest neighbor and names the changed axis as a
+``compile_blame`` health-journal event.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["Contract", "Finding", "load_dumps", "audit", "COLLECTIVE_KINDS"]
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+
+@dataclass(frozen=True)
+class Contract:
+    """One audit row: what the compiled programs of a named cache (or an
+    explicit ``get_or_build(audit=...)`` tag) are allowed to look like.
+
+    donation
+        ``"required"``: at least one entry in the row must carry a real
+        ``input_output_alias``, and NO entry may declare a donation of
+        >= ``donation_bytes_floor`` bytes that failed to alias (the
+        silent 2x-memory case). The floor exists because XLA legitimately
+        declines to alias sub-KB buffers (bias momenta at 64B/shard —
+        measured); a failed alias only matters at sizes where doubling
+        the buffer is a regression. ``"forbidden"``: no entry may declare
+        or carry aliasing at all. ``None``: unchecked.
+    allowed_collectives
+        Collective kinds tolerated in multi-device programs; anything
+        else is a violation (named op, named executable).
+    single_device_collectives_ok
+        ``False`` = a program compiled for ONE device must contain zero
+        collectives (the generation-decode-at-tp=1 contract).
+    require_collectives
+        ``{kind: min_count}`` that must appear across the row's
+        multi-device entries (e.g. zero1: reduce-scatter AND all-gather —
+        the arXiv:2004.13336 lowering). Skipped when the dump holds no
+        multi-device entries for the row.
+    forbid_full_allreduce
+        ``True`` = no single all-reduce may move >= ``full_fraction`` of
+        the entry's largest input (zero1: a full-bucket all-reduce means
+        the reduce-scatter lowering silently regressed to replicated).
+    require_sharded_input
+        ``True`` = at least one multi-device entry in the row must hold a
+        non-replicated input of >= ``large_bytes_floor`` bytes (the 1/N
+        residency claim, observable from the compiled layout). Row-level,
+        not per-entry: helper programs (the zero1 eager pack, warmup
+        shims) legitimately run all-replicated.
+    max_replicated_fraction
+        Cap on the byte-fraction of large (>= ``large_bytes_floor``)
+        inputs that sit fully replicated in a multi-device entry — the
+        "no full-shape parameter under a 1/N plan" proof. ``None`` skips
+        (zero1 keeps weights replicated BY DESIGN; only its state
+        shards).
+    """
+
+    donation: str | None = None
+    donation_bytes_floor: int = 2048
+    allowed_collectives: frozenset = frozenset(COLLECTIVE_KINDS)
+    single_device_collectives_ok: bool = True
+    require_collectives: dict = field(default_factory=dict)
+    forbid_full_allreduce: bool = False
+    full_fraction: float = 0.9
+    require_sharded_input: bool = False
+    max_replicated_fraction: float | None = None
+    large_bytes_floor: int = 4096
+    note: str = ""
+
+
+@dataclass
+class Finding:
+    """One contract violation, anchored to a named executable."""
+
+    tag: str
+    cache: str
+    key: str
+    message: str
+    entry: dict | None = None   # the offending dump entry (for --explain)
+
+    def __str__(self):
+        return (f"[{self.tag}] cache={self.cache!r} "
+                f"key={self.key}: {self.message}")
+
+
+def load_dumps(paths):
+    """Load dump files / directories written by
+    ``compile_cache.dump_audit`` into one entry list (each entry:
+    ``{cache, tag, key, summary}``), deduped by (tag, key) — several
+    suite processes warm the same program."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(".json")))
+        else:
+            files.append(p)
+    entries, seen = [], set()
+    for f in files:
+        with open(f) as fh:
+            doc = json.load(fh)
+        for e in doc.get("entries", []):
+            k = (e.get("tag"), e.get("key"))
+            if k in seen:
+                continue
+            seen.add(k)
+            entries.append(e)
+    return entries
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.2f}{unit}"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def format_inventory(entry):
+    """Human-readable program summary for one dump entry — the
+    ``--explain`` rendering a failed gate prints for its offenders."""
+    s = entry.get("summary") or {}
+    lines = [f"executable [{entry.get('tag')}] cache={entry.get('cache')!r} "
+             f"key={entry.get('key')}"]
+    if "error" in s:
+        lines.append(f"  summary error: {s['error']}")
+        return "\n".join(lines)
+    lines.append(f"  devices: {s.get('num_devices', '?')}")
+    coll = s.get("collectives") or {}
+    if coll:
+        for kind, v in sorted(coll.items()):
+            lines.append(f"  {kind}: {v['count']} op(s), "
+                         f"{_fmt_bytes(v['bytes'])}")
+    else:
+        lines.append("  collectives: none")
+    don = s.get("donation") or {}
+    lines.append(f"  donation: declared={don.get('declared', [])} "
+                 f"aliased={[a['param'] for a in don.get('aliased', [])]} "
+                 f"unaliased={don.get('unaliased', [])}")
+    inputs = s.get("inputs") or []
+    large = [r for r in inputs if r.get("bytes", 0) >= 4096]
+    repl = [r for r in large if r.get("replicated")]
+    if large:
+        lines.append(f"  inputs >=4KiB: {len(large)} "
+                     f"({len(repl)} fully replicated)")
+    for line in (s.get("collective_lines") or [])[:8]:
+        lines.append(f"    | {line}")
+    return "\n".join(lines)
+
+
+def _entry_checks(tag, contract, e):
+    """Per-entry contract checks; returns Findings."""
+    out = []
+    s = e.get("summary") or {}
+    if "error" in s:
+        return out  # counted by the caller's coverage check
+    kinds = set(s.get("collectives") or {})
+    ndev = int(s.get("num_devices") or 1)
+    key = e.get("key", "?")
+    cache = e.get("cache", "?")
+
+    if ndev <= 1 and not contract.single_device_collectives_ok and kinds:
+        named = ", ".join(sorted(kinds))
+        out.append(Finding(tag, cache, key,
+                           f"single-device program contains cross-device "
+                           f"collective(s): {named} (contract says none "
+                           f"at 1 device)", e))
+    if ndev > 1:
+        bad = kinds - set(contract.allowed_collectives)
+        if bad:
+            out.append(Finding(tag, cache, key,
+                               f"disallowed collective(s): "
+                               f"{', '.join(sorted(bad))} (allowed: "
+                               f"{', '.join(sorted(contract.allowed_collectives))})",
+                               e))
+    don = s.get("donation") or {}
+    if contract.donation == "required" and don.get("unaliased"):
+        sizes = don.get("declared_bytes") or {}
+
+        def arg_bytes(i):
+            # sized from the lowered signature's own tensor types; a
+            # missing size counts as large — conservative, never
+            # silently excused
+            return sizes.get(str(i), 1 << 62)
+
+        big = [i for i in don["unaliased"]
+               if arg_bytes(i) >= contract.donation_bytes_floor]
+        if big:
+            out.append(Finding(
+                tag, cache, key,
+                f"donated argument(s) {big} "
+                f"(>= {contract.donation_bytes_floor}B each) were "
+                f"declared but got NO input_output_alias entry — the "
+                f"donation silently did not alias (2x memory for those "
+                f"buffers)", e))
+    if contract.donation == "forbidden" and (don.get("declared")
+                                             or don.get("aliased")):
+        out.append(Finding(tag, cache, key,
+                           f"program declares/carries input-output "
+                           f"aliasing (declared={don.get('declared')}, "
+                           f"aliased={len(don.get('aliased') or [])}) but "
+                           f"the contract forbids donation", e))
+    if contract.forbid_full_allreduce and ndev > 1:
+        inputs = s.get("inputs") or []
+        largest = max((r.get("bytes", 0) for r in inputs), default=0)
+        ar = (s.get("collectives") or {}).get("all-reduce")
+        if ar and largest > 0 and ar["count"] > 0:
+            per_op = ar["bytes"] / ar["count"]
+            if per_op >= contract.full_fraction * largest:
+                out.append(Finding(
+                    tag, cache, key,
+                    f"all-reduce moving {_fmt_bytes(per_op)}/op vs largest "
+                    f"input {_fmt_bytes(largest)} — a full-bucket "
+                    f"all-reduce where the contract expects "
+                    f"reduce-scatter + all-gather", e))
+    if ndev > 1 and contract.max_replicated_fraction is not None:
+        inputs = [r for r in (s.get("inputs") or [])
+                  if r.get("bytes", 0) >= contract.large_bytes_floor
+                  and "replicated" in r]
+        # the cap only binds when the plan visibly sharded SOMETHING
+        # large: a dp-only spec legitimately keeps every parameter
+        # replicated (only the batch shards), and that is not a
+        # residency violation
+        if inputs and any(not r["replicated"] for r in inputs):
+            repl_bytes = sum(r["bytes"] for r in inputs if r["replicated"])
+            total = sum(r["bytes"] for r in inputs)
+            frac = repl_bytes / total if total else 0.0
+            if frac > contract.max_replicated_fraction:
+                out.append(Finding(
+                    tag, cache, key,
+                    f"{frac:.0%} of large-input bytes sit fully replicated "
+                    f"(> {contract.max_replicated_fraction:.0%} allowed) — "
+                    f"a full-shape parameter materialized under a 1/N "
+                    f"plan", e))
+    return out
+
+
+def _has_sharded_input(entry, floor):
+    s = entry.get("summary") or {}
+    if int(s.get("num_devices") or 1) <= 1:
+        return False
+    return any(not r["replicated"]
+               for r in (s.get("inputs") or [])
+               if r.get("bytes", 0) >= floor and "replicated" in r)
+
+
+def audit(entries, registry, require=()):
+    """Run every contract row in ``registry`` over the dumped
+    ``entries``. ``require`` lists tags that MUST have at least one
+    successfully summarized entry (a gate run where a suite stopped
+    warming its cache should fail loudly, not pass vacuously). Returns a
+    Finding list."""
+    findings = []
+    by_tag = {}
+    for e in entries:
+        by_tag.setdefault(e.get("tag"), []).append(e)
+    for tag in require:
+        if tag not in registry:
+            findings.append(Finding(tag, "-", "-",
+                                    "required tag has no contract row in "
+                                    "tools/hlolint/contracts.py"))
+    for tag, contract in registry.items():
+        rows = by_tag.get(tag, [])
+        ok_rows = [e for e in rows
+                   if "error" not in (e.get("summary") or {})]
+        if not ok_rows:
+            if tag in require:
+                detail = (f"{len(rows)} entries, all failed to summarize"
+                          if rows else "no warmed entries in the dumps")
+                findings.append(Finding(
+                    tag, "-", "-",
+                    f"required contract row has nothing to audit "
+                    f"({detail}) — did the suite stop warming this "
+                    f"cache?"))
+            continue
+        for e in ok_rows:
+            findings.extend(_entry_checks(tag, contract, e))
+        if contract.donation == "required":
+            any_aliased = any((e["summary"].get("donation") or {})
+                              .get("aliased") for e in ok_rows)
+            if not any_aliased:
+                findings.append(Finding(
+                    tag, "-", "-",
+                    f"contract requires donation but none of the "
+                    f"{len(ok_rows)} audited entries carries an "
+                    f"input_output_alias"))
+        if contract.require_sharded_input:
+            multi = [e for e in ok_rows
+                     if int(e["summary"].get("num_devices") or 1) > 1]
+            if multi and not any(
+                    _has_sharded_input(e, contract.large_bytes_floor)
+                    for e in multi):
+                findings.append(Finding(
+                    tag, "-", "-",
+                    f"contract requires a sharded (1/N) large input in at "
+                    f"least one multi-device entry; all "
+                    f"{len(multi)} show only replicated inputs"))
+        if contract.require_collectives:
+            multi = [e for e in ok_rows
+                     if int(e["summary"].get("num_devices") or 1) > 1]
+            if multi:
+                have = {}
+                for e in multi:
+                    for kind, v in (e["summary"].get("collectives")
+                                    or {}).items():
+                        have[kind] = have.get(kind, 0) + v["count"]
+                for kind, need in contract.require_collectives.items():
+                    if have.get(kind, 0) < need:
+                        findings.append(Finding(
+                            tag, "-", "-",
+                            f"contract requires >= {need} {kind} across "
+                            f"multi-device entries, found "
+                            f"{have.get(kind, 0)} (programs: "
+                            + ", ".join(e.get("key", "?")[:60]
+                                        for e in multi[:4]) + ")"))
+    return findings

@@ -1,0 +1,93 @@
+"""CLI: ``python -m tools.hlolint check <dump-dir-or-files...>``.
+
+The blocking CI gate (``ci/run.sh unit()``) runs the existing suites
+with ``MXNET_HLOLINT_DUMP=<dir>`` — each suite process writes its warmed
+caches' program summaries at exit — then::
+
+    python -m tools.hlolint check <dir> \
+        --require spmd,zero1,pipeline,serving,generation,lazy \
+        --strict --explain
+
+``--require`` makes an empty row a failure (a suite that silently
+stopped warming its cache must not pass the gate vacuously). ``--strict``
+exits 1 on any finding. ``--explain`` prints the offending executable's
+collective inventory under each finding; ``show`` prints every entry's
+inventory without auditing.
+
+Exit codes: 0 clean, 1 findings under --strict, 2 usage/input error.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+from . import audit, format_inventory, load_dumps
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hlolint",
+        description="compiled-program contract auditor for mxnet_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    chk = sub.add_parser("check", help="audit dumps against the registry")
+    chk.add_argument("paths", nargs="+",
+                     help="dump files or directories "
+                          "(MXNET_HLOLINT_DUMP output)")
+    chk.add_argument("--registry", default="tools.hlolint.contracts",
+                     help="module exposing CONTRACTS "
+                          "(default: the checked-in registry)")
+    chk.add_argument("--require", default="",
+                     help="comma-separated tags that must have audited "
+                          "entries (empty row = failure)")
+    chk.add_argument("--strict", action="store_true",
+                     help="exit 1 when any finding survives (the CI gate)")
+    chk.add_argument("--explain", action="store_true",
+                     help="print each offender's collective inventory / "
+                          "donation table under its finding")
+
+    show = sub.add_parser("show", help="print every entry's inventory")
+    show.add_argument("paths", nargs="+")
+
+    args = ap.parse_args(argv)
+
+    try:
+        entries = load_dumps(args.paths)
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"hlolint: cannot load dumps: {e}\n")
+        return 2
+
+    if args.cmd == "show":
+        for e in entries:
+            print(format_inventory(e))
+            print()
+        print(f"hlolint: {len(entries)} audited executable(s)")
+        return 0
+
+    try:
+        registry = importlib.import_module(args.registry).CONTRACTS
+    except (ImportError, AttributeError) as e:
+        sys.stderr.write(f"hlolint: cannot load registry "
+                         f"{args.registry!r}: {e}\n")
+        return 2
+    require = [t.strip() for t in args.require.split(",") if t.strip()]
+
+    findings = audit(entries, registry, require=require)
+    tags = sorted({e.get("tag") for e in entries})
+    print(f"hlolint: audited {len(entries)} executable(s) across "
+          f"{len(tags)} tag(s): {', '.join(str(t) for t in tags)}")
+    for f in findings:
+        print(f"FAIL {f}")
+        if args.explain and f.entry is not None:
+            for line in format_inventory(f.entry).splitlines():
+                print(f"     {line}")
+    if findings:
+        print(f"\nhlolint: {len(findings)} contract violation(s)")
+        return 1 if args.strict else 0
+    print("hlolint: all contracts hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

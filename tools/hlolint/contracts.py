@@ -1,0 +1,116 @@
+"""The checked-in hlolint contract registry — one row per audit tag.
+
+A row keys on the entry's audit tag: the ``get_or_build(audit=...)``
+label when the call site passes one (the fused train step tags by the
+composition that built the program), else the cache name. Every claim
+below is the machine-checked form of a ROADMAP/BENCH assertion that was
+previously only measured, never proved from the compiled program:
+
+* ``zero1`` — the arXiv:2004.13336 lowering: shard-local update +
+  AllGather of the rebuilt weights, 1/N flat state visible as a sharded
+  input in the compiled layout, every donated buffer actually aliased,
+  and NO full-bucket all-reduce (a full-bucket all-reduce means the
+  sharded update silently regressed to replicated-with-extra-steps —
+  the first audit of these programs found exactly that: the partitioner
+  implemented the pack's sharded concat as dynamic-update-slice + a
+  full-bucket all-reduce per pack, fixed by replicate-first packing in
+  ``parallel/zero1.py``). MEASURED backend truth: XLA:CPU lowers the
+  dp-scatter constraint as all-reduce+slice and never materializes a
+  ``reduce-scatter`` op from GSPMD constraints (it does on the fsdp
+  lanes), so the row REQUIRES all-gather and ALLOWS reduce-scatter
+  rather than requiring it — the byte discipline is enforced by the
+  full-bucket all-reduce ban. Weights stay replicated BY DESIGN (only
+  optimizer state shards), so there is no replicated-fraction cap.
+* ``spmd`` — the arXiv:2105.04663 GSPMD step: parameters, gradients and
+  optimizer state ride at ~1/N, so the compiled input layout must show a
+  mostly-sharded byte profile; small indivisible params (biases, the
+  tp-chain restarts, anything under MXNET_SPMD_FSDP_MIN_SIZE) legitimately
+  stay replicated, hence a fraction cap instead of a blanket ban.
+* ``pipeline`` — params enter the GPipe shard_map 1/S-sharded and are
+  gathered JUST IN TIME inside the schedule: all-gather inside the
+  program is the declared exception to the residency rule, and the
+  stage handoff must show up as collective-permute.
+* ``serving`` — for_training=False bucket executors: no donation ever
+  (weights are shared across buckets and with the owning module), zero
+  collectives at one device; a sharded serving bind (MXNET_SPMD) may
+  all-reduce on row-parallel boundaries and gather.
+* ``generation`` — slab programs donate (decode/prefill/fork/verify
+  replace the KV slab in place — an unaliased donation would double slab
+  memory per tick) and a tp=1 decode must contain ZERO cross-device
+  collectives (the fleet scales by REPLICA at tp=1; a stray collective
+  means the one-mesh default leaked into the decode graph).
+* ``lazy`` — captured op-by-op segments: never donated, never
+  collective (a segment that grew a collective means a dist op was
+  captured instead of flushed).
+
+Rows beyond the six audited-by-default tags (``optimizer.fused_update``,
+``fused_step``) exist so the tpulint ``donation-aliasing`` rule can
+prove every donate site in the tree has a contract home; they are
+audited whenever ``MXNET_HLOLINT_CACHES`` includes them.
+"""
+from __future__ import annotations
+
+from . import Contract
+
+CONTRACTS = {
+    "zero1": Contract(
+        donation="required",
+        donation_bytes_floor=512,
+        allowed_collectives=frozenset(
+            {"reduce-scatter", "all-gather", "all-reduce"}),
+        require_collectives={"all-gather": 1},
+        forbid_full_allreduce=True,
+        require_sharded_input=True,
+        large_bytes_floor=512,
+        note="shard-local update -> all-gather, 1/N flat state visible "
+             "in the compiled layout, no full-bucket all-reduce "
+             "(XLA:CPU never emits reduce-scatter from constraints — "
+             "see module docstring)"),
+    "spmd": Contract(
+        donation="required",
+        allowed_collectives=frozenset(
+            {"all-reduce", "all-gather", "reduce-scatter",
+             "collective-permute", "all-to-all"}),
+        require_sharded_input=True,
+        max_replicated_fraction=0.7,
+        note="params+grads+state at ~1/N; small indivisible params may "
+             "stay replicated (fraction cap, not a ban)"),
+    "pipeline": Contract(
+        donation="required",
+        allowed_collectives=frozenset(
+            {"collective-permute", "all-gather", "reduce-scatter",
+             "all-reduce"}),
+        require_collectives={"collective-permute": 1},
+        note="ppermute is the stage handoff; 1/S residency only holds "
+             "under the spmd composition (audited by the spmd row)"),
+    "serving": Contract(
+        donation="forbidden",
+        single_device_collectives_ok=False,
+        allowed_collectives=frozenset({"all-reduce", "all-gather"}),
+        note="shared weights are never donated; collectives only in a "
+             "sharded (MXNET_SPMD) bind"),
+    "generation": Contract(
+        donation="required",
+        single_device_collectives_ok=False,
+        allowed_collectives=frozenset(
+            {"all-reduce", "all-gather", "collective-permute"}),
+        note="slab donated in place every tick; tp=1 decode has zero "
+             "cross-device collectives"),
+    "lazy": Contract(
+        donation="forbidden",
+        single_device_collectives_ok=False,
+        allowed_collectives=frozenset(),
+        note="captured segments never donate and never hide a "
+             "collective"),
+    # rows for the remaining donate sites (audited on request via
+    # MXNET_HLOLINT_CACHES; the tpulint donation-aliasing rule requires
+    # every donate site to resolve to SOME row here)
+    "optimizer.fused_update": Contract(
+        donation="required",
+        note="the aggregated gluon/updater fused update donates weights "
+             "and state"),
+    "fused_step": Contract(
+        donation="required",
+        note="the plain (unsharded) fused train step; grad-sync psum of "
+             "full gradients is legitimate here"),
+}

@@ -60,6 +60,21 @@ def main(argv=None):
             f"{n} {v.get('misses', 0)} compiled/{v.get('hits', 0)} hits"
             for n, v in sorted(caches.items()))
         sys.stdout.write(f"\nnamed compile caches: {rows}\n")
+    blamed = counters.get("compile.blamed_misses", 0)
+    if blamed:
+        axes = {k.split("compile.blame_axis.", 1)[1]: v
+                for k, v in counters.items()
+                if k.startswith("compile.blame_axis.")}
+        line = f"\nhlolint: {blamed} steady-state recompile(s) blamed"
+        if axes:
+            line += " — axes: " + ", ".join(
+                f"{k} {v}" for k, v in
+                sorted(axes.items(), key=lambda kv: -kv[1]))
+        line += ("\n  (each is a compile_blame health-journal event naming "
+                 "the key axis that changed vs the nearest warmed "
+                 "executable — docs/faq/perf.md \"Auditing the compiled "
+                 "program\")\n")
+        sys.stdout.write(line)
     lazy_segs = counters.get("lazy.segments", 0)
     lazy_ops = counters.get("lazy.ops_captured", 0)
     if lazy_segs or lazy_ops:

@@ -236,6 +236,142 @@ def check_donation_persistence(sf):
 
 
 # ---------------------------------------------------------------------------
+# donation-aliasing: every donate site resolves to an hlolint contract row
+# ---------------------------------------------------------------------------
+#
+# The hlolint donation AUDIT (tools/hlolint) proves declared donations
+# actually alias in the compiled program — but it can only audit programs
+# whose cache entries carry a contract row. This rule closes the loop
+# statically: a `donate_argnums`/`donate_argnames` executable built
+# outside a named-CompileCache builder is invisible to the audit, and a
+# builder whose row cannot be found in tools/hlolint/contracts.py is a
+# contract hole.
+
+
+def _hlolint_contract_rows():
+    """The checked-in registry's tag set (None when unimportable — the
+    structural checks still run; row validation is skipped rather than
+    spraying false findings from an unrelated import error)."""
+    try:
+        from tools.hlolint.contracts import CONTRACTS
+
+        return set(CONTRACTS)
+    except Exception:  # noqa: BLE001 — registry validation is best-effort
+        return None
+
+
+def _compile_cache_literals(tree):
+    """String names passed to CompileCache(...) in this module."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_name(node) == "CompileCache":
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                names.add(node.args[0].value)
+    return names
+
+
+def check_donation_aliasing(sf):
+    """``donate_argnums``/``donate_argnames`` only inside a builder handed
+    to ``CompileCache.get_or_build`` whose hlolint contract row exists:
+    pass ``audit="<row>"`` (a literal found in
+    ``tools/hlolint/contracts.py``), or let the cache name resolve to a
+    row when the module constructs exactly one named ``CompileCache``. A
+    donation the audit cannot see is exactly how "it silently stopped
+    aliasing" regressions survive review."""
+    out = []
+    rows = _hlolint_contract_rows()
+    cache_names = _compile_cache_literals(sf.tree)
+    donating = _donating_defs(sf.tree)
+
+    sanctioned_defs = set()     # builder def names referenced by any
+    sanctioned_lambdas = set()  # get_or_build; id() for inline lambdas
+
+    def builder_of(node):
+        return node.args[1] if len(node.args) >= 2 else _kw(node, "build")
+
+    gob_calls = []  # (call node, enclosing-def stack) — lexical builder
+                    # resolution, same discipline as donation-persistence
+
+    def collect(node, stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node]
+        for child in ast.iter_child_nodes(node):
+            collect(child, stack)
+        if isinstance(node, ast.Call) \
+                and _call_name(node) == "get_or_build":
+            gob_calls.append((node, stack))
+
+    collect(sf.tree, [])
+    for node, stack in gob_calls:
+        build = builder_of(node)
+        is_donating = False
+        if isinstance(build, ast.Lambda):
+            sanctioned_lambdas.add(id(build))
+            is_donating = _has_donate_kw(build)
+        elif isinstance(build, ast.Name):
+            sanctioned_defs.add(build.id)
+            for scope in reversed([sf.tree] + stack):
+                local = donating.get(scope, {})
+                if build.id in local:
+                    is_donating = local[build.id]
+                    break
+        if not is_donating:
+            continue
+        audit = _kw(node, "audit")
+        if audit is None:
+            if len(cache_names) == 1 and rows is not None \
+                    and next(iter(cache_names)) not in rows:
+                out.append(Finding(
+                    sf.path, node.lineno, "donation-aliasing",
+                    f"donating builder compiles under CompileCache"
+                    f"({next(iter(cache_names))!r}) which has no contract "
+                    f"row in tools/hlolint/contracts.py — add a row or an "
+                    f"audit= tag so the donation audit can see it"))
+            elif len(cache_names) != 1:
+                out.append(Finding(
+                    sf.path, node.lineno, "donation-aliasing",
+                    "donating builder on a cache this module does not "
+                    "construct — pass audit=\"<row>\" naming its "
+                    "tools/hlolint/contracts.py contract row"))
+        elif isinstance(audit, ast.Constant) \
+                and isinstance(audit.value, str):
+            if rows is not None and audit.value not in rows:
+                out.append(Finding(
+                    sf.path, node.lineno, "donation-aliasing",
+                    f"audit={audit.value!r} names no contract row in "
+                    f"tools/hlolint/contracts.py"))
+        # a non-literal audit expression (the executor's composition
+        # dispatch) is sanctioned — the runtime gate audits the real tag
+
+    def walk(node, stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            stack = stack + [node]
+        for child in ast.iter_child_nodes(node):
+            walk(child, stack)
+        if not isinstance(node, ast.Call):
+            return
+        if not any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in node.keywords):
+            return
+        for scope in stack:
+            if isinstance(scope, ast.Lambda):
+                if id(scope) in sanctioned_lambdas:
+                    return
+            elif scope.name in sanctioned_defs:
+                return
+        out.append(Finding(
+            sf.path, node.lineno, "donation-aliasing",
+            "donated executable built outside a CompileCache.get_or_build "
+            "builder — it is invisible to the hlolint donation audit "
+            "(tools/hlolint); route it through a named cache"))
+
+    walk(sf.tree, [])
+    return out
+
+
+# ---------------------------------------------------------------------------
 # gate-discipline: no import-time side effects
 # ---------------------------------------------------------------------------
 
@@ -560,6 +696,7 @@ def check_env_registry(sources, env_doc):
 RULES.update({
     "executable-cache": check_executable_cache,
     "donation-persistence": check_donation_persistence,
+    "donation-aliasing": check_donation_aliasing,
     "gate-discipline": check_gate_discipline,
     "tracer-hygiene": check_tracer_hygiene,
     # env-var-registry is project-level (cross-file + doc table), so it
