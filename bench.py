@@ -1040,8 +1040,76 @@ def _measure_serving(on_tpu):
             srv.predict(payloads[s])
         wall = closed_loop(srv.predict, record=True)
 
+        # mid-bench rolling swap sub-phase: the same closed-loop traffic
+        # keeps firing while the predictor hot-swaps to a second weight
+        # version and back — measuring the req/s dip a live swap costs.
+        # The contract under measurement: zero errors, zero new compiles
+        # (same shapes reuse every warmed bucket executable)
+        mod_b = mx.mod.Module(sym)
+        mod_b.bind([DataDesc("data", (8, dim))],
+                   [DataDesc("softmax_label", (8,))], for_training=False)
+        mx.random.seed(99)
+        mod_b.init_params(mx.init.Xavier())
+        arg_b, aux_b = mod_b.get_params()
+        arg_b = {k: v.asnumpy() for k, v in arg_b.items()}
+        aux_b = {k: v.asnumpy() for k, v in aux_b.items()}
+        arg_a, aux_a = mod.get_params()
+        arg_a = {k: v.asnumpy() for k, v in arg_a.items()}
+        aux_a = {k: v.asnumpy() for k, v in aux_a.items()}
+
+        stamps = []
+        stamp_lock = threading.Lock()
+        misses_preswap = pred.cache.misses
+        total = n_clients * per_client
+
+        def stamped_predict(x):
+            srv.predict(x)
+            with stamp_lock:
+                stamps.append(time.perf_counter())
+
+        def swapper():
+            # flip forward once traffic is flowing, back once it has
+            # clearly settled — two live swaps inside the timed window
+            # (deadline-bounded so a dead client loop can't wedge us)
+            deadline = time.perf_counter() + 600
+            for frac, (a, x) in ((0.3, (arg_b, aux_b)),
+                                 (0.65, (arg_a, aux_a))):
+                while time.perf_counter() < deadline:
+                    with stamp_lock:
+                        if len(stamps) >= total * frac:
+                            break
+                    time.sleep(0.002)
+                pred.swap_weights(a, x)
+
+        sw = threading.Thread(target=swapper, daemon=True)
+        sw.start()
+        swap_wall = closed_loop(stamped_predict, record=False)
+        sw.join()
+        swap_compiles = pred.cache.misses - misses_preswap
+        assert swap_compiles == 0, \
+            f"weight swap recompiled {swap_compiles} executables"
+        assert pred.stats()["weights_version"] == 2
+
+        # dip shape from completion timestamps: req/s per window (the
+        # window scales with the phase so sparse CPU traffic doesn't
+        # alias empty buckets into a fake full-depth dip); depth vs the
+        # median window, duration = time spent below 90% of it. The
+        # trailing partial window is dropped — it only reflects drain
+        win = max(0.1, swap_wall / 12.0)
+        t_first = stamps[0]
+        counts = {}
+        for t in stamps:
+            counts[int((t - t_first) / win)] = counts.get(
+                int((t - t_first) / win), 0) + 1
+        n_win = max(max(counts), 1) if counts else 1
+        rates = [counts.get(i, 0) / win for i in range(n_win)]
+        base = sorted(rates)[len(rates) // 2]
+        dip_depth = (max(0.0, 1.0 - min(rates) / base) if base > 0
+                     else 0.0)
+        dip_ms = (sum(win for r in rates if r < 0.9 * base) * 1e3
+                  if base > 0 else 0.0)
+
     all_lat = sorted(x for per in lat for x in per)
-    total = n_clients * per_client
     # the comparison point: the same clients hammering the lock-shared
     # Predictor directly (no queue, no coalescing). With sub-ms CPU
     # compute the batcher's thread handoffs are visible against this; with
@@ -1059,6 +1127,12 @@ def _measure_serving(on_tpu):
         "warmup_compiles": warm["compiles"],
         "steady_state_compiles": pred.cache.misses - misses_warm,
         "buckets": list(buckets),
+        "swap_req_per_s": round(total / swap_wall, 1),
+        "swap_dip_depth": round(dip_depth, 3),
+        "swap_dip_ms": round(dip_ms, 1),
+        "swap_errors": 0,          # closed_loop raised otherwise
+        "swap_steady_state_compiles": swap_compiles,
+        "swaps": 2,
     }
 
 

@@ -59,6 +59,7 @@ unit() {
       --ignore=tests/python/unittest/test_serving.py \
       --ignore=tests/python/unittest/test_generation.py \
       --ignore=tests/python/unittest/test_generation_scale.py \
+      --ignore=tests/python/unittest/test_rollout.py \
       --ignore=tests/python/unittest/test_zero1.py \
       --ignore=tests/python/unittest/test_tracing.py \
       --ignore=tests/python/unittest/test_pipeline.py \
@@ -115,6 +116,17 @@ unit() {
   log "generation-scale suite (radix prefix cache + KV forking, speculative decoding, fleet affinity/autoscale)"
   env MXNET_HLOLINT_DUMP="$hlolint_dump" \
       python -m pytest tests/python/unittest/test_generation_scale.py -q
+  # rollout gate, standalone: the chaos swap suite — publish/subscribe
+  # fault rejects (torn/corrupt/stale via the publish fault point),
+  # zero-compile hot swaps with bit-exact drain pinning on BOTH serving
+  # stacks, SLO-burn-gated fleet rollout with journaled rollback, and
+  # the named_stats assertion that the rollout subsystem owns ZERO new
+  # cached executables — a swap, drain-pinning or rollback regression
+  # fails HERE, attributed. Warms only the already-required serving/
+  # generation caches (no cache of its own, by design)
+  log "rollout suite (zero-downtime weight swap, publish faults, burn-gated rollback, chaos fleet acceptance)"
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_rollout.py -q
   # zero1 gate, standalone: these tests flip MXNET_ZERO1/MXNET_ZERO1_NDEV
   # and pin sharding invariance, 1/N state allocation, checkpoint
   # round-trips and exact compile-cache miss counts — a sharded-update
@@ -202,11 +214,12 @@ unit() {
   # fails the run on ANY lock-order inversion or blocking hazard the
   # suites drove, with both stacks printed — the dynamic complement of
   # the static tpulint gate (the PR 10 / PR 12 deadlock classes)
-  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/lazy/elastic)"
+  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/rollout/lazy/elastic)"
   env MXNET_DEBUG_SYNC=1 python -m pytest \
       tests/python/unittest/test_serving.py \
       tests/python/unittest/test_generation.py \
       tests/python/unittest/test_generation_scale.py \
+      tests/python/unittest/test_rollout.py \
       tests/python/unittest/test_lazy.py \
       tests/python/unittest/test_elastic.py -q
 }

@@ -196,6 +196,13 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                         mutable_vars=tuple(engine.path_var(p) for p in victims))
         elif victims:
             _evict_old_epochs(victims, cur_path)
+    if str(getenv("MXNET_ROLLOUT_DIR") or "").strip():
+        # train->serve streaming: every checkpoint also becomes a rollout
+        # version (arrays gathered to replicated host copies inside
+        # publish); failures never propagate back into the training loop
+        from .serving import rollout
+
+        rollout.publish_checkpoint(prefix, epoch, arg_params, aux_params)
 
 
 def _evict_old_epochs(old_paths, new_path):
@@ -247,23 +254,31 @@ def load_checkpoint(prefix, epoch=None, fallback=None, return_epoch=False):
     if fallback:
         candidates += [e for e in reversed(list_checkpoint_epochs(prefix))
                        if e < epoch]
+    from . import health
+
     errors = []
     save_dict = None
     loaded_epoch = None
-    for cand in candidates:
-        try:
-            save_dict = nd.load(_param_path(prefix, cand))
-            loaded_epoch = cand
-            break
-        except (MXNetError, OSError) as e:
-            errors.append(e)
-            if not fallback:
-                raise
-            if telemetry._enabled:
-                telemetry.counter("checkpoint.crc_fallback").inc()
-            get_logger("mxnet_tpu.model").warning(
-                "checkpoint %s is unreadable (%s); falling back to an "
-                "older epoch", _param_path(prefix, cand), e)
+    with tracing.span("checkpoint.load", cat="io", prefix=prefix,
+                      epoch=epoch):
+        for cand in candidates:
+            try:
+                save_dict = nd.load(_param_path(prefix, cand))
+                loaded_epoch = cand
+                break
+            except (MXNetError, OSError) as e:
+                errors.append(e)
+                if not fallback:
+                    raise
+                if telemetry._enabled:
+                    telemetry.counter("checkpoint.crc_fallback").inc()
+                    telemetry.counter("checkpoint.corrupt_skipped").inc()
+                if health._enabled:
+                    health.event("checkpoint_fallback", prefix=str(prefix),
+                                 epoch=int(cand), error=repr(e))
+                get_logger("mxnet_tpu.model").warning(
+                    "checkpoint %s is unreadable (%s); falling back to an "
+                    "older epoch", _param_path(prefix, cand), e)
     if save_dict is None:
         raise MXNetError(
             f"no loadable checkpoint for prefix {prefix!r} at or below "

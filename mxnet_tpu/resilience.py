@@ -26,13 +26,23 @@ fields separated by ``,``::
     point=write,path=*-0002.params,truncate=64
     point=prefetch,error=KILL
     point=write,path=*.params,times=3,error=EIO
+    point=publish,path=*.manifest.json,error=CORRUPT
 
-Fields: ``point`` (open|read|write|prefetch|shm — required), ``path``
-(fnmatch pattern, default ``*``), ``nth`` (first matching event to fault,
-1-based, default 1), ``times`` (how many consecutive events to fault,
-``inf`` allowed, default 1), ``error`` (errno name, default EIO; ``KILL``
-raises :class:`ThreadKilled`), ``truncate`` (byte count — the write lands
-but is cut at K bytes, a torn write).
+Fields: ``point`` (open|read|write|prefetch|shm|publish — required),
+``path`` (fnmatch pattern, default ``*``), ``nth`` (first matching event
+to fault, 1-based, default 1), ``times`` (how many consecutive events to
+fault, ``inf`` allowed, default 1), ``error`` (errno name, default EIO;
+``KILL`` raises :class:`ThreadKilled`), ``truncate`` (byte count — the
+write lands but is cut at K bytes, a torn write).
+
+The ``publish`` point covers a weight-rollout publish
+(``serving.rollout.publish``) end to end. Errno rules raise as usual;
+three publish-only self-inflicted modes return the rule for the
+publisher to enact on its own output: ``truncate=K`` tears the manifest
+at K bytes (torn rename), ``error=CORRUPT`` flips a payload byte after
+the CRC footers land, and ``error=STALE`` stamps the manifest with an
+already-published version number — the pathologies the rollout
+subscriber's reject-and-keep-serving path is tested against.
 """
 from __future__ import annotations
 
@@ -185,9 +195,15 @@ class FaultRule:
 
     def __init__(self, point, path="*", nth=1, times=1, error="EIO",
                  truncate=None):
-        if point not in ("open", "read", "write", "prefetch", "shm"):
+        if point not in ("open", "read", "write", "prefetch", "shm",
+                         "publish"):
             raise MXNetError(f"MXNET_FAULT_SPEC: unknown fault point {point!r}")
-        if error != "KILL" and not hasattr(_errno, error):
+        if error in ("CORRUPT", "STALE"):
+            if point != "publish":
+                raise MXNetError(
+                    f"MXNET_FAULT_SPEC: error={error} is only valid at "
+                    f"point=publish, not {point!r}")
+        elif error != "KILL" and not hasattr(_errno, error):
             raise MXNetError(f"MXNET_FAULT_SPEC: unknown errno name {error!r}")
         self.point = point
         self.path = path
@@ -210,6 +226,12 @@ class FaultRule:
         if self.truncate is not None:
             _logger().warning("fault injection: truncating write of %s at %d bytes",
                               path, self.truncate)
+            return self
+        if self.error in ("CORRUPT", "STALE"):
+            # self-inflicted publish faults: the publisher enacts them on
+            # its own output (flip a payload byte / stamp an old version)
+            _logger().warning("fault injection: %s publish of %s",
+                              self.error, path)
             return self
         if self.error == "KILL":
             raise ThreadKilled(f"fault injection: killed at {self.point} of {path}")

@@ -31,7 +31,12 @@ thread-safe server:
   histogram, time-in-queue / compute / end-to-end latency p50-p95-p99,
   timeout + rejected counters, and the derived
   ``serving.batch_fill_ratio`` (``tools/telemetry_report.py`` renders a
-  summary; ``docs/faq/perf.md`` explains how to size buckets from it).
+  summary; ``docs/faq/perf.md`` explains how to size buckets from it);
+* :mod:`rollout` — zero-downtime train→serve weight streaming: versioned
+  CRC-verified :class:`WeightSet` publishes over a watched directory
+  (``MXNET_ROLLOUT_DIR``), atomic ``swap_weights`` hot-flips on both
+  serving stacks with zero steady-state compiles, and SLO-burn-gated
+  ``GenerationRouter.rolling_swap`` with automatic journaled rollback.
 
 Quick start::
 
@@ -46,10 +51,15 @@ from .admission import (AdmissionQueue, DeadlineExceededError, QueueFullError,
 from .batcher import DynamicBatcher
 from .generation import GenerationEngine, GenerationRouter, GenerationStream
 from .predictor import Predictor, bucket_ladder
+from .rollout import (RolloutSubscriber, RolloutWatcher, WeightSet, publish,
+                      publish_checkpoint)
 from .warmup import warmup
 from . import generation
+from . import rollout
 
 __all__ = ["Predictor", "DynamicBatcher", "AdmissionQueue", "Request",
            "ServingError", "QueueFullError", "DeadlineExceededError",
            "ServerClosedError", "bucket_ladder", "warmup", "generation",
-           "GenerationEngine", "GenerationRouter", "GenerationStream"]
+           "GenerationEngine", "GenerationRouter", "GenerationStream",
+           "rollout", "WeightSet", "RolloutSubscriber", "RolloutWatcher",
+           "publish", "publish_checkpoint"]

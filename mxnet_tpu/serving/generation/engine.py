@@ -135,7 +135,7 @@ class _Session:
     """Engine-side state of one admitted (or queued) generation."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline", "stream",
-                 "span", "slot", "generated", "prefix_len")
+                 "span", "slot", "generated", "prefix_len", "version")
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline, stream):
         self.prompt = prompt            # np.int32 [n]
@@ -147,6 +147,10 @@ class _Session:
         self.slot = None
         self.generated = 0
         self.prefix_len = 0             # cached tokens forked at admission
+        self.version = 0                # weights version pinned at admission
+        #                                 (rollout: the session finishes
+        #                                 bit-exact on these weights even
+        #                                 after a swap)
 
 
 class GenerationEngine:
@@ -218,6 +222,14 @@ class GenerationEngine:
         self._logger = get_logger("mxnet_tpu.serving.generation")
 
         self._cache = CompileCache("generation")
+        # weight rollout state: _param_sets pins every weights version a
+        # live session may still decode under — {version: (params, ws)}
+        # where ws is the publishing WeightSet (None for construction
+        # params). swap_weights() flips _params/_weights_version between
+        # ticks; _gc_param_sets() releases a version once no session
+        # pins it
+        self._weights_version = 0
+        self._param_sets = {0: (params, None)}
         self._ck, self._cv = model.init_cache(self._slots, self._slab_len)
         # host-side slot metadata — only the tick loop (under _tick_lock)
         # mutates these
@@ -309,14 +321,32 @@ class GenerationEngine:
         """The engine's :class:`RadixPrefixCache` (None when disabled)."""
         return self._prefix
 
+    @property
+    def weights_version(self):
+        """Version of the CURRENT weight set (new admissions use it; live
+        sessions keep the version they were admitted under)."""
+        return self._weights_version
+
+    @property
+    def live_weight_versions(self):
+        """Sorted versions some live session still decodes under plus the
+        current one — >1 entry only while an old version drains after a
+        swap."""
+        versions = {s.version for s in self._sessions if s is not None}
+        versions.add(self._weights_version)
+        return sorted(versions)
+
     def prefix_match_len(self, prompt):
         """Longest USABLE cached prefix of ``prompt`` on this engine (0
         when below the fork threshold or the cache is off) — the router's
-        affinity probe; cheap host trie walk, no device work."""
+        affinity probe; cheap host trie walk, no device work. Only
+        current-version entries count (admission forks filter the same
+        way)."""
         if self._prefix is None:
             return 0
         m = self._prefix.match_len(
-            np.asarray(prompt, dtype=np.int32).reshape(-1))
+            np.asarray(prompt, dtype=np.int32).reshape(-1),
+            version=self._weights_version)
         return m if m >= self._prefix_min else 0
 
     @property
@@ -526,6 +556,158 @@ class GenerationEngine:
         return {"buckets": list(buckets), "compiles": compiles,
                 "seconds": seconds, "cache_entries": len(self._cache)}
 
+    # -- weight rollout ------------------------------------------------------
+
+    def _place_params(self, new):
+        """Validate and device-place one incoming host weight dict against
+        the CURRENT params: same key set, same shapes, values cast to the
+        current dtypes and placed with the model's partition specs — the
+        guarantees that make the swap a pure buffer substitution (every
+        executable key is shape-only, params are non-donated arguments,
+        so the warmed decode/verify/prefill programs are reused
+        untouched)."""
+        import jax
+
+        cur = self._params
+        if set(new) != set(cur):
+            missing = sorted(set(cur) - set(new))
+            extra = sorted(set(new) - set(cur))
+            raise MXNetError(
+                f"swap_weights: parameter names differ from the bound set "
+                f"(missing {missing}, unexpected {extra}) — a hot swap "
+                "must cover exactly the bound parameters")
+        specs = self._model.param_specs()
+        placed = {}
+        for name, v in new.items():
+            old = cur[name]
+            arr = np.asarray(v)
+            if tuple(arr.shape) != tuple(old.shape):
+                raise MXNetError(
+                    f"swap_weights: parameter {name!r} has shape "
+                    f"{tuple(arr.shape)} but the warmed executables "
+                    f"expect {tuple(old.shape)} — identical shapes/dtypes "
+                    "are what make the swap compile-free")
+            placed[name] = jax.device_put(
+                arr.astype(old.dtype, copy=False), specs[name])
+        return placed
+
+    def swap_weights(self, weights, draft_params=None, version=None):
+        """Atomic zero-downtime weight flip, BETWEEN ticks (takes the
+        tick lock): new admissions prefill and decode under the new
+        weights; sessions already live keep decoding — bit-exact — under
+        the version they were admitted with until they finish (the tick
+        runs one executable dispatch per live version, same programs,
+        positions of other cohorts steered to the slab's safe row). The
+        KV slab, the radix prefix cache structure and the speculative
+        draft slab all survive the flip; prefix entries stamped with
+        other versions are evicted (their KV would splice old-weight
+        rows under new-weight logits), and a checkpoint draft's params
+        flip immediately for every slot — stale draft slab rows only
+        cost acceptance ratio, never correctness (the verify is the
+        ground truth).
+
+        ``weights`` is a :class:`~..rollout.WeightSet` or a plain host
+        param dict. Returns the new version, or None when ``version``
+        equals the current one (idempotent double-publish no-op).
+        Rolling BACK to a still-pinned older version reuses its placed
+        params directly."""
+        ws = None
+        if hasattr(weights, "arg_params") and hasattr(weights, "version"):
+            ws = weights
+            version = ws.version if version is None else version
+            new = dict(ws.arg_params)
+            new.update(ws.aux_params)
+            if draft_params is None and ws.draft_params:
+                draft_params = ws.draft_params
+        else:
+            new = dict(weights)
+        with self._tick_lock:
+            if version is None:
+                version = self._weights_version + 1
+            version = int(version)
+            if version == self._weights_version:
+                if telemetry._enabled:
+                    telemetry.counter(
+                        "serving.generation.weight_swap_noops").inc()
+                return None
+            held = self._param_sets.get(version)
+            if held is not None:
+                # rollback to a version still pinned by draining sessions:
+                # its placed buffers are right there
+                placed = held[0]
+            else:
+                placed = self._place_params(new)
+                self._param_sets[version] = (
+                    placed, ws.acquire() if ws is not None else None)
+            self._params = placed
+            self._weights_version = version
+            if draft_params and self._draft is not None:
+                self._draft.swap_params(draft_params)
+            if self._prefix is not None:
+                self._prefix.evict_other_versions(version)
+            self._gc_param_sets()
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.weight_swaps").inc()
+            telemetry.gauge("serving.generation.weights_version").set(
+                version)
+        if health._enabled:
+            health.event("rollout_swap", engine=self.health_name,
+                         version=version,
+                         draining=len(self._param_sets) - 1)
+        self._logger.info(
+            "weights swapped to version %d (%d older version(s) still "
+            "draining)", version, len(self._param_sets) - 1)
+        return version
+
+    def weights_snapshot(self):
+        """Replicated host copy of the CURRENT weights (+ draft) and
+        their version — the router pins this before a fleet's first
+        rolling swap so automatic rollback always has a target, even
+        when the construction params were never published."""
+        with self._tick_lock:
+            params = {k: np.asarray(v) for k, v in self._params.items()}
+            draft = None
+            if self._draft is not None and hasattr(self._draft, "_params"):
+                draft = {k: np.asarray(v)
+                         for k, v in self._draft._params.items()}
+            return self._weights_version, params, draft
+
+    def _version_params(self, version):
+        """The placed param dict pinned for ``version`` (the cohort
+        dispatch in _decode/_spec_decode)."""
+        return self._param_sets[version][0]
+
+    def _cohorts(self):
+        """Live slots grouped by pinned weights version — one entry in
+        steady state; more only while old versions drain after swaps."""
+        out = {}
+        for slot, sess in enumerate(self._sessions):
+            if sess is not None:
+                out.setdefault(sess.version, []).append(slot)
+        return out
+
+    def _gc_param_sets(self):
+        """Release weight versions no live session pins anymore (tick
+        lock held). The current version always stays; a released
+        version's WeightSet drops its engine reference and the drain is
+        journaled — 'both WeightSets stay alive until the old one
+        drains' is exactly this refcount."""
+        if len(self._param_sets) <= 1:
+            return
+        pinned = {s.version for s in self._sessions if s is not None}
+        pinned.add(self._weights_version)
+        for v in [v for v in self._param_sets if v not in pinned]:
+            _, ws = self._param_sets.pop(v)
+            if ws is not None:
+                ws.release()
+            if health._enabled:
+                health.event("rollout_drained", engine=self.health_name,
+                             version=v, current=self._weights_version)
+        if telemetry._enabled:
+            telemetry.gauge(
+                "serving.generation.weight_versions_live").set(
+                len(self._param_sets))
+
     def close(self, timeout=None):
         """Graceful drain: stop admission (``ServerClosedError`` for new
         submits), keep ticking until every admitted AND queued session
@@ -539,6 +721,13 @@ class GenerationEngine:
             self._worker.join(timeout)
         health.unregister(self.health_name)
         self._beacon.idle()
+        # a closed engine pins no published weights: drop every WeightSet
+        # reference (the placed current params stay usable for reopen-free
+        # introspection)
+        for _, ws in self._param_sets.values():
+            if ws is not None:
+                ws.release()
+        self._param_sets = {self._weights_version: (self._params, None)}
 
     def __enter__(self):
         return self
@@ -555,7 +744,9 @@ class GenerationEngine:
                "sessions": self.sessions_submitted,
                "max_len": self._max_len,
                "spec_k": self._spec_k,
-               "kv_slab_bytes": self.kv_slab_bytes()}
+               "kv_slab_bytes": self.kv_slab_bytes(),
+               "weights_version": self._weights_version,
+               "weight_versions_live": self.live_weight_versions}
         if self._prefix is not None:
             out["prefix"] = self._prefix.stats()
         if self._draft is not None and hasattr(self._draft, "slab_bytes"):
@@ -730,6 +921,10 @@ class GenerationEngine:
                             f"{sess.generated} generated token(s)"))
                 self._admit()
                 self._decode()
+                if len(self._param_sets) > 1:
+                    # a swap transition is draining: release versions
+                    # whose last session just finished
+                    self._gc_param_sets()
             except Exception as e:  # noqa: BLE001 — never-strand + serve on
                 self._logger.error("generation tick failed: %r", e)
                 tick_span.set(error=repr(e))
@@ -744,6 +939,9 @@ class GenerationEngine:
                     self._prefix.clear("slab_reset")
                 if self._draft is not None:
                     self._draft.reset()
+                # every session died with the slab: stale weight versions
+                # have nothing left to drain for
+                self._gc_param_sets()
         if self._has_work():
             # close an assist-vs-worker race: an assist tick pops the
             # queue BEFORE publishing the session as live, and a parked
@@ -788,7 +986,7 @@ class GenerationEngine:
         return [i for i, s in enumerate(self._sessions)
                 if s is None and i not in held]
 
-    def _tick_positions(self):
+    def _tick_positions(self, active=None):
         """Write positions for the fixed-shape decode/verify executables:
         a live slot's length, and the slab's LAST row for every other
         slot. Dead and — critically — CACHE-HELD slots still get a K/V
@@ -798,11 +996,19 @@ class GenerationEngine:
         entry can own (a cached prompt is at most ``max_len - 1`` tokens
         — submit requires >= 1 generated token — and the speculative
         slab adds scratch rows past that). A verify block's clamped
-        writes pile onto the same last row, equally harmless."""
+        writes pile onto the same last row, equally harmless.
+
+        ``active`` (an iterable of slot indices) additionally steers
+        every LIVE slot outside it to the same safe row — the per-version
+        cohort dispatch during a weight-swap transition: each cohort's
+        executable call must advance only its own slots, and a slot only
+        ever attends its own rows, so co-resident garbage writes cannot
+        perturb another cohort's (bit-exact) output."""
         pos = self._lengths.copy()
         safe = self._slab_len - 1
+        act = None if active is None else set(active)
         for i, s in enumerate(self._sessions):
-            if s is None:
+            if s is None or (act is not None and i not in act):
                 pos[i] = safe
         return pos
 
@@ -867,7 +1073,8 @@ class GenerationEngine:
             # slot-to-slot, then prefill only the unmatched suffix
             node = None
             if self._prefix is not None:
-                node, m = self._prefix.match(sess.prompt)
+                node, m = self._prefix.match(
+                    sess.prompt, version=self._weights_version)
                 if node is None or m < self._prefix_min:
                     node = None
                 elif m + self.bucket_for(n - m) > self._slab_len:
@@ -921,6 +1128,9 @@ class GenerationEngine:
                                   bucket=self.bucket_for(n - sess.prefix_len),
                                   slot=slot, cached_prefix=sess.prefix_len)
             sess.slot = slot
+            # pinned for the session's whole life: after a swap the tick
+            # keeps decoding this session under these exact weights
+            sess.version = self._weights_version
             self._sessions[slot] = sess
             self._lengths[slot] = n
             self._last_tok[slot] = tok
@@ -939,7 +1149,8 @@ class GenerationEngine:
             if (self._prefix is not None and n >= self._prefix_min
                     and free):
                 cslot = free[0]
-                if self._prefix.insert(sess.prompt, cslot) is not None:
+                if self._prefix.insert(sess.prompt, cslot,
+                                       version=sess.version) is not None:
                     free.pop(0)
                     fn = self._fork_fn()
                     self._ck, self._cv = fn(
@@ -991,7 +1202,14 @@ class GenerationEngine:
         """ONE fused step over the whole slab; every live session
         advances one token (plain) or up to ``spec_k + 1`` (speculative
         verify). Dead slots ride along as masked garbage — that fixed
-        shape is exactly what makes mid-stream admit/evict free."""
+        shape is exactly what makes mid-stream admit/evict free.
+
+        During a weight-swap transition (live sessions pinned to more
+        than one version) the SAME executable runs once per version
+        cohort with that cohort's pinned params, other cohorts' slots
+        steered to the safe row — N dispatches, zero new programs, and
+        every session's output stays bit-exact with an unswapped engine
+        on its own weights."""
         import jax.numpy as jnp
 
         if self._live == 0:
@@ -1000,35 +1218,42 @@ class GenerationEngine:
             self._spec_decode()
             return
         fn = self._decode_fn()
-        with tracing.span("generation.decode", cat="generation",
-                          live=self._live):
-            toks, self._ck, self._cv = fn(
-                self._params, self._ck, self._cv,
-                jnp.asarray(self._last_tok),
-                jnp.asarray(self._tick_positions()))
-            toks = np.asarray(toks)
+        cohorts = self._cohorts()
+        mixed = len(cohorts) > 1
         trc = tracing._enabled
-        if trc:
-            t_us = tracing.now_us()
         live = 0
-        for slot, sess in enumerate(self._sessions):
-            if sess is None:
-                continue
-            live += 1
-            # the token we fed now occupies position lengths[slot]
-            self._lengths[slot] += 1
-            tok = int(toks[slot])
-            self._last_tok[slot] = tok
-            if trc and sess.span is not None:
-                tracing.emit_span("generation.decode_tick", t_us, 0.0,
-                                  cat="generation", parent=sess.span,
-                                  position=int(self._lengths[slot]))
-            self._deliver(sess, tok)
-            self._maybe_finish(slot)
+        for version in sorted(cohorts):
+            slots = cohorts[version]
+            with tracing.span("generation.decode", cat="generation",
+                              live=len(slots), version=version):
+                toks, self._ck, self._cv = fn(
+                    self._version_params(version), self._ck, self._cv,
+                    jnp.asarray(self._last_tok),
+                    jnp.asarray(self._tick_positions(
+                        slots if mixed else None)))
+                toks = np.asarray(toks)
+            if trc:
+                t_us = tracing.now_us()
+            for slot in slots:
+                sess = self._sessions[slot]
+                if sess is None:
+                    continue
+                live += 1
+                # the token we fed now occupies position lengths[slot]
+                self._lengths[slot] += 1
+                tok = int(toks[slot])
+                self._last_tok[slot] = tok
+                if trc and sess.span is not None:
+                    tracing.emit_span("generation.decode_tick", t_us, 0.0,
+                                      cat="generation", parent=sess.span,
+                                      position=int(self._lengths[slot]))
+                self._deliver(sess, tok)
+                self._maybe_finish(slot)
+            if telemetry._enabled:
+                telemetry.counter("serving.generation.tick_slots").inc(
+                    self._slots)
         if telemetry._enabled:
             telemetry.counter("serving.generation.decode_tokens").inc(live)
-            telemetry.counter("serving.generation.tick_slots").inc(
-                self._slots)
 
     def _spec_decode(self):
         """The speculative verify tick: draft proposes k tokens per live
@@ -1041,62 +1266,75 @@ class GenerationEngine:
         import jax.numpy as jnp
 
         k = self._spec_k
+        # the draft proposes ONCE for all slots with its current (post-
+        # swap) params — proposals are free to be "wrong" for an old-
+        # version cohort, its own verify corrects them bit-exactly; a
+        # bad acceptance ratio during the drain is the whole cost
         props = np.asarray(
             self._draft.propose(k, self._sessions), np.int32)   # [S, k]
         tokens = np.concatenate([self._last_tok[:, None], props], axis=1)
         fn = self._verify_fn()
-        with tracing.span("generation.verify", cat="generation",
-                          live=self._live, k=k):
-            toks, self._ck, self._cv = fn(
-                self._params, self._ck, self._cv, jnp.asarray(tokens),
-                jnp.asarray(self._tick_positions()))
-            toks = np.asarray(toks)                             # [S, k+1]
+        cohorts = self._cohorts()
+        mixed = len(cohorts) > 1
         tele = telemetry._enabled
         trc = tracing._enabled
-        if trc:
-            t_us = tracing.now_us()
         live = accepted = committed_total = 0
-        for slot, sess in enumerate(self._sessions):
-            if sess is None:
-                continue
-            live += 1
-            t = toks[slot]
-            d = props[slot]
-            a = 0
-            while a < k and d[a] == t[a]:
-                a += 1
-            committed = []
-            for j in range(a + 1):
-                # same bookkeeping as one plain decode step: the token we
-                # fed at position lengths[slot] is now in the slab, t[j]
-                # is the sampled-but-not-yet-fed continuation
-                self._lengths[slot] += 1
-                tok = int(t[j])
-                self._last_tok[slot] = tok
-                committed.append(tok)
-                self._deliver(sess, tok)
-                self._maybe_finish(slot)
-                if self._sessions[slot] is None:
-                    break
-            if trc and sess.span is not None:
-                tracing.emit_span("generation.decode_tick", t_us, 0.0,
-                                  cat="generation", parent=sess.span,
-                                  position=int(self._lengths[slot]),
-                                  committed=len(committed), accepted=a)
-            if self._sessions[slot] is not None and self._draft is not None:
-                self._draft.on_commit(slot, committed)
-            # accepted = draft proposals that actually became committed
-            # tokens. On a full commit that is `a` (the bonus token is
-            # not a draft); when the loop broke early on a terminal
-            # state every committed token so far WAS a matching draft —
-            # counting the unreachable tail of `a` would inflate the
-            # acceptance_ratio operators tune k against
-            accepted += min(len(committed), a)
-            committed_total += len(committed)
+        for version in sorted(cohorts):
+            slots = cohorts[version]
+            with tracing.span("generation.verify", cat="generation",
+                              live=len(slots), k=k, version=version):
+                toks, self._ck, self._cv = fn(
+                    self._version_params(version), self._ck, self._cv,
+                    jnp.asarray(tokens),
+                    jnp.asarray(self._tick_positions(
+                        slots if mixed else None)))
+                toks = np.asarray(toks)                         # [S, k+1]
+            if trc:
+                t_us = tracing.now_us()
+            for slot in slots:
+                sess = self._sessions[slot]
+                if sess is None:
+                    continue
+                live += 1
+                t = toks[slot]
+                d = props[slot]
+                a = 0
+                while a < k and d[a] == t[a]:
+                    a += 1
+                committed = []
+                for j in range(a + 1):
+                    # same bookkeeping as one plain decode step: the token
+                    # we fed at position lengths[slot] is now in the slab,
+                    # t[j] is the sampled-but-not-yet-fed continuation
+                    self._lengths[slot] += 1
+                    tok = int(t[j])
+                    self._last_tok[slot] = tok
+                    committed.append(tok)
+                    self._deliver(sess, tok)
+                    self._maybe_finish(slot)
+                    if self._sessions[slot] is None:
+                        break
+                if trc and sess.span is not None:
+                    tracing.emit_span("generation.decode_tick", t_us, 0.0,
+                                      cat="generation", parent=sess.span,
+                                      position=int(self._lengths[slot]),
+                                      committed=len(committed), accepted=a)
+                if (self._sessions[slot] is not None
+                        and self._draft is not None):
+                    self._draft.on_commit(slot, committed)
+                # accepted = draft proposals that actually became committed
+                # tokens. On a full commit that is `a` (the bonus token is
+                # not a draft); when the loop broke early on a terminal
+                # state every committed token so far WAS a matching draft —
+                # counting the unreachable tail of `a` would inflate the
+                # acceptance_ratio operators tune k against
+                accepted += min(len(committed), a)
+                committed_total += len(committed)
+            if tele:
+                telemetry.counter("serving.generation.tick_slots").inc(
+                    self._slots)
         if tele:
             telemetry.counter("serving.generation.decode_tokens").inc(live)
-            telemetry.counter("serving.generation.tick_slots").inc(
-                self._slots)
             telemetry.counter("serving.generation.spec.ticks").inc()
             telemetry.counter("serving.generation.spec.verified_slots").inc(
                 live)

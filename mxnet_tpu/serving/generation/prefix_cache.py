@@ -59,7 +59,7 @@ class _Node:
     ``slot`` (when not None) is the slab slot holding this prefix's KV."""
 
     __slots__ = ("edge", "parent", "children", "length", "slot", "refs",
-                 "last_used", "payloads")
+                 "last_used", "payloads", "version")
 
     def __init__(self, edge, parent, length):
         self.edge = edge              # np.int32 [e] tokens from parent
@@ -70,6 +70,10 @@ class _Node:
         self.refs = 0                 # active borrowers (forks in flight)
         self.last_used = 0.0          # LRU clock (payload nodes)
         self.payloads = 0             # payload nodes in subtree incl. self
+        self.version = 0              # weights version the KV was computed
+        #                               under (rollout: a fork must never
+        #                               attend old-weight KV with new-weight
+        #                               logits)
 
 
 class RadixPrefixCache:
@@ -136,37 +140,43 @@ class RadixPrefixCache:
             node = child
         return node, m
 
-    def _payload_below(self, node):
-        """Any payload node at or below ``node`` (depth-first through
-        subtrees that report payloads)."""
-        while node is not None:
-            if node.slot is not None:
-                return node
-            node = next((c for c in node.children.values() if c.payloads),
-                        None)
+    def _payload_below(self, node, version=None):
+        """Any payload node at or below ``node`` stamped with weights
+        ``version`` (None = any), depth-first through subtrees that
+        report payloads."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.slot is not None and (version is None
+                                       or n.version == version):
+                return n
+            stack.extend(c for c in n.children.values() if c.payloads)
         return None
 
-    def match(self, prompt):
+    def match(self, prompt, version=None):
         """Longest usable cached prefix of ``prompt``: returns
         ``(payload_node, matched_len)`` or ``(None, 0)``. The matched
         length is capped at ``len(prompt) - 1`` — at least one suffix
-        token must remain to produce the first sampled logits. Does NOT
-        count telemetry or touch LRU; callers decide (the router probes
-        without consuming)."""
+        token must remain to produce the first sampled logits. With
+        ``version`` only entries stamped with that weights version
+        qualify (the engine passes its current version, so a post-swap
+        fork can never splice old-weight KV under new-weight logits).
+        Does NOT count telemetry or touch LRU; callers decide (the
+        router probes without consuming)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._lock:
             node, m = self._walk(prompt)
             m = min(m, prompt.size - 1)
             if m <= 0:
                 return None, 0
-            pay = self._payload_below(node)
+            pay = self._payload_below(node, version)
             if pay is None:
                 return None, 0
             return pay, m
 
-    def match_len(self, prompt):
+    def match_len(self, prompt, version=None):
         """Matched token count only (the router's affinity probe)."""
-        _, m = self.match(prompt)
+        _, m = self.match(prompt, version)
         return m
 
     def acquire(self, node):
@@ -182,12 +192,15 @@ class RadixPrefixCache:
 
     # -- insertion -----------------------------------------------------------
 
-    def insert(self, prompt, slot):
+    def insert(self, prompt, slot, version=0):
         """Register ``slot`` as holding the KV of the full ``prompt``
-        prefix. Returns the payload node, or None when the exact prefix is
-        already cached (the caller keeps its slot free — dedupe, don't
-        hoard). Splits edges at divergence points; split nodes are
-        internal (payload-less) until some insert lands exactly there."""
+        prefix, stamped with the weights ``version`` it was computed
+        under. Returns the payload node, or None when the exact prefix is
+        already cached at the same version (the caller keeps its slot
+        free — dedupe, don't hoard); an entry cached under a DIFFERENT
+        version is replaced, its old-weight rows dropped. Splits edges at
+        divergence points; split nodes are internal (payload-less) until
+        some insert lands exactly there."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             return None
@@ -219,9 +232,15 @@ class RadixPrefixCache:
                     node = child
                 m += eq
             if node.slot is not None:
-                node.last_used = time.monotonic()   # already cached: touch
-                return None
+                if node.version == int(version):
+                    node.last_used = time.monotonic()  # already cached: touch
+                    return None
+                # same prefix, different weights: the cached rows are
+                # stale logits-wise — replace the payload outright (no
+                # pruning: the node immediately carries the new payload)
+                self._drop_payload(node, "version_replace", prune=False)
             node.slot = int(slot)
+            node.version = int(version)
             node.last_used = time.monotonic()
             self._slots[int(slot)] = node
             p = node
@@ -236,7 +255,7 @@ class RadixPrefixCache:
 
     # -- eviction ------------------------------------------------------------
 
-    def _drop_payload(self, node, reason):
+    def _drop_payload(self, node, reason, prune=True):
         slot = node.slot
         tokens = int(node.length)
         node.slot = None
@@ -245,8 +264,10 @@ class RadixPrefixCache:
         while p is not None:
             p.payloads -= 1
             p = p.parent
-        # prune now-useless leaf chains so the trie stays O(entries)
-        while (node is not self._root and node.slot is None
+        # prune now-useless leaf chains so the trie stays O(entries) —
+        # skipped when the caller is about to repopulate the same node
+        # (version_replace re-inserts in place)
+        while (prune and node is not self._root and node.slot is None
                and not node.children):
             parent = node.parent
             del parent.children[int(node.edge[0])]
@@ -284,6 +305,18 @@ class RadixPrefixCache:
                 return False
             self._drop_payload(node, reason)
             return True
+
+    def evict_other_versions(self, version, reason="weights_swap"):
+        """Drop every entry NOT stamped with weights ``version`` (the
+        engine calls this at swap time: entries computed under the old
+        weights would otherwise serve forks whose prefix logits no
+        longer match the model). Returns the number dropped."""
+        with self._lock:
+            victims = [s for s, n in self._slots.items()
+                       if n.version != int(version)]
+            for slot in victims:
+                self.evict_slot(slot, reason)
+            return len(victims)
 
     def clear(self, reason="clear"):
         """Drop every entry (engine slab reallocation after a failed tick

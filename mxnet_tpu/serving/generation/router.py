@@ -47,12 +47,13 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 import weakref
 
 from ... import analysis
 from ... import health
 from ... import telemetry
-from ...base import MXNetError
+from ...base import MXNetError, getenv
 from ..admission import QueueFullError, ServerClosedError
 
 __all__ = ["GenerationRouter"]
@@ -89,6 +90,12 @@ class GenerationRouter:
         self._all_unready = False
         self._draining = []         # (engine, closer thread) during shrink
         self._closed = False
+        # weight rollout: the fleet's current + previous WeightSets stay
+        # pinned here so rolling_swap always has a rollback target (and
+        # scale_to growth can bring a fresh replica onto the current
+        # version — its factory closure captures construction params)
+        self._ws_current = None
+        self._ws_previous = None
         health.register_fleet(self)
 
     @property
@@ -223,6 +230,13 @@ class GenerationRouter:
                 eng = self._factory()
                 if warm:
                     eng.warm()
+                if self._ws_current is not None:
+                    # the factory closure captures the params the fleet was
+                    # CONSTRUCTED with; after a rollout the live version is
+                    # newer — bring the fresh replica onto it before it
+                    # takes traffic (same shapes: zero compiles)
+                    eng.swap_weights(self._ws_current,
+                                     version=self._ws_current.version)
                 grown.append(eng)
             with self._lock:
                 self._engines.extend(grown)
@@ -267,6 +281,132 @@ class GenerationRouter:
                 router.scale_to(desired, reason="signal")
 
         return health.on_autoscale(_actuate)
+
+    # -- rolling weight swap -------------------------------------------------
+
+    @staticmethod
+    def _swap_burn():
+        """Worst short-window SLO burn rate across objectives, or None
+        when health is off / no objective has data yet. This is the
+        rollout gate: burn > 1 means the error budget is being spent
+        faster than the SLO allows — the swap made things worse."""
+        if not health._enabled:
+            return None
+        tracker = health.tracker()
+        if tracker is None:
+            return None
+        report = tracker.evaluate()
+        burns = [o.get("burn_short") for o in report.get("objectives", [])
+                 if o.get("burn_short") is not None]
+        return max(burns) if burns else None
+
+    def _pin_baseline(self):
+        """First swap on this fleet: snapshot replica 0's live weights as
+        the rollback target (the router was handed engines, not a
+        WeightSet — without this a breached first rollout would have
+        nothing to roll back TO)."""
+        from ..rollout import WeightSet
+        engines = self.engines
+        if not engines:
+            return None
+        version, params, draft = engines[0].weights_snapshot()
+        return WeightSet(version, params, draft_params=draft,
+                         source="fleet-baseline")
+
+    def rolling_swap(self, weights, draft_params=None, version=None,
+                     observe_s=None, gate=None, rollback=True,
+                     reason="publish"):
+        """Flip the fleet to new weights one replica at a time, gated on
+        the SLO burn tracker.
+
+        ``weights`` is a :class:`~mxnet_tpu.serving.rollout.WeightSet`
+        (a subscriber ingest) or a plain name->array dict. After each
+        replica flips, the router waits ``observe_s`` seconds (default
+        ``MXNET_ROLLOUT_POLL_S``) and reads the worst short-window burn
+        rate; burn above ``gate`` (default ``MXNET_ROLLOUT_SLO_GATE``)
+        aborts the roll and — with ``rollback=True`` — swaps every
+        already-flipped replica back to the pinned previous version,
+        journaled as ``rollout_rollback``. Runs under the scale lock, so
+        a roll never interleaves with a concurrent grow/drain (a replica
+        grown later picks the fleet's current version up in
+        :meth:`scale_to`). Per-replica progress is journaled as
+        ``rollout_roll`` events. Returns a report dict.
+        """
+        if self._closed:
+            raise MXNetError("rolling_swap on a closed router")
+        from ..rollout import WeightSet
+        if gate is None:
+            gate = float(getenv("MXNET_ROLLOUT_SLO_GATE"))
+        if observe_s is None:
+            observe_s = float(getenv("MXNET_ROLLOUT_POLL_S"))
+        with self._scale_lock:
+            if self._ws_current is None:
+                self._ws_current = self._pin_baseline()
+            if isinstance(weights, WeightSet):
+                target = weights
+                if version is None:
+                    version = target.version
+            else:
+                if version is None:
+                    version = (self._ws_current.version
+                               if self._ws_current is not None else 0) + 1
+                target = WeightSet(version, dict(weights),
+                                   draft_params=draft_params, source=reason)
+            previous = self._ws_current
+            engines = self.engines
+            report = {"version": int(version), "replicas": len(engines),
+                      "swapped": 0, "noops": 0, "rolled_back": False,
+                      "burn": None,
+                      "previous_version": (previous.version
+                                           if previous is not None else None)}
+            flipped = []
+            breach = None
+            for i, eng in enumerate(engines):
+                v = eng.swap_weights(target, draft_params=draft_params,
+                                     version=version)
+                if v is None:
+                    report["noops"] += 1
+                    continue
+                flipped.append(eng)
+                report["swapped"] += 1
+                if health._enabled:
+                    health.event("rollout_roll", engine=eng.health_name,
+                                 index=i, version=int(version),
+                                 replicas=len(engines))
+                if observe_s > 0:
+                    time.sleep(observe_s)
+                burn = self._swap_burn()
+                if burn is not None:
+                    report["burn"] = float(burn)
+                    if burn > gate:
+                        breach = float(burn)
+                        break
+            if breach is not None and rollback and previous is not None:
+                # roll every flipped replica back to the pinned previous
+                # version — same buffer-substitution path, so the rollback
+                # itself is also zero-compile and zero-downtime
+                for eng in flipped:
+                    eng.swap_weights(previous, version=previous.version)
+                report["rolled_back"] = True
+                telemetry.counter("rollout.rollbacks").inc()
+                if health._enabled:
+                    health.event("rollout_rollback", version=int(version),
+                                 restored=int(previous.version),
+                                 burn=breach, gate=float(gate),
+                                 replicas_hit=len(flipped))
+                # _ws_current stays `previous`: a later publish (or a
+                # re-roll of the same version) starts from the restored
+                # baseline — rollback-of-a-rollback converges here
+                return report
+            if report["swapped"]:
+                if (self._ws_previous is not None
+                        and self._ws_previous is not previous):
+                    self._ws_previous.release()
+                self._ws_previous = previous
+                self._ws_current = target.acquire()
+                telemetry.counter("rollout.rolls").inc()
+                telemetry.gauge("rollout.fleet_version").set(int(version))
+            return report
 
     # -- lifecycle -----------------------------------------------------------
 

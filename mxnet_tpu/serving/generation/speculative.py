@@ -88,6 +88,12 @@ class Draft:
         """The engine reallocated its slab after a failed tick; drop any
         per-slot device state the same way."""
 
+    def swap_params(self, params):
+        """A weight rollout published new draft parameters; flip to them
+        (host dict, same names/shapes). Returns True when the draft has
+        parameters to swap (False for host-side drafts — a no-op)."""
+        return False
+
     def propose(self, k, sessions):
         """Return an int32 [S, k] proposal block (rows of dead slots are
         ignored). ``sessions`` is the engine's slot list (None = dead);
@@ -290,6 +296,34 @@ class CheckpointDraft(Draft):
         self._alloc()
         self._len[:] = 0
         self._pending = [[] for _ in range(self._eng.max_slots)]
+
+    def swap_params(self, params):
+        """Flip the draft to new weights immediately — the slab survives
+        untouched. Rows ingested under the old weights only degrade the
+        acceptance ratio until overwritten (the target's verify is the
+        ground truth, so output never changes); shapes/dtypes must match
+        so the pinned draft executables are reused compile-free."""
+        import jax
+
+        cur = self._params
+        new = {str(k): v for k, v in dict(params).items()}
+        if set(new) != set(cur):
+            raise MXNetError(
+                f"draft swap_params: parameter names differ (have "
+                f"{sorted(cur)}, got {sorted(new)})")
+        specs = self._model.param_specs()
+        placed = {}
+        for name, v in new.items():
+            arr = np.asarray(v)
+            old = cur[name]
+            if tuple(arr.shape) != tuple(old.shape):
+                raise MXNetError(
+                    f"draft swap_params: {name!r} shape "
+                    f"{tuple(arr.shape)} != bound {tuple(old.shape)}")
+            placed[name] = jax.device_put(
+                arr.astype(old.dtype, copy=False), specs[name])
+        self._params = placed
+        return True
 
     def propose(self, k, sessions):
         import jax.numpy as jnp

@@ -37,6 +37,8 @@ from __future__ import annotations
 import threading
 import time
 
+import numpy as np
+
 from .. import analysis
 from .. import memory
 from .. import ndarray as nd
@@ -224,6 +226,7 @@ class Predictor:
         self._cache = CompileCache("serving")
         self._execs = {}
         self._lock = analysis.make_rlock("serving.predictor")
+        self._weights_version = 0     # bumped by swap_weights (rollout)
         # fleet health: /readyz reports warmup state per predictor
         # (serving.warmup sets _warmed; registration is weakly held)
         self._warmed = False
@@ -377,6 +380,103 @@ class Predictor:
                 (time.perf_counter() - t0) * 1e6)
         return outs
 
+    # -- weight rollout ------------------------------------------------------
+
+    @property
+    def weights_version(self):
+        """Version of the currently-bound weight set (0 until the first
+        :meth:`swap_weights`)."""
+        return self._weights_version
+
+    def swap_weights(self, arg_params, aux_params=None, version=None):
+        """Atomic zero-downtime weight flip: substitute new buffers into
+        the SHARED param NDArrays every bucket executor binds, under the
+        serving lock — an in-flight batch finishes on the old weights
+        (``_run`` holds the same lock through its forward), the next
+        flush reads the new ones. The incoming arrays are cast to the
+        bound dtypes and must match the bound shapes exactly, so every
+        warmed ``CompileCache("serving")`` entry is reused untouched:
+        the swap compiles NOTHING (executor signatures are shape/dtype
+        only, and weights are non-donated arguments).
+
+        ``arg_params`` may be a :class:`~.rollout.WeightSet` (its version
+        wins unless ``version`` is passed). Returns the new version, or
+        None when ``version`` equals the current one (idempotent
+        re-publish). Under an SPMD serving bind the new buffers are
+        re-placed with the ORIGINAL sharding specs, so per-device
+        residency is preserved across the flip."""
+        import jax
+
+        if hasattr(arg_params, "arg_params") and hasattr(arg_params,
+                                                         "version"):
+            ws = arg_params
+            aux_params = ws.aux_params if aux_params is None else aux_params
+            version = ws.version if version is None else version
+            arg_params = ws.arg_params
+        new_arg = dict(arg_params or {})
+        new_aux = dict(aux_params or {})
+        # mirror _serving_fused's aux->arg migration: a checkpoint
+        # published by the training loop still carries e.g. BatchNorm
+        # moving stats as aux, but the fused serving graph binds them
+        # as plain arguments
+        for n in list(new_aux):
+            if n in self._arg_params and n not in new_arg:
+                new_arg[n] = new_aux.pop(n)
+        missing = ([n for n in self._arg_params if n not in new_arg]
+                   + [n for n in self._aux_params if n not in new_aux])
+        if missing:
+            raise MXNetError(
+                f"swap_weights: bound parameters {missing} are missing "
+                "from the new weight set — a hot swap must cover every "
+                "bound array (partial updates would serve a chimera)")
+        staged = []
+        for tgt_map, src, spmd in ((self._arg_params, new_arg, True),
+                                   (self._aux_params, new_aux, False)):
+            for n, tgt in tgt_map.items():
+                arr = src[n]
+                arr = (arr.asnumpy() if hasattr(arr, "asnumpy")
+                       else np.asarray(arr))
+                if tuple(arr.shape) != tuple(tgt.shape):
+                    raise MXNetError(
+                        f"swap_weights: parameter {n!r} has shape "
+                        f"{tuple(arr.shape)} but the bound executors "
+                        f"expect {tuple(tgt.shape)} — identical shapes/"
+                        "dtypes are what make the swap compile-free")
+                staged.append((n, tgt, arr, spmd))
+        with self._lock:
+            if version is not None and version == self._weights_version:
+                if telemetry._enabled:
+                    telemetry.counter("serving.weight_swap_noops").inc()
+                return None
+            for n, tgt, arr, spmd in staged:
+                arr = arr.astype(tgt.dtype, copy=False)
+                if self._spmd_mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    spec = (self._spmd_specs.get(n)
+                            if spmd and self._spmd_specs else None)
+                    data = jax.device_put(
+                        arr, NamedSharding(self._spmd_mesh,
+                                           spec if spec is not None
+                                           else PartitionSpec()))
+                else:
+                    import jax.numpy as jnp
+
+                    data = jnp.asarray(arr)
+                tgt._data = data
+            self._weights_version = (self._weights_version + 1
+                                     if version is None else int(version))
+            swapped_to = self._weights_version
+        if telemetry._enabled:
+            telemetry.counter("serving.weight_swaps").inc()
+            telemetry.gauge("serving.weights_version").set(swapped_to)
+        from .. import health
+
+        if health._enabled:
+            health.event("rollout_swap", predictor=self.health_name,
+                         version=swapped_to)
+        return swapped_to
+
     def warm_bucket(self, bucket):
         """Compile-ahead one bucket: run a zeros batch through it (a cache
         hit if already compiled)."""
@@ -458,4 +558,5 @@ class Predictor:
         ``compile_cache.stats()``."""
         return {"cache": self._cache.snapshot(),
                 "buckets": list(self._buckets),
-                "bound": sorted(self._execs)}
+                "bound": sorted(self._execs),
+                "weights_version": self._weights_version}

@@ -1,0 +1,686 @@
+"""Zero-downtime weight rollout: live train→serve checkpoint streaming.
+
+Covers the rollout PR end to end:
+* publish/subscribe — versioned CRC-footed payloads + atomic manifests
+  over a watched directory; idempotent double-publish; retention;
+* reject-and-keep-serving — torn manifest, corrupt-CRC payload and
+  stale/duplicate version stamps (all driven through the ``publish``
+  fault point of ``MXNET_FAULT_SPEC``) are each rejected exactly once
+  with the subscriber still on its current version;
+* hot swap — ``Predictor.swap_weights`` and
+  ``GenerationEngine.swap_weights`` flip to new weights with ZERO new
+  compiles (identical shapes reuse every warmed executable) and
+  bit-exact parity vs a fresh stack constructed on the new weights;
+* drain pinning — sessions admitted before a swap finish BIT-EXACT on
+  their admission-time weights (multi-cohort ticks) while new sessions
+  run the new weights, including mid-speculative-verify swaps; the old
+  version's params are GC'd once the last pinned session drains;
+* prefix-cache versioning — entries are stamped with the weights
+  version that computed them; a post-swap fork never splices old-weight
+  KV under new-weight logits;
+* fleet rollout — ``GenerationRouter.rolling_swap`` rolls one replica
+  at a time behind the PR 11 SLO burn gate, auto-rolls-back (journaled)
+  on a breach, converges under rollback-of-a-rollback, and serializes
+  against ``scale_to`` (a grown replica joins on the fleet's CURRENT
+  version);
+* train-side — ``save_checkpoint`` publishes when ``MXNET_ROLLOUT_DIR``
+  is set; ``load_checkpoint`` corrupt-epoch fallback emits the
+  ``checkpoint_fallback`` health event + ``checkpoint.corrupt_skipped``
+  counter;
+* accounting — the rollout subsystem owns ZERO new cached executables
+  (named_stats over every named CompileCache);
+* chaos acceptance — a 3-replica fleet under sustained concurrent
+  traffic takes a publish (every replica flips, zero dropped requests,
+  zero steady-state compiles), rejects a corrupt publish while still
+  serving, and auto-rolls-back a breach with the fleet converged on the
+  previous version.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile_cache, health, model as mdl
+from mxnet_tpu import parallel as par
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io.io import DataDesc
+from mxnet_tpu.models import TransformerLM, TransformerLMConfig
+from mxnet_tpu.resilience import fault_scope
+from mxnet_tpu.serving import rollout
+from mxnet_tpu.serving.generation import (CheckpointDraft, GenerationEngine,
+                                          GenerationRouter)
+
+VOCAB = 64
+DIM, CLASSES = 8, 4
+
+
+def _model(max_len=48, d_model=32, n_layers=2, seed=0):
+    mesh = par.create_mesh(devices=jax.devices()[:1], dp=1)
+    cfg = TransformerLMConfig(vocab_size=VOCAB, d_model=d_model, n_heads=2,
+                              d_ff=2 * d_model, n_layers=n_layers,
+                              max_len=max_len, dtype="float32")
+    lm = TransformerLM(cfg, mesh)
+    return lm, lm.init_params(jax.random.PRNGKey(seed))
+
+
+@pytest.fixture(scope="module")
+def lm2():
+    """One small model with two independent weight versions (params are
+    read-only; engines each compile their own executables)."""
+    lm, p0 = _model(seed=0)
+    _, p1 = _model(seed=1)
+    return lm, p0, p1
+
+
+def _prompts(n, lo=2, hi=10, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, VOCAB, rng.randint(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+@pytest.fixture
+def tele():
+    prev = telemetry.enabled()
+    telemetry.enable()
+    yield telemetry
+    telemetry.enable(prev)
+
+
+@pytest.fixture
+def healthy(tele):
+    prev = health.enabled()
+    health.enable()
+    health.reset()
+    yield health
+    health.reset()
+    health.enable(prev)
+
+
+def _counter(name):
+    c = telemetry.get(name)
+    return c.value if c is not None else 0
+
+
+def _weights(seed, shape=(3, 4)):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(*shape).astype(np.float32),
+            "b": rng.randn(shape[1]).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Publish / subscribe
+# ---------------------------------------------------------------------------
+
+
+def test_publish_subscribe_roundtrip(tmp_path, tele):
+    w = _weights(0)
+    manifest = rollout.publish(tmp_path, 1, w, aux_params={"m": np.ones(2)},
+                               source="test")
+    assert manifest is not None and os.path.exists(manifest)
+    assert rollout.list_versions(tmp_path) == [1]
+    sub = rollout.RolloutSubscriber(tmp_path)
+    ws = sub.poll()
+    assert ws is not None and ws.version == 1 and sub.version == 1
+    np.testing.assert_array_equal(ws.arg_params["w"], w["w"])
+    np.testing.assert_array_equal(ws.aux_params["m"], np.ones(2))
+    assert sub.poll() is None          # nothing new
+
+
+def test_double_publish_idempotent(tmp_path, tele):
+    w = _weights(0)
+    assert rollout.publish(tmp_path, 1, w) is not None
+    before = _counter("rollout.publish_duplicate")
+    assert rollout.publish(tmp_path, 1, _weights(1)) is None   # no-op
+    assert _counter("rollout.publish_duplicate") - before == 1
+    sub = rollout.RolloutSubscriber(tmp_path)
+    ws = sub.poll()
+    # the FIRST publish won; the duplicate never overwrote the payload
+    np.testing.assert_array_equal(ws.arg_params["w"], w["w"])
+
+
+def test_retention_keeps_newest(tmp_path, tele, monkeypatch):
+    monkeypatch.setenv("MXNET_ROLLOUT_KEEP", "2")
+    for v in range(1, 6):
+        rollout.publish(tmp_path, v, _weights(v))
+    assert rollout.list_versions(tmp_path) == [4, 5]
+    # payloads of evicted versions are gone too
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["v000004.manifest.json", "v000004.params",
+                     "v000005.manifest.json", "v000005.params"]
+
+
+def test_subscriber_takes_newest_of_burst(tmp_path, tele):
+    for v in (1, 2, 3):
+        rollout.publish(tmp_path, v, _weights(v))
+    sub = rollout.RolloutSubscriber(tmp_path)
+    ws = sub.poll()
+    assert ws.version == 3
+    # superseded versions were consumed silently, not left for re-ingest
+    assert sub.poll() is None
+
+
+# ---------------------------------------------------------------------------
+# Publish-side fault injection → reject-and-keep-serving
+# ---------------------------------------------------------------------------
+
+
+def test_reject_torn_manifest(tmp_path, healthy):
+    rollout.publish(tmp_path, 1, _weights(1))
+    sub = rollout.RolloutSubscriber(tmp_path)
+    assert sub.poll().version == 1
+    before = _counter("rollout.reject_torn_manifest")
+    with fault_scope("point=publish,path=*.manifest.json,truncate=10"):
+        rollout.publish(tmp_path, 2, _weights(2))
+    assert sub.poll() is None and sub.version == 1
+    assert _counter("rollout.reject_torn_manifest") - before == 1
+    # handled exactly once: a second poll does not re-reject
+    assert sub.poll() is None
+    assert _counter("rollout.reject_torn_manifest") - before == 1
+    kinds = [e["kind"] for e in health.events()]
+    assert "rollout_reject" in kinds
+
+
+def test_reject_corrupt_payload(tmp_path, healthy):
+    rollout.publish(tmp_path, 1, _weights(1))
+    sub = rollout.RolloutSubscriber(tmp_path)
+    assert sub.poll().version == 1
+    before = _counter("rollout.reject_corrupt_crc")
+    with fault_scope("point=publish,path=*.manifest.json,error=CORRUPT"):
+        rollout.publish(tmp_path, 2, _weights(2))
+    assert sub.poll() is None and sub.version == 1
+    assert _counter("rollout.reject_corrupt_crc") - before == 1
+    # a subsequent GOOD publish still ingests — the subscriber survived
+    rollout.publish(tmp_path, 3, _weights(3))
+    assert sub.poll().version == 3
+
+
+def test_reject_stale_version_stamp(tmp_path, healthy):
+    rollout.publish(tmp_path, 1, _weights(1))
+    rollout.publish(tmp_path, 2, _weights(2))
+    sub = rollout.RolloutSubscriber(tmp_path)
+    assert sub.poll().version == 2
+    before = _counter("rollout.reject_stale_version")
+    # a NEW manifest file stamped with an already-served version
+    with fault_scope("point=publish,path=*.manifest.json,error=STALE"):
+        rollout.publish(tmp_path, 3, _weights(3))
+    assert sub.poll() is None and sub.version == 2
+    assert _counter("rollout.reject_stale_version") - before == 1
+
+
+def test_watcher_applies_and_survives_apply_errors(tmp_path, tele):
+    rollout.publish(tmp_path, 1, _weights(1))
+    seen = []
+    w = rollout.RolloutWatcher(tmp_path, seen.append, start=False)
+    assert w.poll_once().version == 1 and seen[0].version == 1
+    rollout.publish(tmp_path, 2, _weights(2))
+
+    def boom(ws):
+        raise RuntimeError("apply failed")
+
+    w._apply = boom
+    before = _counter("rollout.apply_errors")
+    assert w.poll_once().version == 2          # ingest happened
+    assert _counter("rollout.apply_errors") - before == 1
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# Predictor hot swap
+# ---------------------------------------------------------------------------
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _mlp_module(seed):
+    mod = mx.mod.Module(_mlp_symbol())
+    mod.bind([DataDesc("data", (4, DIM))], [DataDesc("softmax_label", (4,))],
+             for_training=False)
+    mx.random.seed(seed)
+    mod.init_params(mx.init.Xavier())
+    return mod
+
+
+def _np_params(mod):
+    arg, aux = mod.get_params()
+    return ({k: v.asnumpy() for k, v in arg.items()},
+            {k: v.asnumpy() for k, v in aux.items()})
+
+
+@pytest.mark.slow
+def test_predictor_swap_zero_compiles_bit_parity(tele):
+    pred = _mlp_module(7).as_predictor(buckets=(2, 4))
+    x = np.random.RandomState(0).uniform(-1, 1, (4, DIM)).astype(np.float32)
+    y0 = pred.predict(x).asnumpy()
+    m2 = _mlp_module(11)
+    arg2, aux2 = _np_params(m2)
+    misses = pred._cache.misses
+    v = pred.swap_weights(arg2, aux2)
+    y1 = pred.predict(x).asnumpy()
+    assert v == 1 and pred.stats()["weights_version"] == 1
+    assert pred._cache.misses == misses          # zero new compiles
+    assert not np.allclose(y0, y1)               # weights actually changed
+    # bit-exact vs a predictor freshly constructed on the new weights
+    y2 = m2.as_predictor(buckets=(2, 4)).predict(x).asnumpy()
+    np.testing.assert_array_equal(y1, y2)
+    # idempotent re-swap of the same version is a counted no-op
+    before = _counter("serving.weight_swap_noops")
+    assert pred.swap_weights(arg2, aux2, version=v) is None
+    assert _counter("serving.weight_swap_noops") - before == 1
+
+
+def test_predictor_swap_rejects_bad_shapes(tele):
+    pred = _mlp_module(7).as_predictor(buckets=(2,))
+    arg, aux = _np_params(_mlp_module(8))
+    arg["fc1_weight"] = np.zeros((3, 3), np.float32)
+    with pytest.raises(MXNetError):
+        pred.swap_weights(arg, aux)
+    # the failed swap must not have committed anything
+    assert pred.weights_version == 0
+
+
+def test_predictor_swap_accepts_weightset(tele):
+    pred = _mlp_module(7).as_predictor(buckets=(2,))
+    arg2, aux2 = _np_params(_mlp_module(9))
+    ws = rollout.WeightSet(5, arg2, aux_params=aux2)
+    assert pred.swap_weights(ws) == 5
+    assert pred.weights_version == 5
+
+
+# ---------------------------------------------------------------------------
+# GenerationEngine hot swap
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_engine_swap_zero_compiles_bit_parity(tele, lm2):
+    lm, p0, p1 = lm2
+    prompts = _prompts(2, seed=3)
+    with GenerationEngine(lm, p0, max_slots=4, max_len=48) as eng:
+        old = [list(eng.submit(p, max_new_tokens=8)) for p in prompts]
+        misses = eng._cache.misses
+        v = eng.swap_weights(p1)
+        assert v == 1 and eng.stats()["weights_version"] == 1
+        new = [list(eng.submit(p, max_new_tokens=8)) for p in prompts]
+        assert eng._cache.misses == misses       # zero new compiles
+        assert new != old
+        assert eng.swap_weights(p1, version=v) is None   # idempotent
+    with GenerationEngine(lm, p1, max_slots=4, max_len=48) as fresh:
+        want = [list(fresh.submit(p, max_new_tokens=8)) for p in prompts]
+    assert new == want                           # bit-exact vs fresh engine
+
+
+def test_engine_swap_rejects_mismatched_params(tele, lm2):
+    lm, p0, _ = lm2
+    with GenerationEngine(lm, p0, max_slots=2, max_len=48) as eng:
+        with pytest.raises(MXNetError):
+            eng.swap_weights({"nope": np.zeros(3, np.float32)})
+        assert eng.weights_version == 0
+
+
+@pytest.mark.slow
+def test_mid_stream_swap_pins_sessions(tele, lm2):
+    """The drain contract: a session admitted before the swap finishes
+    BIT-EXACT on its admission-time weights while a session admitted
+    after runs the new weights — cohort ticks, zero new compiles — and
+    the old version's params are GC'd once the pinned session drains."""
+    lm, p0, p1 = lm2
+    pr_a, pr_b = _prompts(2, lo=5, hi=8, seed=11)
+    with GenerationEngine(lm, p0, max_slots=4, max_len=48) as ref_old:
+        want_a = list(ref_old.submit(pr_a, max_new_tokens=10))
+    with GenerationEngine(lm, p1, max_slots=4, max_len=48) as ref_new:
+        want_b = list(ref_new.submit(pr_b, max_new_tokens=10))
+
+    eng = GenerationEngine(lm, p0, max_slots=4, max_len=48, start=False)
+    try:
+        sa = eng.submit(pr_a, max_new_tokens=10)
+        for _ in range(4):
+            eng._tick_once()                     # A is mid-stream on v0
+        misses = eng._cache.misses
+        assert eng.swap_weights(p1) == 1
+        assert eng.live_weight_versions == [0, 1]
+        sb = eng.submit(pr_b, max_new_tokens=10)
+        for _ in range(25):
+            eng._tick_once()
+        assert list(sa) == want_a                # pinned old, bit-exact
+        assert list(sb) == want_b                # new weights, bit-exact
+        assert eng._cache.misses == misses       # mixed ticks: zero compiles
+        assert eng.live_weight_versions == [1]   # v0 drained + GC'd
+        assert sorted(eng._param_sets) == [1]
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_prefix_cache_version_stamping(tele, lm2):
+    """A cached prefix computed under old weights must never serve a
+    post-swap fork: version-stamped entries, swap-time eviction."""
+    lm, p0, p1 = lm2
+    prompt = np.arange(1, 13, dtype=np.int32)
+    with GenerationEngine(lm, p0, max_slots=4, max_len=48,
+                          prefix_cache=True, prefix_min_tokens=4) as eng:
+        list(eng.submit(prompt, max_new_tokens=4))
+        assert eng.prefix_match_len(prompt) > 0      # cached under v0
+        eng.swap_weights(p1)
+        # old-weight entries are gone: no match at the current version
+        assert eng.prefix_match_len(prompt) == 0
+        # re-running the prompt re-caches under the NEW version
+        list(eng.submit(prompt, max_new_tokens=4))
+        assert eng.prefix_match_len(prompt) > 0
+
+
+@pytest.mark.slow
+def test_swap_during_speculative_decode(tele, lm2):
+    """Swap landing between spec-decode ticks: the pinned session's
+    verify lane keeps running its admission-time target weights (draft
+    proposals may come from the new draft — verify corrects bit-exactly),
+    the new session runs new weights end to end."""
+    lm, p0, p1 = lm2
+    dlm, dp0 = _model(d_model=16, seed=7)
+    _, dp1 = _model(d_model=16, seed=8)
+    pr_a, pr_b = _prompts(2, lo=5, hi=8, seed=13)
+    with GenerationEngine(lm, p0, max_slots=4, max_len=40, spec_k=3,
+                          draft=CheckpointDraft(dlm, dp0)) as ref_old:
+        want_a = list(ref_old.submit(pr_a, max_new_tokens=10))
+    with GenerationEngine(lm, p1, max_slots=4, max_len=40, spec_k=3,
+                          draft=CheckpointDraft(dlm, dp1)) as ref_new:
+        want_b = list(ref_new.submit(pr_b, max_new_tokens=10))
+
+    eng = GenerationEngine(lm, p0, max_slots=4, max_len=40, spec_k=3,
+                           draft=CheckpointDraft(dlm, dp0), start=False)
+    try:
+        sa = eng.submit(pr_a, max_new_tokens=10)
+        for _ in range(2):
+            eng._tick_once()
+        misses = eng._cache.misses
+        eng.swap_weights(p1, draft_params=dp1)
+        sb = eng.submit(pr_b, max_new_tokens=10)
+        for _ in range(30):
+            eng._tick_once()
+        assert list(sa) == want_a
+        assert list(sb) == want_b
+        assert eng._cache.misses == misses
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet rolling swap + SLO-gated rollback
+# ---------------------------------------------------------------------------
+
+
+def _fleet(lm, params, n=3, factory_params=None):
+    engines = [GenerationEngine(lm, params, max_slots=4, max_len=48)
+               for _ in range(n)]
+    fp = params if factory_params is None else factory_params
+    return GenerationRouter(
+        engines, factory=lambda: GenerationEngine(lm, fp, max_slots=4,
+                                                  max_len=48))
+
+
+def test_rolling_swap_flips_fleet(healthy, lm2, monkeypatch):
+    lm, p0, p1 = lm2
+    # pin the burn gate to an isolated no-data objective: the default
+    # spec reads process-global telemetry other suites already moved
+    monkeypatch.setenv("MXNET_SLO_SPEC", "rollout_quiet.probe:value<=1")
+    health.reset()
+    router = _fleet(lm, p0, n=2)
+    try:
+        ws = rollout.WeightSet(5, p1, source="test")
+        rep = router.rolling_swap(ws, observe_s=0)
+        assert rep["swapped"] == 2 and not rep["rolled_back"]
+        assert [e.weights_version for e in router.engines] == [5, 5]
+        rolls = [e for e in health.events() if e["kind"] == "rollout_roll"]
+        assert len(rolls) == 2
+        # double-publish of the same version: every replica no-ops
+        rep2 = router.rolling_swap(ws, observe_s=0)
+        assert rep2["swapped"] == 0 and rep2["noops"] == 2
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_rolling_swap_burn_gate_rollback(healthy, lm2, monkeypatch):
+    """A post-swap short-window burn above the gate triggers automatic
+    journaled rollback to the pinned previous version — and a rollback
+    of a rollback converges (the fleet never flaps past `previous`)."""
+    lm, p0, p1 = lm2
+    monkeypatch.setenv("MXNET_SLO_SPEC", "rollout_probe.errors:value<=0")
+    monkeypatch.setenv("MXNET_SLO_GRACE_S", "0")
+    health.reset()                   # rebuild the tracker from the spec
+    router = _fleet(lm, p0, n=2)
+    try:
+        assert router.rolling_swap(
+            rollout.WeightSet(5, p1), observe_s=0)["swapped"] == 2
+
+        telemetry.gauge("rollout_probe.errors").set(1)    # breach
+        before = _counter("rollout.rollbacks")
+        rep = router.rolling_swap(rollout.WeightSet(6, p0), observe_s=0)
+        assert rep["rolled_back"] and rep["burn"] > 1.0
+        assert [e.weights_version for e in router.engines] == [5, 5]
+        assert _counter("rollout.rollbacks") - before == 1
+        evs = [e for e in health.events() if e["kind"] == "rollout_rollback"]
+        assert evs and evs[-1]["restored"] == 5
+        # rollback-of-a-rollback: the breach persists, a re-roll of the
+        # bad version rolls back again to the SAME pinned previous
+        rep2 = router.rolling_swap(rollout.WeightSet(7, p0), observe_s=0)
+        assert rep2["rolled_back"]
+        assert [e.weights_version for e in router.engines] == [5, 5]
+    finally:
+        router.close()
+        telemetry.gauge("rollout_probe.errors").set(0)
+
+
+@pytest.mark.slow
+def test_swap_races_scale_to(healthy, lm2, monkeypatch):
+    """rolling_swap and scale_to serialize on the scale lock; a replica
+    grown AFTER a rollout joins on the fleet's current version, not the
+    factory's stale construction params."""
+    lm, p0, p1 = lm2
+    monkeypatch.setenv("MXNET_SLO_SPEC", "rollout_quiet.probe:value<=1")
+    health.reset()
+    router = _fleet(lm, p0, n=2, factory_params=p0)
+    try:
+        ws = rollout.WeightSet(3, p1, source="test")
+        errs = []
+
+        def roll():
+            try:
+                router.rolling_swap(ws, observe_s=0)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=roll)
+        t.start()
+        router.scale_to(3, warm=False)       # concurrent grow
+        t.join()
+        assert not errs
+        assert len(router.engines) == 3
+        # every replica — including the raced grow — is on version 3
+        assert [e.weights_version for e in router.engines] == [3, 3, 3]
+        # and shrink during steady state still works after the roll
+        router.scale_to(2, warm=False)
+        assert all(e.weights_version == 3 for e in router.engines)
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# Train side: save_checkpoint publisher + load_checkpoint fallback
+# ---------------------------------------------------------------------------
+
+
+def test_save_checkpoint_publishes(tmp_path, tele, monkeypatch):
+    rd = tmp_path / "rollout"
+    monkeypatch.setenv("MXNET_ROLLOUT_DIR", str(rd))
+    prefix = str(tmp_path / "ckpt")
+    arg = {"w": mx.nd.array(np.arange(4, dtype=np.float32))}
+    mdl.save_checkpoint(prefix, 2, None, arg, {})
+    assert rollout.list_versions(rd) == [2]
+    ws = rollout.RolloutSubscriber(rd).poll()
+    assert ws.version == 2
+    np.testing.assert_array_equal(ws.arg_params["w"],
+                                  np.arange(4, dtype=np.float32))
+    # epoch 3 publishes as version 3; a subscriber at 2 picks it up
+    mdl.save_checkpoint(prefix, 3, None, arg, {})
+    assert rollout.list_versions(rd) == [2, 3]
+
+
+def test_save_checkpoint_survives_publish_fault(tmp_path, tele, monkeypatch):
+    """A sick rollout directory must never kill the training loop."""
+    rd = tmp_path / "rollout"
+    monkeypatch.setenv("MXNET_ROLLOUT_DIR", str(rd))
+    prefix = str(tmp_path / "ckpt")
+    arg = {"w": mx.nd.array(np.ones(3, np.float32))}
+    before = _counter("rollout.publish_errors")
+    with fault_scope("point=publish,path=*.manifest.json,error=EIO"):
+        mdl.save_checkpoint(prefix, 1, None, arg, {})   # must not raise
+    assert _counter("rollout.publish_errors") - before == 1
+    # the checkpoint itself was written fine
+    _, a, _ = mdl.load_checkpoint(prefix, 1)
+    np.testing.assert_array_equal(a["w"].asnumpy(), np.ones(3, np.float32))
+
+
+def test_load_checkpoint_fallback_observability(tmp_path, healthy):
+    from mxnet_tpu import engine
+    prefix = str(tmp_path / "ckpt")
+    for ep in (1, 2):
+        mdl.save_checkpoint(prefix, ep, None,
+                            {"w": mx.nd.array(np.full(3, ep, np.float32))},
+                            {})
+    if engine.async_io_enabled():
+        engine.wait_all()
+    p2 = f"{prefix}-0002.params"
+    with open(p2, "r+b") as f:
+        f.seek(os.path.getsize(p2) // 2)
+        f.write(b"\xff\xff")
+    before = _counter("checkpoint.corrupt_skipped")
+    _, arg, _, epoch = mdl.load_checkpoint(prefix, return_epoch=True)
+    assert epoch == 1
+    np.testing.assert_array_equal(arg["w"].asnumpy(),
+                                  np.ones(3, np.float32))
+    assert _counter("checkpoint.corrupt_skipped") - before == 1
+    evs = [e for e in health.events() if e["kind"] == "checkpoint_fallback"]
+    assert evs and evs[-1]["epoch"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Compile accounting: the rollout subsystem owns ZERO executables
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_rollout_owns_zero_new_executables(tele, lm2, tmp_path):
+    """The whole publish → ingest → swap cycle adds no entry to ANY
+    named compile cache: a swap is pure buffer substitution into warmed
+    executables, and the store/subscriber are host-side IO."""
+    lm, p0, p1 = lm2
+    with GenerationEngine(lm, p0, max_slots=4, max_len=48) as eng:
+        list(eng.submit(_prompts(1, seed=5)[0], max_new_tokens=6))
+        totals0 = {k: (v["entries"], v["misses"])
+                   for k, v in compile_cache.name_totals().items()}
+        rollout.publish(tmp_path, 1, p1)
+        ws = rollout.RolloutSubscriber(tmp_path).poll()
+        eng.swap_weights(ws)
+        list(eng.submit(_prompts(1, seed=6)[0], max_new_tokens=6))
+        totals1 = {k: (v["entries"], v["misses"])
+                   for k, v in compile_cache.name_totals().items()}
+    assert totals1 == totals0, (
+        f"rollout minted new executables: {totals0} -> {totals1}")
+    assert "rollout" not in totals1          # no cache of its own, ever
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance: fleet under sustained traffic
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_fleet_swap_under_traffic(healthy, lm2, tmp_path,
+                                        monkeypatch):
+    """The PR's acceptance run: a 3-replica router fleet under sustained
+    concurrent traffic takes a publish (every replica flips with zero
+    dropped/errored requests and zero steady-state compiles, in-flight
+    sessions draining bit-exact on their pinned version), REJECTS a
+    corrupt-CRC publish while still serving, and auto-rolls-back a
+    breached rollout with the fleet converged on the previous version."""
+    monkeypatch.setenv("MXNET_SLO_SPEC", "chaos_probe.errors:value<=0")
+    monkeypatch.setenv("MXNET_SLO_GRACE_S", "0")
+    health.reset()
+    lm, p0, p1 = lm2
+    router = _fleet(lm, p0, n=3)
+    try:
+        router.warm()
+        misses0 = sum(e._cache.misses for e in router.engines)
+        stop = threading.Event()
+        done, errors = [], []
+        prompts = _prompts(24, seed=21)
+
+        def client(k):
+            i = 0
+            while not stop.is_set() or i < 4:
+                try:
+                    toks = list(router.submit(prompts[(k * 7 + i) % 24],
+                                              max_new_tokens=6))
+                    done.append(len(toks))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                i += 1
+                if stop.is_set() and i >= 4:
+                    break
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)                      # traffic flowing on v0
+
+        # 1) a good publish rolls the whole fleet
+        rollout.publish(tmp_path, 1, p1, source="chaos")
+        sub = rollout.RolloutSubscriber(tmp_path)
+        ws = sub.poll()
+        rep = router.rolling_swap(ws, observe_s=0.05)
+        assert rep["swapped"] == 3 and not rep["rolled_back"]
+        time.sleep(0.2)
+
+        # 2) a corrupt publish is rejected; the fleet keeps serving v1
+        with fault_scope("point=publish,path=*.manifest.json,error=CORRUPT"):
+            rollout.publish(tmp_path, 2, p0)
+        assert sub.poll() is None and sub.version == 1
+        assert all(e.weights_version == 1 for e in router.engines)
+        time.sleep(0.2)
+
+        # 3) a breached rollout is rolled back, fleet converged on v1
+        telemetry.gauge("chaos_probe.errors").set(1)
+        rollout.publish(tmp_path, 3, p0)
+        rep3 = router.rolling_swap(sub.poll(), observe_s=0.05)
+        assert rep3["rolled_back"]
+        assert all(e.weights_version == 1 for e in router.engines)
+        telemetry.gauge("chaos_probe.errors").set(0)
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+        # zero dropped/errored requests across every phase
+        assert errors == [], errors[:3]
+        assert len(done) >= 16 and all(n == 6 for n in done)
+        # zero steady-state compiles across swap + rollback under load
+        assert sum(e._cache.misses for e in router.engines) == misses0
+        kinds = [e["kind"] for e in health.events()]
+        assert "rollout_roll" in kinds and "rollout_rollback" in kinds
+        assert "rollout_reject" in kinds
+    finally:
+        router.close()

@@ -39,6 +39,8 @@ METRICS = [
     ("serving.req_per_s", "serving req/s", "up"),
     ("serving.p99_ms", "serving p99 ms", "down"),
     ("serving.cold_compile_s", "serving cold compile s", "down"),
+    ("serving.swap_dip_depth", "serving swap dip depth", "down"),
+    ("serving.swap_dip_ms", "serving swap dip ms", "down"),
     ("generation.tokens_per_s", "generation tokens/s", "up"),
     ("generation.ttft_p50_ms", "generation TTFT p50 ms", "down"),
     ("generation.ttft_p99_ms", "generation TTFT p99 ms", "down"),
@@ -118,6 +120,9 @@ INVARIANTS = [
      "prefix-cache steady-state compiles"),
     ("lazy.steady_state_compiles", "lazy steady-state compiles"),
     ("spmd.steady_state_compiles", "spmd steady-state compiles"),
+    ("serving.swap_steady_state_compiles",
+     "weight-swap steady-state compiles"),
+    ("serving.swap_errors", "weight-swap request errors"),
 ]
 
 
