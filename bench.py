@@ -91,6 +91,12 @@ except Exception:  # noqa: BLE001 — cache is an optimisation, never fatal
 
 BASELINE_IMG_S = 298.51  # V100 fp32 b=32 training (BASELINE.md)
 
+# BENCH-record schema: v1 = the r01–r05 era (flat keys, no run id);
+# v2 adds schema_version, a monotonic run_id drawn from the perf ledger,
+# per-lane roofline fields (mfu/mbu/roofline_bound/predicted_floor_s) and
+# the observatory summary under "roofline"
+BENCH_SCHEMA_VERSION = 2
+
 
 # phase name -> deterministic trace id: stamped into the BENCH json AND
 # the telemetry sidecar, so a number cross-references the tracing dump
@@ -127,6 +133,39 @@ def _bench_stamp(backend=None, backend_err=None):
     if _PHASE_TRACE_IDS:
         stamp["trace_ids"] = dict(_PHASE_TRACE_IDS)
     return stamp
+
+
+def _roofline_stamp(lane, dst, mbu_headline=None):
+    """Merge the observatory's roofline attribution for ``lane`` into a
+    result dict: achieved MFU/MBU against the measured peaks, the
+    predicted floor time, and which roofline term binds. Additive —
+    attribution failure (cost analysis unavailable on some backends)
+    never sinks the bench. ``mbu_headline`` names an extra alias for the
+    MBU figure (the decode tick is bandwidth-bound by construction, so
+    its headline is ``tick_mbu``)."""
+    try:
+        from mxnet_tpu import observatory
+
+        if not observatory._enabled or not isinstance(dst, dict):
+            return
+        row = observatory.attribution(lane)
+        if not row:
+            return
+        # publish the lane gauges NOW: the spmd phase resets the step
+        # lane, so the sidecar snapshot must not depend on the final
+        # summary() still seeing it
+        observatory._publish_gauges(lane, row)
+        for k in ("mfu", "mbu", "comm_fraction", "predicted_floor_s",
+                  "measured_over_floor"):
+            v = row.get(k)
+            if isinstance(v, float):
+                dst[k] = round(v, 6)
+        if row.get("roofline_bound"):
+            dst["roofline_bound"] = row["roofline_bound"]
+        if mbu_headline and isinstance(dst.get("mbu"), float):
+            dst[mbu_headline] = dst["mbu"]
+    except Exception:  # noqa: BLE001 — attribution is additive
+        pass
 
 
 def _write_telemetry_snapshot(stamp=None):
@@ -1403,6 +1442,17 @@ def main():
                 result["telemetry_enabled"] = True
             except Exception:  # noqa: BLE001
                 pass
+        # roofline observatory: per-lane wall/exec observation is a dict
+        # update per step (noise), attribution + the measured-peak probes
+        # run AFTER each phase's timed window
+        if os.environ.get("MXNET_OBSERVATORY") != "0":
+            try:
+                from mxnet_tpu import observatory
+
+                observatory.enable()
+                result["observatory_enabled"] = True
+            except Exception:  # noqa: BLE001
+                pass
         fetch_cost = _fetch_cost()
         result["fetch_cost_ms"] = round(fetch_cost * 1e3, 3)
         with _phase_scope("raw_fp32"):
@@ -1442,6 +1492,10 @@ def main():
             result["framework_vs_raw_note"] = (
                 "basis changed in the fused-step PR: r01-r05 measured the "
                 "gluon path, continued as framework_gluon_vs_raw")
+            # roofline attribution for the fused step, stamped NOW —
+            # before module_eager's fit loop dilutes the step lane's wall
+            # EWMA with eager walls
+            _roofline_stamp("step", result)
         except Exception:  # noqa: BLE001
             result["module_error"] = traceback.format_exc(limit=3).strip().splitlines()[-1]
             result["framework_vs_raw"] = round(fw_fetch / raw_fetch, 3)
@@ -1490,6 +1544,7 @@ def main():
             # in the BENCH_TELEMETRY.json sidecar
             with _phase_scope("serving"):
                 result["serving"] = _measure_serving(on_tpu)
+            _roofline_stamp("serving", result.get("serving"))
         except Exception:  # noqa: BLE001
             result["serving_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
@@ -1499,6 +1554,10 @@ def main():
             # (prefill ladder + decode compiles) separated from warm
             with _phase_scope("generation"):
                 result["generation"] = _measure_generation(on_tpu)
+            # the decode tick moves KV cache, not FLOPs: MBU is the
+            # honest utilisation figure, so it gets the tick_mbu headline
+            _roofline_stamp("generation.tick", result.get("generation"),
+                            mbu_headline="tick_mbu")
         except Exception:  # noqa: BLE001
             result["generation_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
@@ -1516,8 +1575,19 @@ def main():
             # the spmd plane: GSPMD-sharded fused step (MXNET_SPMD) vs
             # replicated — measured 1/N param residency + compile
             # invariant; skips (recorded) on single-device runs
+            try:
+                from mxnet_tpu import observatory
+
+                # fresh step lane: the spmd phase re-drives fused_step and
+                # must not inherit the single-device phase's EWMAs
+                observatory.reset("step")
+            except Exception:  # noqa: BLE001
+                pass
             with _phase_scope("spmd"):
                 result["spmd"] = _measure_spmd(on_tpu)
+            if isinstance(result.get("spmd"), dict) and \
+                    "skipped" not in result["spmd"]:
+                _roofline_stamp("step", result["spmd"])
         except Exception:  # noqa: BLE001
             result["spmd_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
@@ -1548,9 +1618,38 @@ def main():
             result["mfu_error"] = traceback.format_exc(limit=3).strip().splitlines()[-1]
     except Exception:  # noqa: BLE001 — a bench crash must still emit JSON
         result["error"] = traceback.format_exc(limit=5).strip().splitlines()[-1]
+    # the observatory's full report (measured peaks + per-lane roofline
+    # rows) rides along; summary() also refreshes the lane gauges the
+    # telemetry sidecar snapshots below
+    try:
+        from mxnet_tpu import observatory
+
+        if observatory._enabled:
+            result["roofline"] = observatory.summary()
+    except Exception:  # noqa: BLE001 — the report is additive
+        pass
     # re-stamp: trace ids accumulated as phases ran, and the headline
     # backend may have resolved after the first stamp
+    result["schema_version"] = BENCH_SCHEMA_VERSION
     stamp = _bench_stamp(result.get("backend"))
+    stamp["schema_version"] = BENCH_SCHEMA_VERSION
+    # cross-run perf ledger: every run appends one record (run_id is the
+    # ledger's monotonic counter, stamped back into the BENCH json and
+    # the telemetry sidecar). MXNET_PERF_LEDGER=0 disables, any other
+    # value overrides the default PERF_LEDGER.jsonl at the repo root.
+    if os.environ.get("MXNET_PERF_LEDGER") != "0":
+        try:
+            from tools import perf_ledger
+
+            result["run_id"] = perf_ledger.next_run_id()
+            stamp["run_id"] = result["run_id"]
+            lrec = perf_ledger.record_from_bench(dict(result, **stamp),
+                                                 source="bench.py")
+            lrec["run_id"] = result["run_id"]
+            perf_ledger.append(lrec)
+            result["perf_ledger"] = perf_ledger.ledger_path()
+        except Exception:  # noqa: BLE001 — the ledger never sinks the bench
+            pass
     result.update(stamp)
     snap_path = _write_telemetry_snapshot(stamp=stamp)
     if snap_path:

@@ -176,6 +176,15 @@ unit() {
   # attributed, not as a flaky assertion inside an unrelated suite
   log "health suite (SLO tracker, liveness/readiness, stall watchdog + capture, router drain, chaos acceptance)"
   python -m pytest tests/python/unittest/test_health.py -q
+  # observatory gate, standalone: these tests flip the process-global
+  # observatory state, run measured-peak probes (tiny shapes on CPU) and
+  # pin probe caching/provenance invalidation, roofline attribution math
+  # against hand-computed fixtures, bound classification (matmul=compute
+  # vs elementwise=bandwidth), per-lane MFU/MBU gauge publication, ledger
+  # ingest + regression flagging and the zero-overhead-off subprocess —
+  # a roofline or ledger regression fails HERE, attributed
+  log "observatory suite (measured-peak probes, roofline attribution, MFU/MBU gauges, perf ledger)"
+  python -m pytest tests/python/unittest/test_observatory.py -q
   # spmd gate, standalone: these tests flip MXNET_SPMD / MXNET_ZERO1 /
   # MXNET_PIPELINE_* and pin sharded-vs-replicated whole-run parity,
   # MEASURED 1/N per-device param+state residency, tp x fsdp x pp x
@@ -330,11 +339,27 @@ PY
 
   log "bench smoke (CPU, reduced steps)"
   # fresh compile cache: XLA:CPU AOT entries are machine-feature-pinned,
-  # and a cache written on another host can SIGILL here
+  # and a cache written on another host can SIGILL here. The run appends
+  # its perf-ledger record to a SCRATCH COPY of the committed ledger
+  # (PERF_LEDGER.jsonl stays clean in CI) so the advisory check below
+  # exercises the real rolling-baseline path against real history
   bench_cache="$(mktemp -d)"
+  bench_ledger="$(mktemp)"
+  cp PERF_LEDGER.jsonl "$bench_ledger" 2>/dev/null || true
   env JAX_PLATFORMS=cpu BENCH_FORCE_CPU=1 BENCH_ITERS=2 \
-      BENCH_COMPILE_CACHE="$bench_cache" timeout 900 python bench.py
+      BENCH_COMPILE_CACHE="$bench_cache" \
+      MXNET_PERF_LEDGER="$bench_ledger" timeout 900 python bench.py
   rm -rf "$bench_cache"
+
+  log "perf-ledger trajectory check (tools/perf_ledger.py check, advisory)"
+  # ADVISORY: the smoke run above vs the median of recent same-backend
+  # ledger records. A CPU smoke box is noisy, so a nonzero exit only
+  # logs; the check output marks a regression 'confirmed' once two
+  # consecutive runs agree — that is the promotion bar for making this
+  # gate blocking later
+  python -m tools.perf_ledger check --ledger "$bench_ledger" \
+      || log "perf_ledger: ADVISORY regression vs rolling baseline (see table above; hard-fails only after two consecutive runs agree)"
+  rm -f "$bench_ledger"
 
   log "bench trajectory check (tools/bench_compare.py, advisory)"
   # ADVISORY: diff the two newest committed sidecars so a throughput

@@ -303,11 +303,11 @@ class CompileCache:
             self._entry_stats[key] = {
                 "avals": jax.tree_util.tree_map(aval, (tuple(args),
                                                        dict(kwargs))),
-                "memory": None}
+                "memory": None, "cost": None, "collectives": None}
         except Exception:  # noqa: BLE001 — stats are additive, never fatal
             pass
 
-    def entry_memory(self, key):
+    def entry_memory(self, key, _want_collectives=False):
         """XLA compiled-memory analysis for one entry: {argument_bytes,
         output_bytes, temp_bytes, peak_bytes} or None. Computed LAZILY via
         an AOT `lower().compile()` pass over the recorded avals and
@@ -319,7 +319,8 @@ class CompileCache:
         st = self._entry_stats.get(key)
         if st is None:
             return None
-        if st["memory"] is not None:
+        if st["memory"] is not None and not (
+                _want_collectives and st.get("collectives") is None):
             return st["memory"] or None  # False = memoized FAILED analysis
         fn = self._entries.get(key)
         target = getattr(fn, "_fn", fn)
@@ -328,7 +329,33 @@ class CompileCache:
         try:
             args, kwargs = st["avals"]
             with donation_warnings_suppressed():
-                ma = target.lower(*args, **kwargs).compile().memory_analysis()
+                compiled = target.lower(*args, **kwargs).compile()
+            ma = compiled.memory_analysis()
+            # the same AOT pass also yields the cost analysis (FLOPs,
+            # bytes accessed — the observatory's roofline numerators) for
+            # free; the collective inventory needs the full
+            # post-optimization HLO TEXT, which is expensive to serialise
+            # and parse for big programs, so it is extracted only when
+            # entry_collectives asked for it (the /memory scrape sweeps
+            # every entry and must stay as cheap as plain memory_analysis)
+            try:
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                st["cost"] = {
+                    "flops": float(ca.get("flops", 0.0)),
+                    "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+            except Exception:  # noqa: BLE001 — cost is best-effort
+                st["cost"] = False
+            if _want_collectives:
+                try:
+                    from . import analysis
+
+                    kinds, _ = analysis.parse_collectives(compiled.as_text())
+                    st["collectives"] = {k: dict(v)
+                                         for k, v in kinds.items()}
+                except Exception:  # noqa: BLE001 — inventory best-effort
+                    st["collectives"] = False
             st["memory"] = {
                 "argument_bytes": int(ma.argument_size_in_bytes),
                 "output_bytes": int(ma.output_size_in_bytes),
@@ -343,8 +370,39 @@ class CompileCache:
                                   - ma.alias_size_in_bytes)}
         except Exception:  # noqa: BLE001 — analysis is best-effort
             st["memory"] = False  # memoize the failure: the AOT lowering
+            st.setdefault("cost", None)
+            st["cost"] = st["cost"] or False
+            st["collectives"] = st.get("collectives") or False
             return None           # is expensive and will not get better
         return st["memory"]
+
+    def entry_cost(self, key):
+        """XLA cost analysis for one entry: ``{flops, bytes_accessed}``
+        or None — computed in the SAME lazy AOT pass as
+        :meth:`entry_memory` (one lowering feeds memory, cost and
+        collective attribution), memoized including failures. The
+        observatory's roofline numerators."""
+        st = self._entry_stats.get(key)
+        if st is None:
+            return None
+        if st.get("cost") is None:
+            self.entry_memory(key)
+        return st.get("cost") or None
+
+    def entry_collectives(self, key):
+        """Collective inventory of one entry's COMPILED program
+        (``{kind: {count, bytes}}``, bytes per participant) or None —
+        recorded by the shared AOT pass on demand (an entry first scanned
+        by a plain memory scrape pays one extra lowering here); the
+        observatory's comm-bound attribution source, same parser as the
+        hlolint audit."""
+        st = self._entry_stats.get(key)
+        if st is None:
+            return None
+        if st.get("collectives") is None:
+            self.entry_memory(key, _want_collectives=True)
+        coll = st.get("collectives")
+        return coll if coll not in (None, False) else None
 
     def memory_stats(self, compute=False):
         """Per-entry memory rows for this cache: entries whose analysis

@@ -498,7 +498,18 @@ def capture_diagnostics(reason, beacon=None, extra=None):
 _DEFAULT_SPEC = ("serving.generation.ttft_us:p99<500ms;"
                  "serving.e2e_us:p99<250ms;"
                  "compile.cache_misses:rate<=0;"
-                 "step.total_us:p99<8*p50")
+                 "step.total_us:p99<8*p50;"
+                 # MFU collapse: achieved step FLOP/s under 0.1% of the
+                 # MEASURED matmul peak (observatory.summary publishes
+                 # step.mfu) means the step path stopped doing real work
+                 # per wall second — a bug, not a ceiling, on any backend
+                 "step.mfu:value>=0.001;"
+                 # projected peak-HBM headroom went negative: resident
+                 # census + the worst warmed executable's temp working
+                 # set exceed device capacity (memory.census) — the next
+                 # dispatch of that program OOMs even though today's
+                 # resident bytes still fit
+                 "memory.headroom_bytes:value>=0")
 
 _OBJ_RE = re.compile(
     r"^(p\d{1,2}|avg|min|max|count|rate|value)\s*"

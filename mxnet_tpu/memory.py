@@ -44,9 +44,16 @@ import weakref
 
 from . import analysis
 from . import telemetry
+from .base import getenv, register_env
 
 __all__ = ["CATEGORIES", "track", "track_transient", "register_provider",
-           "census", "update_gauges", "executable_stats", "clear"]
+           "census", "update_gauges", "executable_stats", "clear",
+           "device_capacity_bytes"]
+
+register_env("MXNET_DEVICE_HBM_BYTES", 0,
+             "per-device memory capacity override in bytes for the "
+             "memory.headroom_bytes gauge; 0 = use the backend's "
+             "reported bytes_limit (none on CPU: headroom unpublished)")
 
 CATEGORIES = ("weights", "optimizer_state", "gradients", "serving_batches",
               "kv_cache")
@@ -241,9 +248,56 @@ def census(update=True):
            "live_total": live_total,
            "other": max(0, live_total - categorized),
            "device_count": len(per_device)}
+    cap = device_capacity_bytes()
+    if cap:
+        # peak-HBM headroom PROJECTED to the worst already-analyzed
+        # executable: capacity − (busiest device's categorized bytes +
+        # unattributed live bytes + the largest temp working set any
+        # warmed program needs while it runs). Negative means the next
+        # dispatch of that program is an OOM waiting to happen even
+        # though the resident census still fits — the SLO default row
+        # memory.headroom_bytes:value>=0 burns on exactly that.
+        used = max(per_device.values()) if per_device else 0
+        out["capacity_bytes"] = cap
+        out["worst_executable_temp_bytes"] = _worst_temp_bytes()
+        out["headroom_bytes"] = (cap - used - out["other"]
+                                 - out["worst_executable_temp_bytes"])
     if update:
         _publish(out)
     return out
+
+
+def device_capacity_bytes():
+    """Per-device memory capacity in bytes: the backend's reported
+    ``bytes_limit`` where available (TPU/GPU), else the
+    ``MXNET_DEVICE_HBM_BYTES`` override, else 0 (unknown — headroom is
+    not published)."""
+    cap = int(getenv("MXNET_DEVICE_HBM_BYTES"))
+    if cap:
+        return cap
+    try:
+        import jax
+
+        ms = jax.devices()[0].memory_stats()
+        if ms:
+            return int(ms.get("bytes_limit") or 0)
+    except Exception:  # noqa: BLE001 — CPU backends have no stats
+        pass
+    return 0
+
+
+def _worst_temp_bytes():
+    """Largest temp working set among executables whose lazy memory
+    analysis has ALREADY run (compute=False — the census never triggers
+    an AOT pass; /memory's executable_stats(compute=True) is what fills
+    this in)."""
+    from . import compile_cache
+
+    worst = 0
+    for c in compile_cache.all_caches():
+        for row in c.memory_stats(compute=False):
+            worst = max(worst, int(row.get("temp_bytes") or 0))
+    return worst
 
 
 def _publish(snap):
@@ -254,6 +308,9 @@ def _publish(snap):
         telemetry.gauge(f"memory.{cat}_bytes_total").set(v["total"])
     telemetry.gauge("memory.other_bytes").set(snap["other"])
     telemetry.gauge("memory.live_bytes_total").set(snap["live_total"])
+    if "headroom_bytes" in snap:
+        telemetry.gauge("memory.headroom_bytes").set(snap["headroom_bytes"])
+        telemetry.gauge("memory.capacity_bytes").set(snap["capacity_bytes"])
 
 
 def update_gauges():

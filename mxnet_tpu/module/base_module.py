@@ -14,6 +14,7 @@ import time
 import numpy as _np
 
 from .. import health
+from .. import observatory
 from .. import telemetry
 from .. import tracing
 from ..base import MXNetError
@@ -253,7 +254,7 @@ class BaseModule:
                     # flight recorder.
                     tele = telemetry._enabled
                     trc = tracing._enabled
-                    timed = tele or trc
+                    timed = tele or trc or observatory._enabled
                     step_span = tracing.span(
                         "step", cat="train",
                         trace_id=(tracing.deterministic_trace_id(
@@ -304,6 +305,11 @@ class BaseModule:
                             step_span.set(fused=fused)
                     if trc:
                         tracing.flight_recorder.observe(step_span.tree())
+                    if observatory._enabled:
+                        # steady-state step wall for the roofline's
+                        # achieved MFU/MBU (the executable itself was
+                        # named by Executor.fused_step's exec_s sample)
+                        observatory.observe("step", wall_s=t_data - t0)
                     step_stats = None
                     if tele:
                         total_h = telemetry.histogram("step.total_us")

@@ -1,0 +1,534 @@
+"""The performance observatory: roofline attribution against MEASURED peaks.
+
+Every headline number the repo produced before this module (img/s,
+tokens/s, "4% MFU") was unanchored wall clock — nothing related measured
+time to what the hardware *could* do, so a 4%-MFU bug and a 4%-MFU
+ceiling read identically (the ROADMAP's falsifiability gap). This module
+closes the loop in three moves:
+
+1. **Measured-peak probes** (:func:`peaks`) — tiny microbenchmarks per
+   device kind: sustained matmul FLOP/s per dtype, HBM/memcpy bandwidth,
+   and collective (all-reduce) bandwidth over the visible devices. Peaks
+   are measured once and persisted under ``MXNET_OBSERVATORY_DIR`` with
+   provenance (backend, device kind, device count, probe sizes); a
+   provenance mismatch re-probes. Probe executables compile under the
+   named ``CompileCache("observatory")`` so their compiles stay counted.
+
+2. **Per-executable attribution** (:func:`attribution` / :func:`summary`)
+   — from the cost analysis CompileCache records per entry (FLOPs, bytes
+   accessed — one AOT pass shared with ``entry_memory``) plus the
+   compiled program's collective inventory (``analysis.parse_collectives``),
+   compute each observed lane's roofline bound (compute- vs bandwidth- vs
+   comm-bound), predicted floor time, and achieved MFU/MBU from the
+   measured steady-state time. Surfaced as telemetry gauges (``step.mfu``,
+   ``step.mbu``, ``generation.tick_mbu``, ``*.comm_fraction``,
+   ``step.host_gap_us`` = wall − device-busy), the ``/roofline`` HTTP
+   endpoint next to ``/metrics``, and worst-offender rows in
+   ``tools/telemetry_report.py``.
+
+3. **Lane observations** (:func:`observe`) — the instrumented hot paths
+   (``Executor.fused_step``, ``Predictor._run``, the generation
+   scheduler's ``_tick``) report which executable ran and how long it
+   took; the off cost is exactly ONE module-attribute read per site
+   (``observatory._enabled``), pinned by a fresh-subprocess test like the
+   telemetry/health/tracing planes.
+
+Attribution math (the classic roofline):
+
+* ``t_compute = flops / peak_flops(dtype)``
+* ``t_memory  = bytes_accessed / peak_hbm_bytes_per_s``
+* ``t_comm    = collective_bytes / peak_collective_bytes_per_s``
+* ``predicted_floor_s = max(of the three)`` — its argmax is the bound
+* ``mfu = (flops / measured_s) / peak_flops`` and
+  ``mbu = (bytes_accessed / measured_s) / peak_hbm`` — achieved
+  utilization against MEASURED (probe-derived, never spec-sheet) peaks.
+
+On CPU the measured "HBM" bandwidth is host memory bandwidth and the
+matmul peak is whatever the BLAS path sustains — the *ratios* stay
+meaningful (a decode tick whose t_memory dominates is bandwidth-bound on
+any backend), but predicted floors on tiny CI shapes sit well under the
+measured wall because per-dispatch host overhead dominates; see
+docs/faq/perf.md "Reading the roofline" for the documented factor.
+
+Everything here is OFF the step path: ``observe()`` is a dict update
+under a lock, and the expensive parts (probes, the per-entry AOT cost
+analysis) run only inside :func:`peaks` / :func:`summary` — pull-based,
+from bench.py, the HTTP endpoint, or an explicit call.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import weakref
+
+from . import telemetry
+from .base import getenv, register_env
+
+register_env("MXNET_OBSERVATORY", False,
+             "enable the roofline observatory: measured-peak probes + "
+             "per-executable MFU/MBU attribution (off = zero overhead, "
+             "one attribute read per instrumented site)")
+register_env("MXNET_OBSERVATORY_DIR", "",
+             "directory for persisted peak-probe results (JSON per "
+             "backend/device-kind/device-count, provenance-checked); "
+             "unset = probe once per process, no persistence")
+register_env("MXNET_OBSERVATORY_PROBE_N", 0,
+             "matmul probe dimension override (NxN); 0 = auto per "
+             "backend (512 on cpu, 4096 on accelerators)")
+register_env("MXNET_OBSERVATORY_PROBE_MB", 64,
+             "memcpy/HBM-bandwidth probe buffer size in MiB")
+
+SCHEMA_VERSION = 1
+
+_enabled = bool(getenv("MXNET_OBSERVATORY"))
+_lock = threading.Lock()
+_lanes = {}          # lane -> {"cache", "key", "wall", "exec", "count"}
+_peaks = None        # cached probe result (dict) for this process
+_probe_runs = 0      # how many times the probes actually RAN (tests pin
+                     # disk-cache hits by asserting this does not move)
+_last_summary = None  # last computed summary (snapshot embeds it for free)
+_cache = None        # the named CompileCache("observatory") for probes
+
+
+def enabled():
+    return _enabled
+
+
+def enable(on=True):
+    """Turn the observatory on/off at runtime (tests; bench.py calls this
+    unless ``MXNET_OBSERVATORY=0``). Enabling never probes by itself —
+    peaks are measured lazily on the first :func:`peaks` call."""
+    global _enabled
+    _enabled = bool(on)
+    return _enabled
+
+
+def disable():
+    return enable(False)
+
+
+def reset(lane=None):
+    """Drop observed lane timings (``lane=None`` drops all). bench.py
+    resets between phases so one phase's steady-state EWMA never bleeds
+    into the next lane's attribution."""
+    with _lock:
+        if lane is None:
+            _lanes.clear()
+        else:
+            _lanes.pop(lane, None)
+
+
+# ---------------------------------------------------------------------------
+# lane observations (the hot-path API — cheap, no compile, no probe)
+# ---------------------------------------------------------------------------
+
+# Lane -> telemetry gauge prefix. "generation.tick" publishes tick_mbu
+# (underscore, per the decode-tick metric family), the others dot-join.
+_GAUGE_PREFIX = {"step": "step.", "serving": "serving.",
+                 "generation.tick": "serving.generation.tick_"}
+
+
+def _ewma_update(st, field, value, alpha=0.2):
+    cur = st.get(field)
+    if cur is None:
+        st[field] = float(value)
+    else:
+        st[field] = (1.0 - alpha) * cur + alpha * float(value)
+    mn = st.get(field + "_min")
+    st[field + "_min"] = float(value) if mn is None else min(mn, float(value))
+
+
+def observe(lane, cache=None, key=None, wall_s=None, exec_s=None):
+    """Record one steady-state timing sample for ``lane``.
+
+    ``cache``/``key`` name the executable that ran (a CompileCache — the
+    instance itself, or its name — and entry key; attribution pulls its
+    FLOPs/bytes later, NEVER here). Pass the INSTANCE where the call
+    site has it: cache names are shared (every GenerationEngine owns a
+    ``CompileCache("generation")``, and two engines can hold the same
+    decode key for different models), so a name-only lookup can resolve
+    to another instance's entry. The instance is held weakly —
+    observing never extends an executable's lifetime. ``wall_s`` is the
+    full step/tick wall time, ``exec_s`` the window around just the
+    executable dispatch+drain (their difference is the host gap). Call
+    sites gate on ``observatory._enabled`` so the off cost is one
+    attribute read."""
+    if not _enabled:
+        return
+    with _lock:
+        st = _lanes.setdefault(lane, {"count": 0})
+        if cache is not None:
+            if isinstance(cache, str):
+                st["cache"] = cache
+                st.pop("_cache_ref", None)
+            else:
+                st["cache"] = cache.name
+                st["_cache_ref"] = weakref.ref(cache)
+            st["key"] = key
+        if wall_s is not None:
+            _ewma_update(st, "wall_s", wall_s)
+        if exec_s is not None:
+            _ewma_update(st, "exec_s", exec_s)
+        st["count"] += 1
+
+
+def lanes():
+    """Shallow copy of the observed-lane table (tests/report); private
+    fields (the weak cache ref) are stripped."""
+    with _lock:
+        return {k: {f: v for f, v in st.items() if not f.startswith("_")}
+                for k, st in _lanes.items()}
+
+
+# ---------------------------------------------------------------------------
+# measured-peak probes
+# ---------------------------------------------------------------------------
+
+
+def _probe_cache():
+    global _cache
+    if _cache is None:
+        from .compile_cache import CompileCache
+
+        # track_memory=False: three tiny probe programs need no per-entry
+        # AOT memory analysis riding the /memory scrape
+        _cache = CompileCache("observatory", track_memory=False)
+    return _cache
+
+
+def _provenance():
+    import jax
+
+    dev = jax.devices()[0]
+    n = int(getenv("MXNET_OBSERVATORY_PROBE_N"))
+    backend = dev.platform
+    if not n:
+        n = 512 if backend == "cpu" else 4096
+    return {"schema_version": SCHEMA_VERSION,
+            "backend": backend,
+            "device_kind": getattr(dev, "device_kind", backend),
+            "device_count": jax.device_count(),
+            "probe_n": n,
+            "probe_mb": int(getenv("MXNET_OBSERVATORY_PROBE_MB")),
+            "jax": getattr(jax, "__version__", "unknown")}
+
+
+def _peaks_path(prov):
+    d = getenv("MXNET_OBSERVATORY_DIR")
+    if not d:
+        return None
+    slug = "".join(c if c.isalnum() else "-"
+                   for c in str(prov["device_kind"]))[:48]
+    return os.path.join(
+        d, f"peaks_{prov['backend']}_{slug}_{prov['device_count']}.json")
+
+
+def _min_time(fn, reps=3):
+    best = math.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _probe_matmul_flops(n, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.ones((n, n), dtype)
+    f = _probe_cache().get_or_build(
+        ("probe_matmul", n, str(dtype)),
+        lambda: jax.jit(lambda x, y: x @ y))
+    jax.block_until_ready(f(a, a))  # compile + warm
+    dt = _min_time(lambda: jax.block_until_ready(f(a, a)))
+    return 2.0 * n ** 3 / max(dt, 1e-9)
+
+
+def _probe_hbm_bandwidth(mb):
+    import jax
+    import jax.numpy as jnp
+
+    n = max(int(mb), 1) * (1 << 20) // 4
+    x = jnp.ones((n,), jnp.float32)
+    f = _probe_cache().get_or_build(
+        ("probe_copy", n), lambda: jax.jit(lambda v: v + 1.0))
+    jax.block_until_ready(f(x))
+    dt = _min_time(lambda: jax.block_until_ready(f(x)))
+    # the kernel reads N and writes N bytes — 2x the buffer per pass
+    return 2.0 * n * 4 / max(dt, 1e-9)
+
+
+def _probe_collective_bandwidth(mb):
+    """Sustained all-reduce bytes/s per participant over every visible
+    device, or None on a single device (nothing to move)."""
+    import jax
+    import jax.numpy as jnp
+
+    ndev = jax.device_count()
+    if ndev < 2:
+        return None
+    n = max(int(mb), 1) * (1 << 20) // (4 * ndev)
+    x = jnp.ones((ndev, n), jnp.float32)
+    f = _probe_cache().get_or_build(
+        ("probe_psum", ndev, n),
+        lambda: jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i"))
+    jax.block_until_ready(f(x))
+    dt = _min_time(lambda: jax.block_until_ready(f(x)))
+    # ring all-reduce moves 2*(N-1)/N of the payload per participant
+    moved = 2.0 * (ndev - 1) / ndev * n * 4
+    return moved / max(dt, 1e-9)
+
+
+def _run_probes(prov):
+    global _probe_runs
+    _probe_runs += 1
+    n, mb = prov["probe_n"], prov["probe_mb"]
+    flops = {}
+    for dtype in ("float32", "bfloat16"):
+        try:
+            flops[dtype] = _probe_matmul_flops(n, dtype)
+        except Exception:  # noqa: BLE001 — a dtype the backend lacks
+            pass
+    out = {"provenance": prov,
+           "matmul_flops": flops,
+           "hbm_bytes_per_s": _probe_hbm_bandwidth(mb),
+           "collective_bytes_per_s": None,
+           "probed_unix": time.time(),
+           "source": "measured"}
+    try:
+        out["collective_bytes_per_s"] = _probe_collective_bandwidth(mb)
+    except Exception:  # noqa: BLE001 — collectives are best-effort
+        pass
+    return out
+
+
+def peaks(refresh=False):
+    """The measured device peaks (probing lazily on first use). The
+    result is cached in-process and — when ``MXNET_OBSERVATORY_DIR`` is
+    set — on disk, keyed and validated by provenance: a different
+    backend, device kind, device count, or probe size re-probes instead
+    of trusting a stale file. ``refresh=True`` forces a re-probe."""
+    global _peaks
+    if _peaks is not None and not refresh:
+        return _peaks
+    prov = _provenance()
+    path = _peaks_path(prov)
+    if path and not refresh:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if doc.get("provenance") == prov:
+                doc["source"] = "disk"
+                _peaks = doc
+                return _peaks
+        except (OSError, ValueError):
+            pass
+    doc = _run_probes(prov)
+    if path:
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = path + ".tmp~"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+    _peaks = doc
+    return _peaks
+
+
+def probe_verdict():
+    """One-line provenance string for ledgers/sidecars: where the peaks
+    came from and what they are."""
+    p = _peaks
+    if p is None:
+        return "unprobed"
+    prov = p["provenance"]
+    return (f"{p['source']}:{prov['backend']}/{prov['device_kind']}"
+            f"x{prov['device_count']}")
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+
+
+def attribute(flops, bytes_accessed, coll_bytes, pk,
+              dtype="float32", wall_s=None, exec_s=None):
+    """Pure roofline math over one executable's counted work — the
+    hand-checkable core (test_observatory.py pins it against fixtures).
+    Returns the attribution row; measured fields are present only when a
+    ``wall_s`` observation is supplied."""
+    mf = pk.get("matmul_flops") or {}
+    peak_flops = mf.get(dtype) or (max(mf.values()) if mf else None)
+    hbm = pk.get("hbm_bytes_per_s")
+    cbw = pk.get("collective_bytes_per_s")
+    t_compute = (flops / peak_flops) if (flops and peak_flops) else 0.0
+    t_memory = (bytes_accessed / hbm) if (bytes_accessed and hbm) else 0.0
+    t_comm = (coll_bytes / cbw) if (coll_bytes and cbw) else 0.0
+    floor = max(t_compute, t_memory, t_comm)
+    if floor <= 0.0:
+        bound = "unknown"
+    elif floor == t_comm:
+        bound = "comm"
+    elif floor == t_memory:
+        bound = "bandwidth"
+    else:
+        bound = "compute"
+    out = {"flops": flops, "bytes_accessed": bytes_accessed,
+           "collective_bytes": coll_bytes,
+           "t_compute_s": t_compute, "t_memory_s": t_memory,
+           "t_comm_s": t_comm,
+           "predicted_floor_s": floor, "roofline_bound": bound,
+           "peak_flops": peak_flops, "peak_hbm_bytes_per_s": hbm,
+           "peak_collective_bytes_per_s": cbw, "dtype": dtype}
+    if wall_s and wall_s > 0:
+        out["measured_s"] = wall_s
+        if peak_flops and flops:
+            out["mfu"] = (flops / wall_s) / peak_flops
+        if hbm and bytes_accessed:
+            out["mbu"] = (bytes_accessed / wall_s) / hbm
+        out["comm_fraction"] = (t_comm / floor) if floor > 0 else 0.0
+        if floor > 0:
+            out["measured_over_floor"] = wall_s / floor
+        if exec_s is not None:
+            out["host_gap_us"] = max(wall_s - exec_s, 0.0) * 1e6
+    return out
+
+
+def _find_cache(name, key=None):
+    """The live CompileCache called ``name`` — preferring, when several
+    instances share the name (every GenerationEngine owns a
+    ``CompileCache("generation")``), the one that actually holds ``key``."""
+    from . import compile_cache
+
+    first = None
+    for c in compile_cache.all_caches():
+        if c.name == name:
+            if key is None or key in getattr(c, "_entry_stats", {}):
+                return c
+            if first is None:
+                first = c
+    return first
+
+
+def _entry_dtype(cache, key):
+    """Dominant input dtype of the entry — picks which matmul peak the
+    MFU denominator uses (bf16 programs against the bf16 peak)."""
+    st = cache._entry_stats.get(key)
+    if not st:
+        return "float32"
+    try:
+        import jax
+
+        best, best_bytes = "float32", -1
+        args, kwargs = st["avals"]
+        for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+            if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+                nb = int(leaf.dtype.itemsize)
+                for d in leaf.shape:
+                    nb *= int(d)
+                if nb > best_bytes:
+                    best, best_bytes = str(leaf.dtype), nb
+        return best
+    except Exception:  # noqa: BLE001 — a wrong dtype only blurs the peak
+        return "float32"
+
+
+def attribution(lane, pk=None):
+    """Roofline attribution for one observed lane, or None when the lane
+    has no observation or no attributable executable. Pull-based: the
+    first call per entry pays the shared AOT cost/memory analysis
+    (compile_cache.entry_cost — seconds for donated programs), never the
+    step path."""
+    with _lock:
+        st = dict(_lanes.get(lane) or {})
+    cache_name, key = st.get("cache"), st.get("key")
+    if cache_name is None:
+        return None
+    # the observed instance itself when still alive; the name lookup is
+    # only a fallback (names are shared across instances)
+    ref = st.get("_cache_ref")
+    cache = ref() if ref is not None else None
+    if cache is None:
+        cache = _find_cache(cache_name, key)
+    if cache is None:
+        return None
+    cost = cache.entry_cost(key)
+    if not cost:
+        return None
+    coll = cache.entry_collectives(key) or {}
+    coll_bytes = sum(v.get("bytes", 0) for v in coll.values())
+    # wall falls back to the dispatch window: a caller driving
+    # fused_step directly (bench's module loop) observes only exec_s,
+    # and the blocked dispatch window IS its wall
+    wall = st.get("wall_s")
+    if wall is None:
+        wall = st.get("exec_s")
+    row = attribute(cost.get("flops", 0.0),
+                    cost.get("bytes_accessed", 0.0),
+                    coll_bytes, pk or peaks(),
+                    dtype=_entry_dtype(cache, key),
+                    wall_s=wall, exec_s=st.get("exec_s"))
+    row["lane"] = lane
+    row["cache"] = cache_name
+    row["key"] = repr(key)
+    row["samples"] = st.get("count", 0)
+    mem = cache.entry_memory(key)
+    if mem:
+        row["peak_bytes"] = mem.get("peak_bytes")
+    return row
+
+
+def _publish_gauges(lane, row):
+    prefix = _GAUGE_PREFIX.get(lane, lane + ".")
+    for field, gauge in (("mfu", "mfu"), ("mbu", "mbu"),
+                        ("comm_fraction", "comm_fraction"),
+                        ("host_gap_us", "host_gap_us")):
+        v = row.get(field)
+        if v is not None:
+            telemetry.gauge(prefix + gauge).set(round(float(v), 6))
+
+
+def summary(refresh_peaks=False):
+    """The observatory's full report: measured peaks + one attribution
+    row per observed lane, gauges published as a side effect
+    (``step.mfu``/``step.mbu``/``serving.*``/``serving.generation.tick_mbu``
+    and friends — the SLO plane's MFU-collapse row reads these). This is
+    the ``/roofline`` endpoint's body and the bench stamp source."""
+    global _last_summary
+    if not _enabled:
+        return {"enabled": False}
+    pk = peaks(refresh=refresh_peaks)
+    out = {"enabled": True, "schema_version": SCHEMA_VERSION,
+           "probe_verdict": probe_verdict(), "peaks": pk, "lanes": {}}
+    for lane in list(lanes()):
+        try:
+            row = attribution(lane, pk)
+        except Exception:  # noqa: BLE001 — one broken lane must not
+            continue       # take down the scrape
+        if row is None:
+            continue
+        out["lanes"][lane] = row
+        _publish_gauges(lane, row)
+    # worst offenders: observed lanes by achieved utilization against
+    # their binding roof, ascending — the report's first read
+    def util(r):
+        return r.get("mbu" if r.get("roofline_bound") == "bandwidth"
+                     else "mfu") or 0.0
+
+    out["worst"] = sorted(out["lanes"],
+                          key=lambda k: util(out["lanes"][k]))
+    _last_summary = out
+    return out
+
+
+def cached_summary():
+    """The last computed :func:`summary` (no probes, no AOT work) —
+    telemetry.snapshot embeds this so report tooling sees the roofline
+    without triggering compilation from a scrape path."""
+    return _last_summary

@@ -71,6 +71,7 @@ import numpy as np
 from ... import analysis
 from ... import health
 from ... import memory
+from ... import observatory
 from ... import telemetry
 from ... import tracing
 from ...base import MXNetError, getenv, register_env
@@ -901,6 +902,8 @@ class GenerationEngine:
         failure fails the live sessions (never-strand, the batcher's
         guard) and reallocates the possibly-donated slab."""
         tele = telemetry._enabled
+        obs = observatory._enabled
+        decoded = False
         t0 = time.perf_counter()
         # the tick's own span tree (admit/decode children via the context
         # var; per-SESSION spans keep their explicit session parents) —
@@ -920,6 +923,7 @@ class GenerationEngine:
                             f"session deadline passed after "
                             f"{sess.generated} generated token(s)"))
                 self._admit()
+                decoded = self._live > 0
                 self._decode()
                 if len(self._param_sets) > 1:
                     # a swap transition is draining: release versions
@@ -961,6 +965,16 @@ class GenerationEngine:
             self._beacon.touch()
             if not self._has_work():
                 self._beacon.idle()
+        if obs and decoded:
+            # a decode (or verify) actually swept the slab this tick:
+            # the tick wall against THE decode executable's bytes is the
+            # per-tick MBU — the honest decode metric (arXiv:2603.09555),
+            # bandwidth-bound by construction at steady state
+            key = (("verify", self._spec_k, self._slots, self._slab_len)
+                   if self._spec_k else
+                   ("decode", self._slots, self._slab_len))
+            observatory.observe("generation.tick", self._cache, key,
+                                wall_s=time.perf_counter() - t0)
         if tele:
             dt = time.perf_counter() - t0
             telemetry.counter("serving.generation.ticks").inc()

@@ -42,6 +42,7 @@ import numpy as np
 from .. import analysis
 from .. import memory
 from .. import ndarray as nd
+from .. import observatory
 from .. import telemetry
 from .. import tracing
 from ..base import MXNetError, getenv, register_env
@@ -368,16 +369,22 @@ class Predictor:
             padded, _ = pad_arrays(list(arrays), bucket)
         feed = dict(zip(self._data_names, padded))
         tele = telemetry._enabled
-        t0 = time.perf_counter() if tele else 0.0
+        obs = observatory._enabled
+        t0 = time.perf_counter() if tele or obs else 0.0
         with self._lock, tracing.span("serving.forward", cat="serving",
                                       bucket=bucket):
             outs = list(exec_.forward(is_train=False, **feed))
             jax.block_until_ready([o._data for o in outs])
         # in-flight batch residency: weak refs, swept as batches retire
         memory.track_transient("serving_batches", padded + outs)
+        dt = time.perf_counter() - t0 if tele or obs else 0.0
         if tele:
-            telemetry.histogram("serving.compute_us").record(
-                (time.perf_counter() - t0) * 1e6)
+            telemetry.histogram("serving.compute_us").record(dt * 1e6)
+        if obs:
+            # block_until_ready above makes dt an honest device window;
+            # the executor recorded which compiled entry this forward hit
+            observatory.observe("serving", self._cache, exec_._last_fwd_key,
+                                wall_s=dt, exec_s=dt)
         return outs
 
     # -- weight rollout ------------------------------------------------------

@@ -15,12 +15,14 @@ is_train=True)` so backward never re-runs the forward.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as _np
 
 import jax
 import jax.numpy as jnp
 
-from .. import tracing
+from .. import observatory, tracing
 from ..base import MXNetError
 from ..compile_cache import CompileCache
 from ..ops import registry as _reg
@@ -155,6 +157,7 @@ class Executor:
         self._monitor_callback = None
 
         self._fns = {}
+        self._last_fwd_key = None
         # every compiled executable this executor holds, keyed by full shape
         # signature — shape churn (bucketing, unpadded partial batches) shows
         # up as compile.cache_misses instead of silently re-specializing.
@@ -286,6 +289,9 @@ class Executor:
         else:
             outputs, aux_new = self._jit_fwd(bool(is_train), sig)(key, args, auxs)
             self._vjp = None
+            # which compiled entry this forward ran — the serving plane's
+            # roofline attribution reads it back (observatory.observe)
+            self._last_fwd_key = ("fwd", bool(is_train), sig)
 
         if is_train:
             # aux write-back (moving stats) — reference mutable aux NDArrays
@@ -573,12 +579,23 @@ class Executor:
             # donation silently degrades to a copy
             put = pipeline.put_replicated
             call_args = [jax.tree_util.tree_map(put, a) for a in call_args]
+        obs = observatory._enabled
+        t_obs = time.perf_counter() if obs else 0.0
         try:
             with tracing.span("fused.dispatch", cat="train",
                               params=len(names),
                               zero1=zero1 is not None,
                               pipeline=pipeline is not None):
                 outputs, new_ws, new_ss, aux_new = fn(*call_args)
+            if obs:
+                # device-busy window for the roofline's host-gap: drain
+                # the step here (the fit loop would block moments later
+                # in update_metric anyway) and name the executable that
+                # ran so attribution can pull its FLOPs/bytes lazily
+                jax.block_until_ready(outputs)
+                observatory.observe("step", cache,
+                                    ("fused_step", sig),
+                                    exec_s=time.perf_counter() - t_obs)
         except Exception as e:
             donated = [w._data for w in weights]
             if zero1 is not None:

@@ -12,9 +12,12 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "..")
 
 
 @pytest.mark.slow
-def test_bench_emits_json_on_cpu():
+def test_bench_emits_json_on_cpu(tmp_path):
     env = dict(os.environ)
-    env.update(JAX_PLATFORMS="cpu", BENCH_FORCE_CPU="1", BENCH_ITERS="1")
+    env.update(JAX_PLATFORMS="cpu", BENCH_FORCE_CPU="1", BENCH_ITERS="1",
+               # keep the committed repo-root ledger clean: the run still
+               # exercises the append path, just into a scratch file
+               MXNET_PERF_LEDGER=str(tmp_path / "ledger.jsonl"))
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, timeout=900, env=env, cwd=REPO,

@@ -149,8 +149,10 @@ def test_slo_spec_parsing():
     assert objs[4].threshold == 1.5e6          # s -> us
     assert objs[2].rel_stat == "p50" and objs[2].threshold == 8.0
     assert objs[3].stat == "value" and objs[3].op == ">="
-    # defaults exist and parse
-    assert len(health.parse_spec("")) == 4
+    # defaults exist and parse (incl. the roofline + headroom rows)
+    assert len(health.parse_spec("")) == 6
+    keys = [o.metric for o in health.parse_spec("")]
+    assert "step.mfu" in keys and "memory.headroom_bytes" in keys
     for bad in ("nocolon", "m:p99<<1", "m:p99<abc", "m:weird<1"):
         with pytest.raises(ValueError):
             health.parse_spec(bad)

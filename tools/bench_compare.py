@@ -58,6 +58,48 @@ METRICS = [
     ("framework_module_compile_s", "module compile s", "down"),
 ]
 
+# roofline utilisation rows (bench.py stamps them per lane from the
+# observatory's attribution against MEASURED peaks): a drop past
+# ROOFLINE_HARD_THRESHOLD is a hard regression regardless of --threshold,
+# same standing as steady_state_compiles > 0 — utilisation against the
+# machine's own measured roof is workload- and hardware-normalised, so a
+# fall means the framework started leaving the chip idle.
+ROOFLINE_METRICS = [
+    ("mfu", "train step MFU", "up"),
+    ("mbu", "train step MBU", "up"),
+    ("serving.mfu", "serving MFU", "up"),
+    ("serving.mbu", "serving MBU", "up"),
+    ("generation.tick_mbu", "generation decode-tick MBU", "up"),
+    ("generation.mfu", "generation decode-tick MFU", "up"),
+    ("spmd.mfu", "spmd step MFU", "up"),
+    ("spmd.mbu", "spmd step MBU", "up"),
+]
+ROOFLINE_HARD_THRESHOLD = 0.10
+
+
+def compare_roofline(old, new, write):
+    """Direction-aware MFU/MBU rows; returns the hard-regression list.
+    Rows appear only when BOTH records carry the lane (pre-observatory
+    baselines have none, so history stays comparable)."""
+    regressions = []
+    for path, label, direction in ROOFLINE_METRICS:
+        o, n = get(old, path), get(new, path)
+        if o is None or n is None:
+            continue
+        delta = 0.0 if o == 0 and n == 0 else \
+            (n - o) / abs(o) if o else float("inf")
+        worse = -delta if direction == "up" else delta
+        bad = worse > ROOFLINE_HARD_THRESHOLD
+        verdict = "REGRESSION (hard)" if bad else (
+            "improved" if (delta > 0) == (direction == "up") and delta != 0
+            else "ok")
+        write(f"{label:<34}{o:>12.4f}{n:>12.4f}"
+              f"{delta * 100:>8.1f}%  {verdict}\n")
+        if bad:
+            regressions.append((label, o, n, delta))
+    return regressions
+
+
 # hlolint collective inventories (bench.py stamps them per lane as
 # {"mesh": "<spec>", "collective_bytes": N, "collectives": {...}}): bytes
 # moved per step by cross-device collectives, from the COMPILED program.
@@ -193,6 +235,7 @@ def main(argv=None):
             regressions.append((label, o, n, delta))
         sys.stdout.write(f"{label:<34}{o:>12.3f}{n:>12.3f}"
                          f"{delta * 100:>8.1f}%  {verdict}\n")
+    regressions.extend(compare_roofline(old, new, sys.stdout.write))
     regressions.extend(compare_hlolint(old, new, sys.stdout.write))
     for path, label in INVARIANTS:
         n = get(new, path)
