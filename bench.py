@@ -859,6 +859,133 @@ def _measure_lazy(on_tpu):
     return out
 
 
+def _measure_lazy_fused(on_tpu):
+    """Rewrite-on vs rewrite-off on a fusion-friendly lazy chain — the
+    lane that isolates what lazy/rewrite.py itself buys, holding the
+    capture machinery constant (MXNET_LAZY=1 in BOTH modes, only
+    MXNET_LAZY_REWRITE flips). The chain is built so every default rule
+    family fires: dense+bias+relu per layer (dense_bias_act), an
+    add-of-zeros_like (identity), duplicated MATERIALIZED sum(tanh(abs))
+    branches (CSE halves live output buffers AND host wrap cost — XLA
+    CSEs the compute but must keep both output buffers; map_reduce then
+    merges the surviving chain). Stamps the rewrite-off/on wall ratio and
+    the node shrink ratio, asserts steady_state_compiles == 0 in both
+    modes and EXACT compile accounting: one compile per signature per
+    mode (rewritten keys never collide with unrewritten), zero on warm
+    replay. All four rules here are bit-parity rules, so the two modes
+    must agree bit-for-bit. On a host-dispatch-bound CPU run the steady
+    wall ratio sits near 1.0 (recording dominates and is identical by
+    design) — the deterministic rewrite win there is compile_speedup
+    (smaller program through XLA) and shrink_ratio; on TPU the smaller
+    replay program is also the faster one."""
+    import numpy as np
+
+    from mxnet_tpu import compile_cache, nd, telemetry
+
+    layers, width, batch = 6, 128, 16
+    rng = np.random.RandomState(0)
+    ws = [nd.array(rng.uniform(-0.2, 0.2, (width, width)).astype(np.float32))
+          for _ in range(layers)]
+    bs = [nd.array(rng.uniform(-0.1, 0.1, (width,)).astype(np.float32))
+          for _ in range(layers)]
+    x = nd.array(rng.uniform(-1, 1, (batch, width)).astype(np.float32))
+
+    def step():
+        h = x
+        for w, b in zip(ws, bs):
+            h = nd.relu(nd.dot(h, w) + b)  # dense_bias_act collapses these
+        h = h + nd.zeros_like(h)           # identity rule eliminates
+        y1 = nd.sum(nd.tanh(nd.abs(h)))    # map_reduce merges the chain
+        y2 = nd.sum(nd.tanh(nd.abs(h)))    # CSE dedups the duplicate
+        return float(y1.asnumpy()) + float(y2.asnumpy())
+
+    iters = max(30, int(os.environ.get("BENCH_ITERS", "3")) * 10)
+    prev = {k: os.environ.get(k) for k in ("MXNET_LAZY",
+                                           "MXNET_LAZY_REWRITE")}
+    out = {"basis": "lazy_fused_chain_fp32 (rewrite-on vs rewrite-off, "
+                    "MXNET_LAZY=1 both)",
+           "layers": layers, "width": width, "batch": batch, "iters": iters}
+    try:
+        def timed_window():
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    step()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        os.environ["MXNET_LAZY"] = "1"
+
+        def mode(rewrite_on):
+            os.environ["MXNET_LAZY_REWRITE"] = "1" if rewrite_on else "0"
+            cold0 = compile_cache.named_stats("lazy")
+            pre0 = telemetry.counter("lazy.rewrite.nodes_pre").value
+            post0 = telemetry.counter("lazy.rewrite.nodes_post").value
+            t0 = time.perf_counter()
+            val = step(); step()  # cold: this mode's signatures compile
+            cold_s = time.perf_counter() - t0
+            warm0 = compile_cache.named_stats("lazy")
+            wall = timed_window()
+            warm1 = compile_cache.named_stats("lazy")
+            steady = warm1["misses"] - warm0["misses"]
+            assert steady == 0, (
+                f"lazy_fused rewrite={rewrite_on} steady state compiled "
+                f"{steady} programs")
+            return {"val": val, "wall_s": wall,
+                    "cold_wall_s": round(cold_s, 3),
+                    "cold_compile_s": round(
+                        warm0["compile_seconds"] - cold0["compile_seconds"],
+                        3),
+                    "segment_compiles": warm0["misses"] - cold0["misses"],
+                    "nodes_pre":
+                        telemetry.counter("lazy.rewrite.nodes_pre").value
+                        - pre0,
+                    "nodes_post":
+                        telemetry.counter("lazy.rewrite.nodes_post").value
+                        - post0}
+
+        off = mode(False)
+        on = mode(True)
+        if on["val"] != off["val"]:  # bit-parity rules only in this chain
+            raise RuntimeError(
+                f"lazy_fused rewrite parity broke: {on['val']} vs "
+                f"{off['val']}")
+        # exact accounting: each mode cold-compiles its own signature
+        # once (rewritten keys are disjoint from unrewritten), warm
+        # replays compile nothing
+        assert off["segment_compiles"] == 1 and on["segment_compiles"] == 1, \
+            (off["segment_compiles"], on["segment_compiles"])
+        shrink = 0.0
+        if on["nodes_pre"] > 0:
+            shrink = (on["nodes_pre"] - on["nodes_post"]) / on["nodes_pre"]
+        assert shrink > 0, \
+            f"rewriter eliminated nothing on the fusion-friendly chain"
+        out.update(
+            rewrite_off_steps_per_s=round(
+                iters / max(off["wall_s"], 1e-9), 1),
+            rewrite_on_steps_per_s=round(iters / max(on["wall_s"], 1e-9), 1),
+            rewrite_speedup=round(off["wall_s"] / max(on["wall_s"], 1e-9),
+                                  3),
+            compile_speedup=round(
+                off["cold_compile_s"] / max(on["cold_compile_s"], 1e-9), 3),
+            shrink_ratio=round(shrink, 3),
+            nodes_pre=on["nodes_pre"], nodes_post=on["nodes_post"],
+            cold_compile_s_off=off["cold_compile_s"],
+            cold_compile_s_on=on["cold_compile_s"],
+            segment_compiles=on["segment_compiles"]
+            + off["segment_compiles"],
+            steady_state_compiles=0,
+        )
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def _measure_spmd(on_tpu):
     """spmd lane: the GSPMD-sharded fused step (MXNET_SPMD,
     parallel/spmd.py) vs the replicated one on a small all-divisible MLP.
@@ -1570,6 +1697,16 @@ def main():
                 result["lazy"] = _measure_lazy(on_tpu)
         except Exception:  # noqa: BLE001
             result["lazy_error"] = \
+                traceback.format_exc(limit=3).strip().splitlines()[-1]
+        try:
+            # the rewrite plane: same capture machinery, only
+            # MXNET_LAZY_REWRITE flips — isolates the lazy/rewrite.py win
+            # (node shrink + merged outputs) with exact compile
+            # accounting in both modes
+            with _phase_scope("lazy_fused"):
+                result["lazy_fused"] = _measure_lazy_fused(on_tpu)
+        except Exception:  # noqa: BLE001
+            result["lazy_fused_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
         try:
             # the spmd plane: GSPMD-sharded fused step (MXNET_SPMD) vs

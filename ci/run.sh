@@ -168,6 +168,23 @@ unit() {
   log "lazy suite (deferred capture parity, barrier sweep, zero-steady-state compiles, fit+Monitor e2e)"
   env MXNET_HLOLINT_DUMP="$hlolint_dump" \
       python -m pytest tests/python/unittest/test_lazy.py -q
+  # rewrite gate, standalone: per-rule bit/ulp parity vs the unrewritten
+  # replay, the randomized 50-chain differential sweep, autograd through
+  # rewritten forwards, EXACT post-rewrite-signature compile accounting
+  # (one compile per rewritten signature, zero warm), per-rule disable
+  # gates and the tp=1 zero-collectives pin (hlolint 'lazy' contract on
+  # a live dump) — a rule, keying or fallback regression fails HERE,
+  # attributed
+  log "lazy rewrite gate (rule parity, differential sweep, post-rewrite cache keying, tp=1 zero collectives)"
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_lazy_rewrite.py -q
+  # the full lazy suite again with the rewriter FORCED on: every barrier,
+  # autograd and accounting invariant must hold identically over
+  # rewritten programs (the rewrite defaults on, but this pins the
+  # combination even if the default ever flips)
+  log "lazy suite rerun (MXNET_LAZY_REWRITE=1 forced over every capture invariant)"
+  env MXNET_LAZY_REWRITE=1 MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_lazy.py -q
   # health gate, standalone: these tests flip the process-global health/
   # telemetry/tracing state, spin engine scheduler threads and the
   # telemetry HTTP endpoint, and drive deterministic watchdog sweeps
@@ -223,13 +240,14 @@ unit() {
   # fails the run on ANY lock-order inversion or blocking hazard the
   # suites drove, with both stacks printed — the dynamic complement of
   # the static tpulint gate (the PR 10 / PR 12 deadlock classes)
-  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/rollout/lazy/elastic)"
+  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/rollout/lazy/rewrite/elastic)"
   env MXNET_DEBUG_SYNC=1 python -m pytest \
       tests/python/unittest/test_serving.py \
       tests/python/unittest/test_generation.py \
       tests/python/unittest/test_generation_scale.py \
       tests/python/unittest/test_rollout.py \
       tests/python/unittest/test_lazy.py \
+      tests/python/unittest/test_lazy_rewrite.py \
       tests/python/unittest/test_elastic.py -q
 }
 

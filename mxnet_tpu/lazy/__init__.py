@@ -23,6 +23,16 @@ register_env("MXNET_LAZY", False,
              "segments compiled as ONE fused XLA program per "
              "materialization barrier (default off; per-op eager is the "
              "bit-parity reference)")
+register_env("MXNET_LAZY_REWRITE", 1,
+             "graph-rewrite the captured segment before the flush compile "
+             "(lazy/rewrite.py: identity elimination, CSE, dense/conv "
+             "fusion, map-reduce merge, spmd constraint injection); "
+             "active only under MXNET_LAZY; rewritten programs key the "
+             "cache by their post-rewrite signature")
+register_env("MXNET_LAZY_REWRITE_DISABLE", "",
+             "comma-separated rewrite rule names to turn off individually "
+             "(identity, cse, dense_bias_act, conv_bn_relu, map_reduce, "
+             "spmd_constraint) while keeping the rest")
 register_env("MXNET_LAZY_MAX_OPS", 256,
              "flush a lazy segment when it reaches this many recorded ops "
              "(bounds host memory and compile size)")

@@ -506,14 +506,41 @@ class LazyGraph:
             while len(self._seen_sigs) > bound:
                 self._seen_sigs.popitem(last=False)
 
-        def build():
-            return jax.jit(_make_replay(specs, out_spec))
+        # graph rewrite (lazy/rewrite.py): pattern->replacement passes on
+        # the renumbered signature, AFTER liveness DCE, BEFORE the compile.
+        # The plan is memoized per (sig, config), so a warm flush pays one
+        # dict hit; a rewritten segment keys the cache by its POST-rewrite
+        # signature (plan.cache_key()) so rewritten and unrewritten
+        # programs never collide. Churn hysteresis stays keyed on the
+        # PRE-rewrite sig (capture-shape polymorphism is what it tracks).
+        plan = None
+        try:
+            from . import rewrite as _rewrite
 
-        args = [leaves[li] for li in leaf_order]
+            if _rewrite.enabled():
+                plan = _rewrite.plan_for(sig)
+            if plan is not None:
+                _rewrite.note_applied(plan)
+        except Exception:  # noqa: BLE001 — a rewriter bug must degrade
+            #               to the unrewritten (always-correct) program
+            telemetry.counter("lazy.rewrite.plan_errors").inc()
+            plan = None
+        if plan is not None:
+            key = plan.cache_key()
+            r_specs, r_out = plan.specs, plan.out_spec
+            args = [leaves[leaf_order[j]] for j in plan.leaf_sel]
+        else:
+            key, r_specs, r_out = sig, specs, out_spec
+            args = [leaves[li] for li in leaf_order]
+
+        def build():
+            return jax.jit(_make_replay(r_specs, r_out))
+
         try:
             with tracing.span("lazy.flush", cat="lazy", reason=reason,
-                              ops=len(kept), outputs=len(out_slots)):
-                fn = cache.get_or_build(sig, build)
+                              ops=len(kept), outputs=len(out_slots),
+                              rewritten=plan is not None):
+                fn = cache.get_or_build(key, build)
                 outs = fn(*args)
         except Exception:  # noqa: BLE001 — degrade to slow, never wrong
             telemetry.counter("lazy.flush_errors").inc()
@@ -596,7 +623,10 @@ def _make_replay(specs, out_spec):
     the exact content the cache key hashes, so a cache hit built from a
     different (but sig-identical) graph replays the same computation.
     Inputs address leaves by their renumbered first-use position and
-    producer outputs as (kept-node index, flat output index)."""
+    producer outputs as (kept-node index, flat output index). Rewritten
+    segments (lazy/rewrite.py) may additionally route an OUTPUT straight
+    to a leaf — ("l", idx) — when identity elimination reduced it to a
+    passthrough of an input."""
     from ..ops.registry import _OPS
 
     steps = []
@@ -631,7 +661,8 @@ def _make_replay(specs, out_spec):
                     f"recorded {n_flat} (abstract/concrete trace mismatch)")
             for i, v in enumerate(flat):
                 env[(k, i)] = v
-        return tuple(env[s] for s in out_list)
+        return tuple(leaf_vals[s[1]] if s[0] == "l" else env[s]
+                     for s in out_list)
 
     return replay
 

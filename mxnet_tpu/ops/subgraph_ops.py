@@ -11,6 +11,14 @@ conv+bn+activation fusion role, `subgraph/mkldnn/mkldnn_conv.cc`):
 BatchNorm folds into the convolution weights at run time, then ReLU —
 one MXU conv instead of conv + 5 elementwise passes. Inference-only
 (uses the moving statistics, like the reference's deployment fusions).
+
+`_rw_*` — replacement nodes emitted by the lazy segment rewriter
+(`mxnet_tpu/lazy/rewrite.py`): each re-invokes the SAME registered op
+fns the pattern it replaced would have, so the jitted trace — and
+therefore the numerics — are bit-identical to the unrewritten segment;
+the win is fewer replay nodes, merged live outputs and smaller
+programs. `_rw_sharding_constraint` is the sharding-aware rewrite's
+layout annotation (a pure identity on values).
 """
 from __future__ import annotations
 
@@ -51,3 +59,43 @@ def _fused_conv_bn_relu(data, weight, bias, gamma, beta, moving_mean,
                     num_group=num_group, no_bias=False, layout=layout)
     out = conv(data, w, b)
     return jax.nn.relu(out) if parse_bool(with_relu) else out
+
+
+@register("_rw_dense_bias_act")
+def _rw_dense_bias_act(x, w, b, transpose_a=False, transpose_b=False,
+                       act="relu", **kw):
+    """dense+bias+activation collapse target: literally re-invokes the
+    dot / broadcast_add / Activation fns the rewriter matched, so the
+    fused trace is the unfused trace (bit parity by construction)."""
+    from .registry import _OPS
+
+    out = _OPS["dot"].fn(x, w, transpose_a=transpose_a,
+                         transpose_b=transpose_b)
+    out = _OPS["broadcast_add"].fn(out, b)
+    return _OPS["Activation"].fn(out, act_type=act) if act else out
+
+
+@register("_rw_map_reduce")
+def _rw_map_reduce(x, steps="", reduce_op="sum", reduce_attrs=(), **kw):
+    """elementwise-chain-into-reduction merge target: applies the
+    recorded unary fns in order, then the recorded reduction with its
+    original attrs — same fns, same trace, one replay node."""
+    from .registry import _OPS
+
+    for name in str(steps).split(","):
+        if name:
+            x = _OPS[name].fn(x)
+    return _OPS[reduce_op].fn(x, **dict(reduce_attrs))
+
+
+@register("_rw_sharding_constraint")
+def _rw_sharding_constraint(x, mesh=None, spec=(), **kw):
+    """GSPMD layout annotation at a segment leaf (values pass through
+    untouched). The mesh rides in as a static attr — no env reads inside
+    the traced fn (the tpulint tracer-hygiene rule); on a trivial mesh
+    this lowers to zero collectives (the hlolint 'lazy' contract pin)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.collectives import sharding_constraint
+
+    return sharding_constraint(x, NamedSharding(mesh, PartitionSpec(*spec)))

@@ -71,12 +71,9 @@ class ConvBNReLUProperty(SubgraphProperty):
             bias = _apply_op("_zeros",
                              shape=(int(conv.attrs.get("num_filter", 0)),),
                              dtype="float32")
-        attrs = {k: v for k, v in conv.attrs.items()
-                 if k in ("kernel", "stride", "dilate", "pad", "num_filter",
-                          "num_group", "layout")}
-        attrs["eps"] = bn.attrs.get("eps", 1e-3)
-        attrs["fix_gamma"] = bn.attrs.get("fix_gamma", True)
-        attrs["with_relu"] = act is not None
+        from ..lazy.rewrite import fused_conv_bn_attrs
+
+        attrs = fused_conv_bn_attrs(conv.attrs, bn.attrs, act is not None)
         return _apply_op(
             "_fused_conv_bn_relu", data, weight, bias, gamma, beta, mean,
             variance, name=f"fused_conv{subgraph_id}", **attrs)

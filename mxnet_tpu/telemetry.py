@@ -367,6 +367,19 @@ def snapshot():
         # lazy-segment telemetry")
         out["derived"]["lazy.mean_ops_per_segment"] = \
             out["counters"].get("lazy.ops_captured", 0) / segs
+    rseg = out["counters"].get("lazy.rewrite.segments", 0)
+    if rseg > 0:
+        # pre- AND post-rewrite node counts per rewritten segment: post
+        # alone would read as "capture got worse" next to
+        # mean_ops_per_segment; shrink_ratio is the fraction of replay
+        # nodes the rewriter removed (docs/faq/perf.md "Reading rewrite
+        # telemetry")
+        pre = out["counters"].get("lazy.rewrite.nodes_pre", 0)
+        post = out["counters"].get("lazy.rewrite.nodes_post", 0)
+        out["derived"]["lazy.rewrite.mean_ops_pre"] = pre / rseg
+        out["derived"]["lazy.rewrite.mean_ops_post"] = post / rseg
+        if pre > 0:
+            out["derived"]["lazy.rewrite.shrink_ratio"] = (pre - post) / pre
     try:
         from . import compile_cache as _cc
 

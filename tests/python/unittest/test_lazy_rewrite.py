@@ -1,0 +1,541 @@
+"""Lazy segment rewriter (mxnet_tpu/lazy/rewrite.py, MXNET_LAZY_REWRITE).
+
+The correctness harness ISSUE 18 demands:
+
+* per-rule parity — every shipped rule fires on a chain built for it and
+  the rewritten segment is BIT-EXACT vs the unrewritten replay (the
+  conv+bn fold is the one documented-ulp exception, the PR 6 FMA / serving
+  TPU_FUSE precedent: BN folds into the conv weights, so the contraction
+  order changes);
+* a randomized 50-chain differential sweep rewrite-on vs rewrite-off;
+* autograd parity THROUGH rewritten segments — vjp nodes recorded inside
+  the segment consume the rewritten forward's values;
+* exact CompileCache("lazy") accounting — one compile per rewritten
+  signature, zero on warm replay, and rewritten keys never collide with
+  the unrewritten signature of the same chain;
+* per-rule disable gates (MXNET_LAZY_REWRITE_DISABLE) and the global
+  MXNET_LAZY_REWRITE=0 kill switch;
+* sharding-aware injection — under MXNET_SPMD="tp=1" the constraint
+  rule annotates segment leaves and the compiled program lowers to ZERO
+  collectives (pinned through the hlolint 'lazy' contract on a real
+  MXNET_HLOLINT_DUMP);
+* telemetry — lazy.rewrite.* counters, the pre/post derived metrics and
+  the tools/telemetry_report.py "rewrite:" line.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, compile_cache, nd, telemetry
+from mxnet_tpu.lazy import graph as lazy_graph
+from mxnet_tpu.lazy import rewrite
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "..", "..", ".."))
+
+
+def _fresh_graph():
+    lazy_graph._tls.graph = None
+    lazy_graph.graph_for_thread()
+
+
+def _counters(prefix="lazy.rewrite."):
+    snap = telemetry.snapshot()
+    return {k: v for k, v in snap["counters"].items() if k.startswith(prefix)}
+
+
+def _run(fn, rewrite_on, disable="", seed=11):
+    """Run ``fn`` under MXNET_LAZY=1 with the rewriter on/off; returns
+    (outputs-as-numpy, lazy.rewrite.* counter deltas)."""
+    prev = {k: os.environ.get(k)
+            for k in ("MXNET_LAZY", "MXNET_LAZY_REWRITE",
+                      "MXNET_LAZY_REWRITE_DISABLE")}
+    os.environ["MXNET_LAZY"] = "1"
+    os.environ["MXNET_LAZY_REWRITE"] = "1" if rewrite_on else "0"
+    os.environ["MXNET_LAZY_REWRITE_DISABLE"] = disable
+    before = _counters()
+    try:
+        _fresh_graph()
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        outs = fn()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        outs = [o.asnumpy() if hasattr(o, "asnumpy") else np.asarray(o)
+                for o in outs]
+        nd.waitall()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    after = _counters()
+    delta = {k: after.get(k, 0) - before.get(k, 0) for k in after
+             if after.get(k, 0) != before.get(k, 0)}
+    return outs, delta
+
+
+def _applied(delta, rule):
+    return delta.get(f"lazy.rewrite.rules_applied.{rule}", 0)
+
+
+def _assert_bit_equal(on, off):
+    assert len(on) == len(off)
+    for a, b in zip(on, off):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# per-rule parity: each rule fires on its chain and matches the
+# unrewritten replay
+# ---------------------------------------------------------------------------
+
+
+def _x(shape, seed=0, lo=-1.0, hi=1.0):
+    rng = np.random.RandomState(seed)
+    return nd.array(rng.uniform(lo, hi, shape).astype(np.float32))
+
+
+def test_identity_rules_bit_exact():
+    x = _x((4, 5))
+
+    def chain():
+        h = x + nd.zeros_like(x)            # add-of-zeros
+        h = h * nd.ones_like(h)             # mul-by-one
+        h = -(-h)                           # double negation
+        h = nd.transpose(nd.transpose(h))   # transpose-of-transpose
+        h = h + 0.0                         # _plus_scalar 0
+        h = h * 1.0                         # _mul_scalar 1
+        return h
+
+    on, d = _run(chain, True)
+    off, _ = _run(chain, False)
+    assert _applied(d, "identity") >= 5, d
+    _assert_bit_equal(on, off)
+
+
+def test_cse_bit_exact():
+    x = _x((6, 6))
+
+    def chain():
+        y1 = nd.sum(nd.exp(x * 2.0))
+        y2 = nd.sum(nd.exp(x * 2.0))  # identical chain — CSE dedups
+        return y1, y2
+
+    on, d = _run(chain, True)
+    off, _ = _run(chain, False)
+    assert _applied(d, "cse") >= 1, d
+    _assert_bit_equal(on, off)
+    np.testing.assert_array_equal(on[0], on[1])
+
+
+def test_dense_bias_act_bit_exact():
+    x, w, b = _x((4, 8)), _x((8, 8), 1), _x((8,), 2)
+
+    def chain():
+        return nd.relu(nd.dot(x, w) + b)
+
+    on, d = _run(chain, True)
+    off, _ = _run(chain, False)
+    assert _applied(d, "dense_bias_act") == 1, d
+    _assert_bit_equal(on, off)
+
+
+def test_map_reduce_bit_exact():
+    x = _x((5, 7))
+
+    def chain():
+        return nd.sum(nd.tanh(nd.abs(x)))
+
+    on, d = _run(chain, True)
+    off, _ = _run(chain, False)
+    assert _applied(d, "map_reduce") == 1, d
+    _assert_bit_equal(on, off)
+
+
+def test_conv_bn_relu_documented_ulp():
+    """The conv+bn fold changes the contraction order (BN scale folds
+    into the conv weights — exactly the serving TPU_FUSE transform), so
+    the contract is documented-ulp, not bit parity."""
+    data = _x((2, 4, 8, 8))
+    wt, bi = _x((6, 4, 3, 3), 1, -0.3, 0.3), _x((6,), 2, -0.1, 0.1)
+    gamma, beta = _x((6,), 3, 0.5, 1.5), _x((6,), 4, -0.2, 0.2)
+    mm, mv = _x((6,), 5, -0.1, 0.1), _x((6,), 6, 0.5, 1.5)
+
+    def chain():
+        return nd.relu(nd.BatchNorm(
+            nd.Convolution(data, wt, bi, kernel=(3, 3), num_filter=6,
+                           pad=(1, 1)),
+            gamma, beta, mm, mv, fix_gamma=False, use_global_stats=True))
+
+    on, d = _run(chain, True)
+    off, _ = _run(chain, False)
+    assert _applied(d, "conv_bn_relu") == 1, d
+    assert rewrite.RULES["conv_bn_relu"].parity == "ulp"
+    np.testing.assert_allclose(on[0], off[0], rtol=1e-5, atol=1e-5)
+
+
+def test_conv_output_also_live_blocks_fusion():
+    """When the conv output escapes the fused pattern (a live segment
+    output), the rule must refuse — fusing could not eliminate the conv."""
+    data = _x((2, 4, 8, 8))
+    wt = _x((6, 4, 3, 3), 1, -0.3, 0.3)
+    gamma, beta = _x((6,), 3, 0.5, 1.5), _x((6,), 4, -0.2, 0.2)
+    mm, mv = _x((6,), 5, -0.1, 0.1), _x((6,), 6, 0.5, 1.5)
+
+    def chain():
+        c = nd.Convolution(data, wt, kernel=(3, 3), num_filter=6,
+                           pad=(1, 1), no_bias=True)
+        r = nd.relu(nd.BatchNorm(c, gamma, beta, mm, mv, fix_gamma=False,
+                                 use_global_stats=True))
+        return c, r
+
+    on, d = _run(chain, True)
+    off, _ = _run(chain, False)
+    assert _applied(d, "conv_bn_relu") == 0, d
+    _assert_bit_equal(on, off)
+
+
+# ---------------------------------------------------------------------------
+# randomized 50-chain differential sweep
+# ---------------------------------------------------------------------------
+
+
+def _random_chain(rng):
+    """A random fusion-friendly imperative chain mixing every rule
+    family's trigger shapes with plain ops."""
+    width = int(rng.choice([4, 8, 16]))
+    x = nd.array(rng.uniform(-1, 1, (3, width)).astype(np.float32))
+    w = nd.array(rng.uniform(-0.5, 0.5, (width, width)).astype(np.float32))
+    b = nd.array(rng.uniform(-0.2, 0.2, (width,)).astype(np.float32))
+    h = x
+    outs = []
+    for _ in range(int(rng.randint(2, 6))):
+        pick = int(rng.randint(6))
+        if pick == 0:
+            h = nd.relu(nd.dot(h, w) + b)
+        elif pick == 1:
+            h = h + nd.zeros_like(h)
+        elif pick == 2:
+            h = nd.transpose(nd.transpose(h))
+        elif pick == 3:
+            outs.append(nd.sum(nd.tanh(nd.abs(h))))
+        elif pick == 4:
+            outs.append(nd.mean(nd.exp(h * 0.5)))
+            outs.append(nd.mean(nd.exp(h * 0.5)))  # CSE fodder
+        else:
+            h = -(-(h * 1.0))
+    outs.append(h)
+    return outs
+
+
+@pytest.mark.parametrize("case", range(50))
+def test_differential_sweep_bit_exact(case):
+    def chain():
+        return _random_chain(np.random.RandomState(1000 + case))
+
+    on, _ = _run(chain, True, seed=case)
+    off, _ = _run(chain, False, seed=case)
+    _assert_bit_equal(on, off)
+
+
+# ---------------------------------------------------------------------------
+# autograd: vjp recorded inside the segment sees the rewritten forward
+# ---------------------------------------------------------------------------
+
+
+def test_autograd_parity_through_rewritten_forward():
+    """Ops recorded under autograd capture as kind='vjp' (fused
+    forward+residual nodes) and are NEVER rewritten themselves — but the
+    op-kind forward PREFIX feeding the tape is, so the vjp nodes must
+    consume the rewritten forward's values and the grads must match the
+    unrewritten replay bit-for-bit."""
+    xv = np.random.RandomState(3).uniform(-1, 1, (4, 8)).astype(np.float32)
+    wv = np.random.RandomState(4).uniform(-0.5, 0.5, (8, 8)).astype(
+        np.float32)
+    bv = np.random.RandomState(5).uniform(-0.2, 0.2, (8,)).astype(np.float32)
+
+    def grads():
+        x, w, b = nd.array(xv), nd.array(wv), nd.array(bv)
+        # op-kind prefix the identity rule rewrites away; the tape's vjp
+        # nodes then read the rewritten value
+        x2 = x + nd.zeros_like(x)
+        for a in (x2, w, b):
+            a.attach_grad()
+        with autograd.record():
+            h = nd.relu(nd.dot(x2, w) + b)
+            loss = nd.sum(h)
+        loss.backward()
+        return x2.grad, w.grad, b.grad, loss
+
+    on, d_on = _run(grads, True)
+    off, _ = _run(grads, False)
+    _assert_bit_equal(on, off)
+    # the forward prefix was rewritten even though vjp nodes never are
+    assert d_on.get("lazy.rewrite.segments", 0) >= 1, d_on
+    assert _applied(d_on, "identity") >= 1, d_on
+
+
+# ---------------------------------------------------------------------------
+# compile accounting and cache-key separation
+# ---------------------------------------------------------------------------
+
+
+def test_one_compile_per_rewritten_signature_zero_warm():
+    # width 9 keeps this chain's signatures unique to this test — the
+    # named "lazy" cache persists across the module
+    x, w, b = _x((4, 9)), _x((9, 9), 1), _x((9,), 2)
+
+    def step():
+        return float(nd.sum(nd.relu(nd.dot(x, w) + b)).asnumpy())
+
+    prev = {k: os.environ.get(k)
+            for k in ("MXNET_LAZY", "MXNET_LAZY_REWRITE")}
+    os.environ["MXNET_LAZY"] = "1"
+    os.environ["MXNET_LAZY_REWRITE"] = "1"
+    try:
+        _fresh_graph()
+        cold0 = compile_cache.named_stats("lazy")
+        ref = step()
+        cold1 = compile_cache.named_stats("lazy")
+        assert cold1["misses"] - cold0["misses"] == 1  # ONE compile
+        for _ in range(20):
+            assert step() == ref
+        warm = compile_cache.named_stats("lazy")
+        assert warm["misses"] - cold1["misses"] == 0   # ZERO on warm replay
+        # the unrewritten signature of the SAME chain is a different key:
+        # flipping the rewriter off must compile exactly one more program
+        os.environ["MXNET_LAZY_REWRITE"] = "0"
+        _fresh_graph()
+        assert step() == ref
+        off1 = compile_cache.named_stats("lazy")
+        assert off1["misses"] - warm["misses"] == 1
+        for _ in range(5):
+            assert step() == ref
+        assert compile_cache.named_stats("lazy")["misses"] == off1["misses"]
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_no_rule_fired_shares_unrewritten_key():
+    """A segment no rule touches must reuse the UNREWRITTEN signature, so
+    rewrite-on and rewrite-off share one compiled program."""
+    x = _x((5, 3))
+
+    def step():
+        return float(nd.sum(nd.sigmoid(x)).asnumpy())  # 2 ops, no pattern
+
+    prev = {k: os.environ.get(k)
+            for k in ("MXNET_LAZY", "MXNET_LAZY_REWRITE")}
+    os.environ["MXNET_LAZY"] = "1"
+    try:
+        os.environ["MXNET_LAZY_REWRITE"] = "1"
+        _fresh_graph()
+        s0 = compile_cache.named_stats("lazy")
+        ref = step()
+        s1 = compile_cache.named_stats("lazy")
+        assert s1["misses"] - s0["misses"] == 1
+        os.environ["MXNET_LAZY_REWRITE"] = "0"
+        _fresh_graph()
+        assert step() == ref
+        s2 = compile_cache.named_stats("lazy")
+        assert s2["misses"] == s1["misses"]  # shared program, cache HIT
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# gates: global kill switch + per-rule disable
+# ---------------------------------------------------------------------------
+
+
+def test_global_kill_switch():
+    x, w, b = _x((4, 8)), _x((8, 8), 1), _x((8,), 2)
+
+    def chain():
+        return nd.relu(nd.dot(x, w) + b)
+
+    _, d = _run(chain, False)
+    assert not any(k.startswith("lazy.rewrite.rules_applied") for k in d), d
+    assert d.get("lazy.rewrite.segments", 0) == 0
+
+
+@pytest.mark.parametrize("rule", list(rewrite.RULES))
+def test_per_rule_disable(rule):
+    """Disabling one rule leaves the rest firing and keeps parity."""
+    x, w, b = _x((4, 8)), _x((8, 8), 1), _x((8,), 2)
+
+    def chain():
+        h = x + nd.zeros_like(x)
+        h = nd.relu(nd.dot(h, w) + b)
+        return nd.sum(nd.tanh(nd.abs(h)))
+
+    on, d = _run(chain, True, disable=rule)
+    off, _ = _run(chain, False)
+    assert _applied(d, rule) == 0, d
+    others = {"identity", "dense_bias_act", "map_reduce"} - {rule}
+    assert any(_applied(d, r) for r in others), d
+    _assert_bit_equal(on, off)
+
+
+def test_unknown_disable_name_counted():
+    x = _x((4, 4))
+    _, d = _run(lambda: x + nd.zeros_like(x), True,
+                disable="no_such_rule_xyz")
+    assert d.get("lazy.rewrite.unknown_disable_names", 0) >= 1, d
+
+
+def test_rule_registry_documented():
+    """Every rule is registered with family/doc/parity — the shared
+    registry fusion.py's TPU_FUSE property and the docs point at."""
+    assert set(rewrite.rule_names()) == {
+        "identity", "cse", "dense_bias_act", "conv_bn_relu", "map_reduce",
+        "spmd_constraint"}
+    for r in rewrite.RULES.values():
+        assert r.family in ("algebraic", "fusion", "sharding")
+        assert r.parity in ("bit", "ulp")
+        assert r.doc
+    assert "symbol" in rewrite.RULES["conv_bn_relu"].levels  # TPU_FUSE tie
+
+
+def test_fused_conv_bn_attrs_shared_with_fusion():
+    """symbol/fusion.py builds its _fused_conv_bn_relu attrs through the
+    SAME helper the lazy rule uses — one registry, no drift."""
+    import inspect
+
+    from mxnet_tpu.symbol import fusion
+
+    assert "fused_conv_bn_attrs" in inspect.getsource(fusion)
+    attrs = rewrite.fused_conv_bn_attrs(
+        {"kernel": (3, 3), "num_filter": 6, "pad": (1, 1), "dilate": (1, 1),
+         "workspace": 1024},  # non-conv attr filtered out
+        {"eps": 2e-5, "fix_gamma": False}, True)
+    assert attrs == {"kernel": (3, 3), "num_filter": 6, "pad": (1, 1),
+                     "dilate": (1, 1), "eps": 2e-5, "fix_gamma": False,
+                     "with_relu": True}
+
+
+# ---------------------------------------------------------------------------
+# sharding-aware injection: tp=1 lowers to ZERO collectives (hlolint pin)
+# ---------------------------------------------------------------------------
+
+
+def test_spmd_constraint_injection_zero_collectives(tmp_path):
+    """Under MXNET_SPMD="tp=1" the constraint rule annotates large
+    segment leaves; the compiled program must contain ZERO collectives —
+    pinned through the hlolint 'lazy' contract on a real dump (the mesh
+    is trivial, so every annotation is layout-only). Runs in a
+    subprocess: the mesh/env gates are memoized at first use."""
+    code = (
+        "import os\n"
+        "import numpy as np\n"
+        "from mxnet_tpu import nd, telemetry\n"
+        "x = nd.array(np.random.RandomState(0)"
+        ".uniform(-1, 1, (256, 256)).astype(np.float32))\n"
+        "w = nd.array(np.random.RandomState(1)"
+        ".uniform(-0.1, 0.1, (256, 256)).astype(np.float32))\n"
+        "y = nd.relu(nd.dot(x, w))\n"
+        "on = y.asnumpy()\n"
+        "snap = telemetry.snapshot()['counters']\n"
+        "assert snap.get('lazy.rewrite.rules_applied.spmd_constraint', 0)"
+        " >= 1, snap\n"
+        "os.environ['MXNET_LAZY_REWRITE'] = '0'\n"
+        "y2 = nd.relu(nd.dot(x, w))\n"
+        "assert np.array_equal(on, y2.asnumpy())\n"  # annotation-only
+        "print('SPMD_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_LAZY="1",
+               MXNET_LAZY_REWRITE="1", MXNET_SPMD="tp=1",
+               MXNET_HLOLINT_DUMP=str(tmp_path),
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "SPMD_OK" in proc.stdout
+    check = subprocess.run(
+        [sys.executable, "-m", "tools.hlolint", "check", str(tmp_path),
+         "--require", "lazy", "--strict", "--explain"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert check.returncode == 0, check.stdout + check.stderr
+
+
+def test_spmd_rule_inert_without_gate():
+    """No MXNET_SPMD -> the sharding rule never fires (and nothing in the
+    8-virtual-device test env sneaks a mesh in)."""
+    x = _x((256, 256))
+
+    def chain():
+        return nd.relu(x * 2.0)
+
+    _, d = _run(chain, True)
+    assert _applied(d, "spmd_constraint") == 0, d
+
+
+# ---------------------------------------------------------------------------
+# telemetry: counters, derived metrics, report line
+# ---------------------------------------------------------------------------
+
+
+def test_rewrite_counters_and_derived_metrics():
+    x, w, b = _x((4, 8)), _x((8, 8), 1), _x((8,), 2)
+
+    def chain():
+        return nd.relu(nd.dot(x, w) + b)
+
+    _, d = _run(chain, True)
+    assert d.get("lazy.rewrite.segments", 0) >= 1
+    assert d["lazy.rewrite.nodes_pre"] > d["lazy.rewrite.nodes_post"]
+    assert d.get("lazy.rewrite.nodes_eliminated", 0) >= 2
+    derived = telemetry.snapshot()["derived"]
+    assert derived["lazy.rewrite.mean_ops_pre"] > \
+        derived["lazy.rewrite.mean_ops_post"]
+    assert 0.0 < derived["lazy.rewrite.shrink_ratio"] < 1.0
+    # the capture metric stays PRE-rewrite: rewriting must never read as
+    # "capture got worse" in mean_ops_per_segment
+    assert "lazy.mean_ops_per_segment" in derived
+
+
+def test_report_has_rewrite_line(tmp_path):
+    x, w, b = _x((4, 8)), _x((8, 8), 1), _x((8,), 2)
+    _run(lambda: nd.relu(nd.dot(x, w) + b), True)
+    path = str(tmp_path / "snap.json")
+    telemetry.dump(path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         path], capture_output=True, text=True, check=True, timeout=300).stdout
+    assert "rewrite:" in out
+    assert "dense_bias_act" in out
+    assert "Reading rewrite telemetry" in out
+
+
+def test_plan_errors_fall_back_to_unrewritten(monkeypatch):
+    """A rewriter bug must degrade to the always-correct unrewritten
+    program and count a plan error — never break the flush."""
+    def boom(sig, cfg):
+        raise RuntimeError("injected rewriter bug")
+
+    monkeypatch.setattr(rewrite, "_compute_plan", boom)
+    rewrite._PLANS.clear()
+    x = _x((4, 8))
+
+    def chain():
+        return x + nd.zeros_like(x)
+
+    on, d = _run(chain, True)
+    off, _ = _run(chain, False)
+    _assert_bit_equal(on, off)
+    assert d.get("lazy.rewrite.plan_errors", 0) >= 1, d
+    assert d.get("lazy.rewrite.segments", 0) == 0

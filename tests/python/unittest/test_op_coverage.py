@@ -850,6 +850,9 @@ COVERED_ELSEWHERE = {
     "_contrib_quantized_pooling": "test_subgraph_quantization.py",
     "_fused_conv_bn_relu": "test_subgraph_quantization.py",
     "_subgraph_exec": "test_subgraph_quantization.py",
+    "_rw_dense_bias_act": "test_lazy_rewrite.py",
+    "_rw_map_reduce": "test_lazy_rewrite.py",
+    "_rw_sharding_constraint": "test_lazy_rewrite.py",
     # vision/detection — test_vision_ops.py
     "_contrib_ROIAlign": "test_vision_ops.py", "ROIPooling": "test_vision_ops.py",
     "_contrib_box_nms": "test_vision_ops.py",

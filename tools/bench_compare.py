@@ -51,6 +51,9 @@ METRICS = [
      "generation accepted toks/tick", "up"),
     ("generation.spec_vs_plain", "generation spec/plain speedup", "up"),
     ("lazy.lazy_vs_eager", "lazy/eager speedup", "up"),
+    ("lazy_fused.rewrite_speedup", "lazy rewrite on/off speedup", "up"),
+    ("lazy_fused.compile_speedup", "lazy rewrite compile speedup", "up"),
+    ("lazy_fused.shrink_ratio", "lazy rewrite node shrink", "up"),
     ("spmd.spmd_vs_replicated", "spmd/replicated step speedup", "up"),
     ("spmd.param_bytes_ratio", "spmd param bytes ratio (1/N)", "down"),
     ("spmd.parity_rel", "spmd whole-run parity rel", "down"),
@@ -161,6 +164,8 @@ INVARIANTS = [
     ("generation.prefix_steady_state_compiles",
      "prefix-cache steady-state compiles"),
     ("lazy.steady_state_compiles", "lazy steady-state compiles"),
+    ("lazy_fused.steady_state_compiles",
+     "lazy rewrite-lane steady-state compiles"),
     ("spmd.steady_state_compiles", "spmd steady-state compiles"),
     ("serving.swap_steady_state_compiles",
      "weight-swap steady-state compiles"),

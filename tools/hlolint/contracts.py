@@ -101,7 +101,10 @@ CONTRACTS = {
         single_device_collectives_ok=False,
         allowed_collectives=frozenset(),
         note="captured segments never donate and never hide a "
-             "collective"),
+             "collective; graph-rewritten segments (lazy/rewrite.py) keep "
+             "this same row — sharding-constraint injection is layout "
+             "annotation only, so tp=1 lowers to ZERO collectives "
+             "(test_lazy_rewrite pins it on a live dump)"),
     # rows for the remaining donate sites (audited on request via
     # MXNET_HLOLINT_CACHES; the tpulint donation-aliasing rule requires
     # every donate site to resolve to SOME row here)

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Bench-in-the-loop tuner for the lazy capture + rewrite knobs.
+
+TVM closes its fusion loop with a learned cost model (arXiv:1802.04799);
+this repo's cost oracle already exists — ``bench.py``'s lazy lanes — so
+the tuner simply SWEEPS the knob space and lets the measured lanes
+score every point. Each configuration runs in a fresh subprocess (env
+knobs like ``MXNET_LAZY_MAX_OPS`` are read through memoized gates, so
+in-process flipping would leak state between points) driving the exact
+``_measure_lazy`` / ``_measure_lazy_fused`` lanes CI records.
+
+Swept knobs::
+
+    MXNET_LAZY_MAX_OPS            segment flush threshold
+    MXNET_LAZY_CHURN_RATIO_PCT    hysteresis trip point
+    MXNET_LAZY_REWRITE            rewrite pipeline on/off
+    MXNET_LAZY_REWRITE_DISABLE    each rule knocked out alone (--per-rule)
+
+Usage::
+
+    python -m tools.lazy_tune [-o LAZY_TUNE.json] [--per-rule] [--quick]
+
+The output JSON is shaped like a bench record (top-level ``lazy`` /
+``lazy_fused`` lanes hold the BEST point's numbers) plus ``best_config``
+and the full ``sweep`` table — so ``tools/bench_compare.py`` validates a
+tuned record against any bench sidecar direction-aware, unchanged::
+
+    python -m tools.bench_compare BENCH_rNN.json LAZY_TUNE.json
+
+Scoring is direction-aware too: a point wins on the geometric mean of
+``lazy.lazy_vs_eager`` and ``lazy_fused.rewrite_speedup`` (both "up"
+metrics), with ``steady_state_compiles != 0`` disqualifying the point
+outright (compile-once is a constraint, not a tradeoff).
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+SWEEP_MAX_OPS = (64, 256, 1024)
+SWEEP_CHURN_PCT = (50,)
+RULE_NAMES = ("identity", "cse", "dense_bias_act", "conv_bn_relu",
+              "map_reduce", "spmd_constraint")
+
+
+def _worker():
+    """Child-process entry: run the two lazy lanes under the env the
+    parent staged and print their records as one JSON line."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    out = {}
+    try:
+        out["lazy"] = bench._measure_lazy(False)
+    except Exception as exc:  # noqa: BLE001 — a failed point scores 0
+        out["lazy_error"] = f"{type(exc).__name__}: {exc}"
+    try:
+        out["lazy_fused"] = bench._measure_lazy_fused(False)
+    except Exception as exc:  # noqa: BLE001
+        out["lazy_fused_error"] = f"{type(exc).__name__}: {exc}"
+    print(json.dumps(out))
+
+
+def _run_point(cfg, timeout):
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in cfg.items()})
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lazy_tune", "--worker"],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if proc.returncode != 0:
+        return {"error": (proc.stderr or "").strip().splitlines()[-1:]}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": ["unparseable worker output"]}
+
+
+def _score(rec):
+    """Direction-aware score: geomean of the two "up" headline ratios;
+    0 disqualifies (missing lanes or a broken compile-once invariant)."""
+    lazy = rec.get("lazy") or {}
+    fused = rec.get("lazy_fused") or {}
+    a = lazy.get("lazy_vs_eager")
+    b = fused.get("rewrite_speedup")
+    if a is None or b is None:
+        return 0.0
+    if lazy.get("steady_state_compiles", 1) != 0:
+        return 0.0
+    if fused.get("steady_state_compiles", 1) != 0:
+        return 0.0
+    return (float(a) * float(b)) ** 0.5
+
+
+def _configs(per_rule, quick):
+    max_ops = SWEEP_MAX_OPS[:2] if quick else SWEEP_MAX_OPS
+    for mo, churn in itertools.product(max_ops, SWEEP_CHURN_PCT):
+        base = {"MXNET_LAZY_MAX_OPS": mo, "MXNET_LAZY_CHURN_RATIO_PCT": churn}
+        yield dict(base, MXNET_LAZY_REWRITE=1, MXNET_LAZY_REWRITE_DISABLE="")
+        yield dict(base, MXNET_LAZY_REWRITE=0, MXNET_LAZY_REWRITE_DISABLE="")
+        if per_rule:
+            for rule in RULE_NAMES:
+                yield dict(base, MXNET_LAZY_REWRITE=1,
+                           MXNET_LAZY_REWRITE_DISABLE=rule)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-o", "--output", default="LAZY_TUNE.json")
+    ap.add_argument("--per-rule", action="store_true",
+                    help="also knock out each rewrite rule alone")
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller MAX_OPS sweep (CI smoke)")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-point subprocess timeout seconds")
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.worker:
+        _worker()
+        return 0
+
+    sweep = []
+    for cfg in _configs(args.per_rule, args.quick):
+        rec = _run_point(cfg, args.timeout)
+        score = _score(rec)
+        sweep.append({"config": cfg, "score": round(score, 4),
+                      "lazy": rec.get("lazy"),
+                      "lazy_fused": rec.get("lazy_fused"),
+                      **({"error": rec["error"]} if "error" in rec else {})})
+        label = ",".join(f"{k.replace('MXNET_LAZY_', '').lower()}={v}"
+                         for k, v in cfg.items() if v != "")
+        print(f"  {label}: score {score:.3f}", file=sys.stderr)
+
+    scored = [p for p in sweep if p["score"] > 0]
+    if not scored:
+        print("lazy_tune: every sweep point failed or was disqualified",
+              file=sys.stderr)
+        return 1
+    best = max(scored, key=lambda p: p["score"])
+    out = {
+        "basis": "tools/lazy_tune.py sweep (bench lazy lanes as oracle)",
+        "best_config": best["config"],
+        "best_score": best["score"],
+        "lazy": best["lazy"],
+        "lazy_fused": best["lazy_fused"],
+        "sweep": sweep,
+    }
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"lazy_tune: best {best['config']} (score {best['score']:.3f}) "
+          f"-> {args.output}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

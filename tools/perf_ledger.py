@@ -59,6 +59,8 @@ METRICS = [
     ("generation.ttft_p99_ms", "down"),
     ("generation.tick_mbu", "up"),
     ("lazy.lazy_vs_eager", "up"),
+    ("lazy_fused.rewrite_speedup", "up"),
+    ("lazy_fused.compile_speedup", "up"),
     ("spmd.spmd_vs_replicated", "up"),
     ("multichip.avg_gb_per_sec_per_device", "up"),
 ]
@@ -162,6 +164,11 @@ def record_from_bench(rec, source="bench.py", historical=False):
         ("predicted_floor_s", "predicted_floor_s"),
     ])
     _lane(lanes, "lazy", rec.get("lazy"), [("lazy_vs_eager", "lazy_vs_eager")])
+    _lane(lanes, "lazy_fused", rec.get("lazy_fused"), [
+        ("rewrite_speedup", "rewrite_speedup"),
+        ("compile_speedup", "compile_speedup"),
+        ("shrink_ratio", "shrink_ratio"),
+    ])
     _lane(lanes, "spmd", rec.get("spmd"), [
         ("spmd_vs_replicated", "spmd_vs_replicated"),
         ("mfu", "mfu"), ("mbu", "mbu"),

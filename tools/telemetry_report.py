@@ -99,6 +99,31 @@ def main(argv=None):
         line += ("\n  (mean ops/segment near 1 = flush-happy code; see "
                  "docs/faq/perf.md \"Reading lazy-segment telemetry\")\n")
         sys.stdout.write(line)
+    rw_segs = counters.get("lazy.rewrite.segments", 0)
+    rw_errs = counters.get("lazy.rewrite.plan_errors", 0)
+    if rw_segs or rw_errs:
+        derived = snap.get("derived", {})
+        pre = derived.get("lazy.rewrite.mean_ops_pre")
+        post = derived.get("lazy.rewrite.mean_ops_post")
+        shrink = derived.get("lazy.rewrite.shrink_ratio")
+        line = f"\nrewrite: {rw_segs} segments rewritten"
+        if pre is not None and post is not None:
+            line += f", mean nodes {pre:.1f} -> {post:.1f}"
+        if shrink is not None:
+            line += f" (shrink {shrink:.0%})"
+        rules = {k.split("lazy.rewrite.rules_applied.", 1)[1]: v
+                 for k, v in counters.items()
+                 if k.startswith("lazy.rewrite.rules_applied.")}
+        if rules:
+            line += "; rules: " + ", ".join(
+                f"{k} {v}" for k, v in
+                sorted(rules.items(), key=lambda kv: -kv[1]))
+        if rw_errs:
+            line += (f"; WARNING {rw_errs} plan errors (those segments "
+                     "ran unrewritten)")
+        line += ("\n  (which rules paid and when CSE loses: "
+                 "docs/faq/perf.md \"Reading rewrite telemetry\")\n")
+        sys.stdout.write(line)
     dropped = counters.get("profiler.dropped_events", 0)
     t_dropped = counters.get("tracing.dropped_events", 0)
     if dropped or t_dropped:
