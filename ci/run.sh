@@ -67,6 +67,7 @@ unit() {
       --ignore=tests/python/unittest/test_lazy.py \
       --ignore=tests/python/unittest/test_health.py \
       --ignore=tests/python/unittest/test_tpulint.py \
+      --ignore=tests/python/unittest/test_overlap.py \
       --ignore=tests/python/unittest/test_spmd.py
   # resilience gate, run standalone (not twice) so a fault-injection
   # failure is attributed loudly. CI runs the whole suite including the
@@ -202,6 +203,15 @@ unit() {
   # a roofline or ledger regression fails HERE, attributed
   log "observatory suite (measured-peak probes, roofline attribution, MFU/MBU gauges, perf ledger)"
   python -m pytest tests/python/unittest/test_observatory.py -q
+  # overlap gate, standalone: these tests flip MXNET_OVERLAP / the
+  # telemetry registry, spin the DeviceStager staging thread and pin
+  # N-step BIT-EXACT parameter parity vs the MXNET_OVERLAP=0 lockstep
+  # reference (SGD+Adam across fused/zero1/spmd), staged-buffer donation
+  # safety under in-flight reuse, serving flush parity with zero
+  # steady-state compiles, and pad-buffer identity stability — a
+  # pipeline-ordering or staging regression fails HERE, attributed
+  log "overlap suite (async dispatch pipeline parity, staged donation safety, deferred metric lane)"
+  python -m pytest tests/python/unittest/test_overlap.py -q
   # spmd gate, standalone: these tests flip MXNET_SPMD / MXNET_ZERO1 /
   # MXNET_PIPELINE_* and pin sharded-vs-replicated whole-run parity,
   # MEASURED 1/N per-device param+state residency, tp x fsdp x pp x
@@ -240,8 +250,9 @@ unit() {
   # fails the run on ANY lock-order inversion or blocking hazard the
   # suites drove, with both stacks printed — the dynamic complement of
   # the static tpulint gate (the PR 10 / PR 12 deadlock classes)
-  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/rollout/lazy/rewrite/elastic)"
+  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/rollout/lazy/rewrite/elastic/overlap)"
   env MXNET_DEBUG_SYNC=1 python -m pytest \
+      tests/python/unittest/test_overlap.py \
       tests/python/unittest/test_serving.py \
       tests/python/unittest/test_generation.py \
       tests/python/unittest/test_generation_scale.py \

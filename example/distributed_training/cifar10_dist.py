@@ -45,6 +45,9 @@ def main():
 
     # synthetic CIFAR-shaped data, deterministically sharded by rank
     # (the reference's SplitSampler, cifar10_dist.py:90)
+    # global stream feeds NDArrayIter's epoch shuffle — seed per rank so
+    # each worker's shard order is reproducible
+    np.random.seed(7 + rank)
     rng = np.random.RandomState(7)
     n = 512
     X = rng.uniform(-1, 1, (n, 3, 32, 32)).astype(np.float32)

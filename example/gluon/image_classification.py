@@ -43,6 +43,9 @@ def main():
 
     c, h, w = (int(s) for s in args.image_shape.split(","))
     n = args.batch_size * args.num_batches
+    # global stream feeds NDArrayIter's epoch shuffle — seed both for a
+    # reproducible run
+    np.random.seed(0)
     rng = np.random.RandomState(0)
     X = rng.uniform(-1, 1, (n, c, h, w)).astype(np.float32)
     y = rng.randint(0, args.classes, n).astype(np.float32)

@@ -52,7 +52,10 @@ def main():
     args = p.parse_args()
 
     # synthetic sparse binary classification: a hidden weight over a small
-    # active-feature universe decides the label
+    # active-feature universe decides the label. NDArrayIter's epoch
+    # shuffle draws from the GLOBAL np.random stream, so seed it too for
+    # a reproducible run
+    np.random.seed(0)
     rng = np.random.RandomState(0)
     n = 1024
     idx = rng.randint(0, args.num_features, (n, args.nnz)).astype(np.float32)

@@ -73,6 +73,36 @@ class DataBatch:
         return f"{type(self).__name__}: data shapes: {dshapes} label shapes: {lshapes}"
 
 
+# (rows, batch_size) -> device index vector mapping a short batch onto its
+# padded bucket (row i<n keeps data[i], row n+j recycles data[j % n]).  One
+# gather with a cached index replaces the per-call concatenate chain, so a
+# partial batch costs zero fresh host allocations on the hot path; LRU keeps
+# the cache bounded across pathological shape churn.  ``_pad_index`` is the
+# preallocated per-bucket pad buffer — tests pin its id-stability.
+_PAD_INDEX_CACHE = collections.OrderedDict()
+_PAD_INDEX_CACHE_MAX = 64
+
+
+def _pad_index(n, batch_size):
+    """Cached wrap-around gather index for padding ``n`` rows up to
+    ``batch_size``; the same (n, batch_size) returns the SAME array."""
+    import jax.numpy as jnp
+
+    key = (int(n), int(batch_size))
+    idx = _PAD_INDEX_CACHE.get(key)
+    if idx is None:
+        pad = batch_size - n
+        idx = jnp.asarray(
+            _np.concatenate([_np.arange(n), _np.arange(pad) % n]).astype(
+                _np.int32))
+        _PAD_INDEX_CACHE[key] = idx
+        while len(_PAD_INDEX_CACHE) > _PAD_INDEX_CACHE_MAX:
+            _PAD_INDEX_CACHE.popitem(last=False)
+    else:
+        _PAD_INDEX_CACHE.move_to_end(key)
+    return idx
+
+
 def pad_arrays(arrays, batch_size):
     """Pad each array in ``arrays`` along axis 0 up to ``batch_size`` by
     recycling its rows from the start (wrapping around if the batch is
@@ -104,10 +134,7 @@ def pad_arrays(arrays, batch_size):
                              "(no rows to recycle)")
         pad = batch_size - n
         data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
-        reps = -(-pad // n)  # ceil
-        filler = jnp.concatenate([data] * reps, axis=0)[:pad] if reps > 1 \
-            else data[:pad]
-        out.append(NDArray(jnp.concatenate([data, filler], axis=0)))
+        out.append(NDArray(jnp.take(data, _pad_index(n, batch_size), axis=0)))
     return out, pad
 
 

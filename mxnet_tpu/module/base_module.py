@@ -20,6 +20,7 @@ from .. import tracing
 from ..base import MXNetError
 from .. import metric as _metric
 from .. import ndarray as nd
+from ..io import staging as _staging
 from ..io.io import DataDesc
 
 
@@ -236,6 +237,13 @@ class BaseModule:
                 end_of_batch = False
                 data_iter = iter(train_data)
                 next_data_batch = next(data_iter)
+                # async overlap lane (MXNET_OVERLAP=1): metric reads become
+                # deferred thunks applied one step late, so the host never
+                # blocks on the step it just dispatched; sync points land
+                # only at epoch boundaries (and wherever a consumer pulls
+                # quantiles). `pending_metric` holds step t-1's thunk.
+                overlap = _staging.overlap_enabled()
+                pending_metric = None
                 while not end_of_batch:
                     data_batch = next_data_batch
                     if monitor is not None:
@@ -273,35 +281,85 @@ class BaseModule:
                         t_up = time.perf_counter() if timed else 0.0
                         if tele:
                             telemetry.gauge("step.fused").set(1 if fused else 0)
-                        if isinstance(data_batch, list):
-                            self.update_metric(eval_metric,
-                                               [db.label for db in data_batch],
-                                               pre_sliced=True)
+                        # deferred-metric capture: under overlap, step t's
+                        # metric read becomes a thunk holding t's still-live
+                        # lazy outputs; it is applied NEXT iteration, while
+                        # step t+1 is in flight. None = this step cannot
+                        # defer (overlap off, list batch, module without
+                        # captured outputs) -> eager lockstep reference.
+                        capture = None
+                        if overlap and not isinstance(data_batch, list):
+                            capture = self.capture_metric_update(
+                                data_batch.label)
+                        if capture is None:
+                            if pending_metric is not None:
+                                # mixed-mode seam: settle the deferred step
+                                # before the eager one updates the metric
+                                pending_metric(eval_metric)
+                                pending_metric = None
+                                self.retire_staged()
+                            if isinstance(data_batch, list):
+                                self.update_metric(
+                                    eval_metric,
+                                    [db.label for db in data_batch],
+                                    pre_sliced=True)
+                            else:
+                                self.update_metric(eval_metric,
+                                                   data_batch.label)
+                            t_sync = time.perf_counter() if timed else 0.0
+                            try:
+                                next_data_batch = next(data_iter)
+                                self.prepare(next_data_batch,
+                                             sparse_row_id_fn=sparse_row_id_fn)
+                            except StopIteration:
+                                end_of_batch = True
+                            t_end = t_data = time.perf_counter() if timed \
+                                else 0.0
+                            marks = (("fwdbwd", t0, t_fb),
+                                     ("update", t_fb, t_up),
+                                     ("sync", t_up, t_sync),
+                                     ("data", t_sync, t_data))
                         else:
-                            self.update_metric(eval_metric, data_batch.label)
-                        t_sync = time.perf_counter() if timed else 0.0
-                        try:
-                            next_data_batch = next(data_iter)
-                            self.prepare(next_data_batch,
-                                         sparse_row_id_fn=sparse_row_id_fn)
-                        except StopIteration:
-                            end_of_batch = True
-                        t_data = time.perf_counter() if timed else 0.0
+                            # dispatch-then-prepare: fetch + device-stage
+                            # batch t+1 while step t executes, then apply
+                            # step t-1's metric thunk (its outputs finished
+                            # at least one step ago, so this rarely blocks)
+                            try:
+                                next_data_batch = next(data_iter)
+                                self.prepare(next_data_batch,
+                                             sparse_row_id_fn=sparse_row_id_fn)
+                                self.stage_batch(next_data_batch)
+                            except StopIteration:
+                                end_of_batch = True
+                            t_data = time.perf_counter() if timed else 0.0
+                            if pending_metric is not None:
+                                pending_metric(eval_metric)
+                                self.retire_staged()
+                            pending_metric = capture
+                            if end_of_batch:
+                                # epoch boundary is a sync point: flush so
+                                # epoch-end metrics match lockstep bit-exact
+                                pending_metric(eval_metric)
+                                pending_metric = None
+                                self.retire_staged()
+                            t_end = t_sync = time.perf_counter() if timed \
+                                else 0.0
+                            marks = (("fwdbwd", t0, t_fb),
+                                     ("update", t_fb, t_up),
+                                     ("data", t_up, t_data),
+                                     ("sync", t_data, t_sync))
+                            if tele:
+                                telemetry.counter("overlap.steps").inc()
                         if trc:
                             # the phase children, reconstructed from the perf
                             # marks (one wall-clock read anchors them all)
                             end_us = tracing.now_us()
-
-                            def _seg(name, a, b):
+                            for seg, a, b in marks:
                                 tracing.emit_span(
-                                    name, end_us - (t_data - a) * 1e6,
+                                    "step." + seg,
+                                    end_us - (t_end - a) * 1e6,
                                     (b - a) * 1e6, cat="train",
                                     parent=step_span)
-
-                            _seg("step.fwdbwd", t0, t_fb)
-                            _seg("step.update", t_fb, t_up)
-                            _seg("step.sync", t_up, t_sync)
-                            _seg("step.data", t_sync, t_data)
                             step_span.set(fused=fused)
                     if trc:
                         tracing.flight_recorder.observe(step_span.tree())
@@ -309,30 +367,28 @@ class BaseModule:
                         # steady-state step wall for the roofline's
                         # achieved MFU/MBU (the executable itself was
                         # named by Executor.fused_step's exec_s sample)
-                        observatory.observe("step", wall_s=t_data - t0)
+                        observatory.observe("step", wall_s=t_end - t0)
                     step_stats = None
                     if tele:
                         total_h = telemetry.histogram("step.total_us")
-                        for name, us in (("step.fwdbwd_us", (t_fb - t0) * 1e6),
-                                         ("step.update_us", (t_up - t_fb) * 1e6),
-                                         ("step.sync_us", (t_sync - t_up) * 1e6),
-                                         ("step.data_us", (t_data - t_sync) * 1e6)):
-                            telemetry.histogram(name).record(us)
-                        total_us = (t_data - t0) * 1e6
+                        for seg, a, b in marks:
+                            telemetry.histogram(
+                                f"step.{seg}_us").record((b - a) * 1e6)
+                        total_us = (t_end - t0) * 1e6
                         total_h.record(total_us)
+                        # wall-clock denominator for the derived pipeline
+                        # stall ratio (prefetch wait + stage wait over wall)
+                        telemetry.counter("step.wall_us_total").inc(
+                            int(total_us))
                         if batch_end_callback is not None:
                             # quantiles sort the reservoir, so they are NOT
                             # computed here each batch — the histogram rides
                             # along and consumers (Speedometer) pull
                             # hist.quantiles(50, 99) only on their log ticks
-                            step_stats = {
-                                "fwdbwd_ms": (t_fb - t0) * 1e3,
-                                "update_ms": (t_up - t_fb) * 1e3,
-                                "sync_ms": (t_sync - t_up) * 1e3,
-                                "data_ms": (t_data - t_sync) * 1e3,
-                                "total_ms": total_us / 1e3,
-                                "hist": total_h,
-                            }
+                            seg_ms = {f"{seg}_ms": (b - a) * 1e3
+                                      for seg, a, b in marks}
+                            step_stats = dict(seg_ms, total_ms=total_us / 1e3,
+                                              hist=total_h)
                     if monitor is not None:
                         monitor.toc_print()
                     if batch_end_callback is not None:
@@ -345,6 +401,10 @@ class BaseModule:
                         # completed — the watchdog's rolling median learns
                         # the step cadence from these
                         fit_beacon.touch()
+                if pending_metric is not None:  # pragma: no cover — safety
+                    pending_metric(eval_metric)
+                    pending_metric = None
+                    self.retire_staged()
                 if fit_beacon is not None:
                     fit_beacon.idle()
                 for name, val in eval_metric.get_name_value():
@@ -365,11 +425,38 @@ class BaseModule:
                         self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
                 train_data.reset()
         finally:
+            self._overlap_teardown()
             if fit_beacon is not None:
                 fit_beacon.idle()
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass
+
+    # -- async overlap lane hooks (MXNET_OVERLAP) ----------------------------
+    # Subclasses that can defer their sync points override these; the base
+    # defaults make every module a valid lockstep participant, so `fit`
+    # degrades to the bit-exact reference order wherever a hook opts out.
+
+    def capture_metric_update(self, labels):
+        """A thunk ``f(eval_metric)`` that applies THIS step's metric
+        update later (from outputs captured now), or None when this step
+        must update eagerly (the lockstep reference path)."""
+        return None
+
+    def stage_batch(self, data_batch):
+        """Hand ``data_batch`` to the device-staging thread so its
+        pad/cast/placement overlaps the in-flight step. False = not
+        staged (consumers fall back to host-side feed prep)."""
+        return False
+
+    def retire_staged(self):
+        """Release the oldest staged buffer whose step finished — called
+        by ``fit`` right after the deferred metric for that step lands."""
+        return False
+
+    def _overlap_teardown(self):
+        """Stop any staging thread and drop staged buffers (fit exit)."""
+        return None
 
     def install_monitor(self, mon):
         raise NotImplementedError

@@ -23,6 +23,7 @@ from .. import ndarray as nd
 from .. import optimizer as opt
 from ..model import _create_kvstore
 from ..initializer import Uniform, InitDesc
+from ..io import staging as _staging
 from ..io.io import DataDesc
 from .base_module import BaseModule
 
@@ -76,6 +77,8 @@ class Module(BaseModule):
         self._pipeline_failed = False  # plan/trace failed — stay unpipelined
         self._spmd = None  # SPMD sharding plan (MXNET_SPMD)
         self._spmd_failed = False  # plan/trace failed — stay replicated
+        self._stager = None  # DeviceStager ring (MXNET_OVERLAP, lazy)
+        self._staged_meta = []  # [(batch, pad/hysteresis meta)] FIFO
 
     # -- properties ----------------------------------------------------------
 
@@ -423,7 +426,13 @@ class Module(BaseModule):
         the caller (BaseModule.fit) to run forward_backward() + update()."""
         if not self._fused_step_ready():
             return False
-        feed = self._make_feed(data_batch)
+        # overlap lane: a batch the staging thread already padded/cast/
+        # placed rides straight into the executor (set_args' asarray is a
+        # no-op on device-resident arrays of the bound dtype); a miss
+        # falls back to the host-side lockstep feed prep
+        feed = self._consume_staged(data_batch)
+        if feed is None:
+            feed = self._make_feed(data_batch)
         self._exec.set_args(**feed)
         # SPMD one-mesh composition: when MXNET_SPMD is set, the schedule
         # and the sharding plan must share ONE device assignment — resolve
@@ -662,6 +671,132 @@ class Module(BaseModule):
         eval_metric.update_dict(
             dict(zip(self._label_names, labels)),
             dict(zip(self._output_names, self.get_outputs())))
+
+    # -- async overlap lane (MXNET_OVERLAP) ----------------------------------
+
+    def capture_metric_update(self, labels):
+        """Defer this step's metric read: the returned thunk holds the
+        CURRENT outputs (lazily sliced by the current pad state, which the
+        next step's feed prep will overwrite) and applies them whenever
+        `fit` settles the deferred lane."""
+        if labels is None or not (self.binded and self.params_initialized):
+            return None
+        label_map = dict(zip(self._label_names, labels))
+        out_map = dict(zip(self._output_names, self.get_outputs()))
+
+        def apply(eval_metric):
+            eval_metric.update_dict(label_map, out_map)
+
+        return apply
+
+    def stage_batch(self, data_batch):
+        """Decide stageability on the MAIN thread (executor shapes + the
+        pad-vs-reshape hysteresis state are only coherent here), then hand
+        the pad/cast/device-placement to the staging thread. Mirrors
+        `_make_feed`'s decision tree exactly: a reshape-bound batch is not
+        staged — the lockstep path owns rebinds."""
+        if not _staging.overlap_enabled() or not self._fused_step_ready():
+            return False
+        if isinstance(data_batch, list) or data_batch.data is None:
+            return False
+        feed_src = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed_src[name] = arr
+        if data_batch.label is not None and self._label_names:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed_src[name] = arr
+        cur = self._exec.arg_dict
+        if not feed_src or any(n not in cur for n in feed_src):
+            return False
+        mismatched = [n for n, a in feed_src.items()
+                      if tuple(cur[n].shape) != tuple(a.shape)]
+        short_shape = None
+        if mismatched:
+            short_shape = tuple(sorted((n, tuple(feed_src[n].shape))
+                                       for n in mismatched))
+            is_short = not self.inputs_need_grad and all(
+                tuple(feed_src[n].shape[1:]) == tuple(cur[n].shape[1:])
+                and 0 < feed_src[n].shape[0] < cur[n].shape[0]
+                for n in mismatched)
+            if not is_short or short_shape == getattr(
+                    self, "_last_short_shape", None):
+                return False  # reshape path — host rebind, never staged
+        shapes = {n: tuple(cur[n].shape) for n in feed_src}
+        dtypes = {n: cur[n].dtype for n in feed_src}
+        pad_names = frozenset(mismatched)
+        bound = cur[mismatched[0]].shape[0] if mismatched else 0
+        sp = self._spmd
+        exec_ref = self._exec
+
+        def prep():  # staging thread: pad -> cast -> place
+            import jax.numpy as jnp
+
+            from ..io.io import pad_arrays
+            from ..ndarray import NDArray
+
+            feed, pad = {}, 0
+            for n, src in feed_src.items():
+                a = src
+                if n in pad_names:
+                    padded, p = pad_arrays([a], shapes[n][0])
+                    a = padded[0]
+                    pad = max(pad, p)
+                data = a._data if isinstance(a, NDArray) else a
+                data = jnp.asarray(data, dtypes[n])
+                if sp is not None:
+                    # land already laid out per the dp plan's input
+                    # shardings; dispatch's spmd.put then no-ops
+                    data = sp.put(n, data)
+                feed[n] = NDArray(data)
+            return feed, pad
+
+        if self._stager is None:
+            self._stager = _staging.DeviceStager()
+        accepted = self._stager.stage(
+            data_batch, prep,
+            # a reshape swaps the executor: its staged layout is stale
+            guard=lambda: self._exec is exec_ref)
+        if accepted:
+            self._staged_meta.append(
+                (data_batch, {"short_shape": short_shape, "bound": bound}))
+            del self._staged_meta[:-self._stager.depth - 2]
+        return accepted
+
+    def _consume_staged(self, data_batch):
+        """The staged feed for this exact batch (device-resident, already
+        padded/cast/placed), applying the same pad/hysteresis state
+        `_make_feed` would have set — or None (lockstep fallback)."""
+        st = self._stager
+        if st is None or isinstance(data_batch, list):
+            return None
+        meta = None
+        for i, (b, m) in enumerate(self._staged_meta):
+            if b is data_batch:
+                meta = m
+                del self._staged_meta[:i + 1]  # drop stale earlier entries
+                break
+        if meta is None:
+            return None
+        hit = st.take(data_batch)
+        if hit is None:
+            return None
+        feed, pad = hit
+        self._pad = pad
+        if pad:
+            self._pad_bound = meta["bound"]
+        self._last_short_shape = meta["short_shape"]
+        return feed
+
+    def retire_staged(self):
+        st = self._stager
+        return st.retire() if st is not None else False
+
+    def _overlap_teardown(self):
+        st = self._stager
+        if st is not None:
+            self._stager = None
+            self._staged_meta = []
+            st.close()
 
     # -- checkpoint ----------------------------------------------------------
 

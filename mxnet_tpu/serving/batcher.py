@@ -37,8 +37,10 @@ from concurrent.futures import Future
 
 from .. import analysis
 from .. import ndarray as nd
+from .. import observatory
 from .. import telemetry
 from .. import tracing
+from ..io import staging as _staging
 from ..base import getenv, register_env
 from ..log import get_logger
 from ..resilience import retry_call
@@ -217,26 +219,54 @@ class DynamicBatcher:
         return fut
 
     def _loop(self):
+        # overlap lane (MXNET_OVERLAP=1): while a flush executes on
+        # device, the worker preps the NEXT one — `_execute_prep` calls
+        # `_stage_next` between forward dispatch and drain, so the staged
+        # prep's concat/pad/placement rides under the in-flight compute.
+        # A staged prep is executed on the next loop turn (after a
+        # deadline re-sweep); MXNET_OVERLAP=0 never stages.
+        staged = None
         while True:
+            if staged is not None:
+                prep, staged = staged, None
+                prep = self._resweep_staged(prep)
+                if prep is None:
+                    continue
+                staged = self._execute_prep_guarded(prep, stage=True)
+                continue
             batch, reason = self._admission.get_batch(
                 self._max_batch, self._max_wait_s)
             if batch is None:
                 return
-            self._run_batch_guarded(batch, reason)
+            staged = self._run_batch_guarded(batch, reason, stage=True)
 
-    def _run_batch_guarded(self, batch, reason):
+    def _run_batch_guarded(self, batch, reason, stage=None):
         """_run_batch with the never-strand guarantee: an unexpected bug in
         the batching/delivery path fails every popped future instead of
         killing the worker — or, on the assist path, instead of leaking
         batch-mates' futures (popped, so no one else would run them) while
-        the exception propagates to the one assisting caller."""
+        the exception propagates to the one assisting caller. Returns the
+        prep staged mid-flight, if any (worker loop only; the assist path
+        never stages — it is a borrowed caller thread)."""
         try:
-            self._run_batch(batch, reason)
+            return self._run_batch(batch, reason, stage=stage)
         except Exception as e:  # noqa: BLE001
             for r in batch:
                 if not r.origin.future.done():
                     self._fail(r, e)
             self._logger.error("serving batch failed unexpectedly: %r", e)
+            return None
+
+    def _execute_prep_guarded(self, prep, stage=None):
+        """Never-strand wrapper for executing an already-prepared flush."""
+        try:
+            return self._execute_prep(prep, stage=stage)
+        except Exception as e:  # noqa: BLE001
+            for r in prep["live"]:
+                if not r.origin.future.done():
+                    self._fail(r, e)
+            self._logger.error("serving batch failed unexpectedly: %r", e)
+            return None
 
     def _fail(self, req, exc, timeout=False):
         """Fail the request a piece belongs to (once — later pieces of a
@@ -288,7 +318,18 @@ class DynamicBatcher:
                 telemetry.histogram("serving.e2e_us").record(
                     (done_ts - orig.enqueued_at) * 1e6)
 
-    def _run_batch(self, reqs, reason):
+    def _run_batch(self, reqs, reason, stage=None):
+        prep = self._prepare_batch(reqs, reason)
+        if prep is None:
+            return None
+        return self._execute_prep(prep, stage=stage)
+
+    def _prepare_batch(self, reqs, reason, staged=False, requeued=False):
+        """Everything host-side a flush needs BEFORE dispatch: deadline
+        filter, queue telemetry/spans, feed concat — and, for a staged
+        prep (overlap lane), the pad up to the bucket, so the predictor's
+        own pad is a no-op and the transfer happened off the critical
+        path. Returns a prep dict or None when nothing stayed live."""
         tele = telemetry._enabled
         now = time.monotonic()
         live = []
@@ -300,43 +341,116 @@ class DynamicBatcher:
             elif not r.origin.future.done():
                 live.append(r)
         if not live:
-            return
-        if tele:
+            return None
+        if tele and not requeued:
             for r in live:
                 telemetry.histogram("serving.time_in_queue_us").record(
                     (now - r.enqueued_at) * 1e6)
         rows = sum(r.rows for r in live)
         bucket = self._predictor.bucket_for(rows)
+        if tracing._enabled:
+            # per-request queue spans (submit -> this pop) + the flow
+            # arrow landing in this batch's slice
+            t_pop = tracing.now_us()
+            for r in live:
+                sp = r.origin.span
+                if sp is None:
+                    continue
+                if not r.traced_queue:
+                    r.traced_queue = True
+                    tracing.emit_span("serving.queue", sp.t0,
+                                      t_pop - sp.t0, cat="serving",
+                                      parent=sp, offset=r.offset,
+                                      rows=r.rows)
+                if not r.origin.flow_ended:
+                    # one arrow per REQUEST: split pieces share the
+                    # origin's flow id, so only the first batch a
+                    # request lands in terminates the flow
+                    r.origin.flow_ended = True
+                    tracing.flow_end(sp.span_id, name="serving.request")
+        feeds = []
+        for i in range(len(self._predictor.data_names)):
+            parts = [r.arrays[i] for r in live]
+            feeds.append(parts[0] if len(parts) == 1
+                         else nd.concatenate(parts, axis=0))
+        if staged:
+            from ..io.io import pad_arrays
+
+            feeds, _ = pad_arrays(feeds, bucket)
+        earliest = min((r.deadline for r in live
+                        if r.deadline is not None), default=None)
+        return {"live": live, "reason": reason, "rows": rows,
+                "bucket": bucket, "feeds": feeds, "earliest": earliest,
+                "staged": staged, "t0": time.perf_counter()}
+
+    def _resweep_staged(self, prep):
+        """A staged prep sat out one flush: re-sweep its deadlines before
+        dispatch. Expired requests fail here; survivors are re-prepared
+        (their rows no longer pad the batch) exactly like the post-timeout
+        re-run in `_execute_prep`."""
+        now = time.monotonic()
+        live = prep["live"]
+        expired = [r for r in live
+                   if r.deadline is not None and now >= r.deadline]
+        if not expired and all(not r.origin.future.done() for r in live):
+            return prep
+        for r in expired:
+            self._fail(r, DeadlineExceededError(
+                "request expired while staged for the next flush"),
+                timeout=True)
+        rest = [r for r in live if r not in expired]
+        if not rest:
+            return None
+        return self._prepare_batch(rest, prep["reason"], staged=True,
+                                   requeued=True)
+
+    def _stage_next(self):
+        """Pop + prepare the NEXT flush while the current one executes —
+        called between forward dispatch and drain, so the prep's
+        concat/pad/device placement hides under in-flight compute. Only a
+        FULL flush already queued is staged: a partial queue keeps its
+        ``max_wait`` coalescing window (identical batch shaping to
+        lockstep), and an empty one has nothing to hide."""
+        try:
+            if self._admission._rows < self._max_batch:
+                return None
+            batch, reason = self._admission.get_batch_nowait(self._max_batch)
+            if batch is None:
+                return None
+            if telemetry._enabled:
+                telemetry.counter("serving.staged_flushes").inc()
+            prep = self._prepare_batch(batch, reason, staged=True)
+            if prep is None:
+                return None
+            return prep
+        except Exception as e:  # noqa: BLE001 — never fail the IN-FLIGHT
+            # batch because the NEXT one failed to stage; its requests die
+            # here, already popped and unrunnable by anyone else
+            self._logger.error("serving stage-ahead failed: %r", e)
+            return None
+
+    def _execute_prep(self, prep, stage=None):
+        """Dispatch, (overlap) stage the next flush, drain, deliver.
+        Returns the prep staged mid-flight, or None."""
+        tele = telemetry._enabled
         trc = tracing._enabled
+        live, reason = prep["live"], prep["reason"]
+        rows, bucket = prep["rows"], prep["bucket"]
+        feeds, earliest = prep["feeds"], prep["earliest"]
+        # staged preps overlapped their prepare; their wall starts at
+        # dispatch. Lockstep walls include the prepare they paid inline.
+        t_wall0 = time.perf_counter() if prep["staged"] else prep["t0"]
+        staged_box = [None]
+        # the dispatch/drain split honors the `_run` seam: an instance
+        # with `_run` patched over (test gates, wrappers) keeps the
+        # lockstep call so the patch still sees every forward
+        stage_fn = self._stage_next if (
+            stage and _staging.overlap_enabled()
+            and "_run" not in self._predictor.__dict__) else None
+        state = {"first": stage_fn is not None}
         with tracing.span("serving.batch", cat="serving", rows=rows,
-                          bucket=bucket, reason=reason):
-            if trc:
-                # per-request queue spans (submit -> this pop) + the flow
-                # arrow landing in this batch's slice
-                t_pop = tracing.now_us()
-                for r in live:
-                    sp = r.origin.span
-                    if sp is None:
-                        continue
-                    if not r.traced_queue:
-                        r.traced_queue = True
-                        tracing.emit_span("serving.queue", sp.t0,
-                                          t_pop - sp.t0, cat="serving",
-                                          parent=sp, offset=r.offset,
-                                          rows=r.rows)
-                    if not r.origin.flow_ended:
-                        # one arrow per REQUEST: split pieces share the
-                        # origin's flow id, so only the first batch a
-                        # request lands in terminates the flow
-                        r.origin.flow_ended = True
-                        tracing.flow_end(sp.span_id, name="serving.request")
-            feeds = []
-            for i in range(len(self._predictor.data_names)):
-                parts = [r.arrays[i] for r in live]
-                feeds.append(parts[0] if len(parts) == 1
-                             else nd.concatenate(parts, axis=0))
-            earliest = min((r.deadline for r in live
-                            if r.deadline is not None), default=None)
+                          bucket=bucket, reason=reason,
+                          staged=prep["staged"]):
 
             def attempt():
                 # a retry must never run past the batch's earliest
@@ -345,6 +459,13 @@ class DynamicBatcher:
                 if earliest is not None and time.monotonic() >= earliest:
                     raise DeadlineExceededError(
                         "deadline passed before a (re)try could run")
+                if state["first"]:
+                    # overlap lane: host work (staging the next flush)
+                    # between dispatch and drain, not before dispatch
+                    state["first"] = False
+                    pending = self._predictor._run_dispatch(bucket, feeds)
+                    staged_box[0] = stage_fn()
+                    return self._predictor._run_wait(pending)
                 return self._predictor._run(bucket, feeds)
 
             t_exec0 = tracing.now_us() if trc else 0.0
@@ -367,11 +488,11 @@ class DynamicBatcher:
                     # the expired requests (their rows no longer pad the
                     # batch)
                     self._run_batch(rest, reason)
-                return
+                return staged_box[0]
             except Exception as e:  # noqa: BLE001 — fail batch, keep serving
                 for r in live:
                     self._fail(r, e)
-                return
+                return staged_box[0]
             if trc:
                 # each request's view of the shared compute window: one
                 # execute child per request makes every request tree
@@ -397,3 +518,10 @@ class DynamicBatcher:
                 sliced = [o[off:off + r.rows] for o in outs]
                 off += r.rows
                 self._deliver(r, sliced, done_ts)
+            if observatory._enabled:
+                # the flush WALL (prep + dispatch + drain + deliver, minus
+                # whatever staging hid); the predictor observed exec_s —
+                # their gap is the serving lane's host_gap_us
+                observatory.observe(
+                    "serving", wall_s=time.perf_counter() - t_wall0)
+        return staged_box[0]

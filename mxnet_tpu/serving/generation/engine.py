@@ -76,6 +76,7 @@ from ... import telemetry
 from ... import tracing
 from ...base import MXNetError, getenv, register_env
 from ...compile_cache import CompileCache
+from ...io import staging as _staging
 from ...log import get_logger
 from ..admission import AdmissionQueue, DeadlineExceededError, Request
 from ..health import attach_engine, queue_ready
@@ -904,6 +905,7 @@ class GenerationEngine:
         tele = telemetry._enabled
         obs = observatory._enabled
         decoded = False
+        dec_s = None
         t0 = time.perf_counter()
         # the tick's own span tree (admit/decode children via the context
         # var; per-SESSION spans keep their explicit session parents) —
@@ -913,18 +915,57 @@ class GenerationEngine:
                                  live=self._live, queued=len(self._queue))
         with tick_span:
             try:
-                now = time.monotonic()
-                for req in self._queue.expire(now):
-                    self._fail_queued(req.payload, now)
-                for slot, sess in enumerate(self._sessions):
-                    if (sess is not None and sess.deadline is not None
-                            and now >= sess.deadline):
-                        self._evict(slot, "deadline", DeadlineExceededError(
-                            f"session deadline passed after "
-                            f"{sess.generated} generated token(s)"))
-                self._admit()
-                decoded = self._live > 0
-                self._decode()
+                if _staging.overlap_enabled():
+                    # overlap order: dispatch the decode FIRST, do the
+                    # host bookkeeping (queue expiry, deadline sweep,
+                    # admission scan) while the executable runs, THEN
+                    # block and commit — the tick's host work hides
+                    # behind device time instead of serializing ahead of
+                    # it. Sessions evicted or replaced inside that window
+                    # are identity-guarded at commit (their tokens are
+                    # discarded; the stale slab rows are masked garbage
+                    # the next occupant's prefill overwrites). Admitted
+                    # prefills chain on the still-lazy decode cache
+                    # outputs, so they join the NEXT tick's decode —
+                    # per-session token streams stay bit-exact with the
+                    # lockstep order below.
+                    decoded = self._live > 0
+                    t_dec = time.perf_counter()
+                    pending = self._decode_dispatch()
+                    now = time.monotonic()
+                    for req in self._queue.expire(now):
+                        self._fail_queued(req.payload, now)
+                    for slot, sess in enumerate(self._sessions):
+                        if (sess is not None and sess.deadline is not None
+                                and now >= sess.deadline):
+                            self._evict(
+                                slot, "deadline", DeadlineExceededError(
+                                    f"session deadline passed after "
+                                    f"{sess.generated} generated token(s)"))
+                    self._admit()
+                    if pending is not None:
+                        self._decode_commit(pending)
+                    # the dispatch→commit window: the swept bookkeeping
+                    # rides INSIDE it, so wall − dec_s (the lane's
+                    # host_gap_us) is exactly the host work the overlap
+                    # order still leaves outside device time
+                    dec_s = time.perf_counter() - t_dec
+                else:
+                    now = time.monotonic()
+                    for req in self._queue.expire(now):
+                        self._fail_queued(req.payload, now)
+                    for slot, sess in enumerate(self._sessions):
+                        if (sess is not None and sess.deadline is not None
+                                and now >= sess.deadline):
+                            self._evict(
+                                slot, "deadline", DeadlineExceededError(
+                                    f"session deadline passed after "
+                                    f"{sess.generated} generated token(s)"))
+                    self._admit()
+                    decoded = self._live > 0
+                    t_dec = time.perf_counter()
+                    self._decode()
+                    dec_s = time.perf_counter() - t_dec
                 if len(self._param_sets) > 1:
                     # a swap transition is draining: release versions
                     # whose last session just finished
@@ -974,7 +1015,8 @@ class GenerationEngine:
                    if self._spec_k else
                    ("decode", self._slots, self._slab_len))
             observatory.observe("generation.tick", self._cache, key,
-                                wall_s=time.perf_counter() - t0)
+                                wall_s=time.perf_counter() - t0,
+                                exec_s=dec_s)
         if tele:
             dt = time.perf_counter() - t0
             telemetry.counter("serving.generation.ticks").inc()
@@ -1223,19 +1265,35 @@ class GenerationEngine:
         cohort with that cohort's pinned params, other cohorts' slots
         steered to the safe row — N dispatches, zero new programs, and
         every session's output stays bit-exact with an unswapped engine
-        on its own weights."""
+        on its own weights.
+
+        Split into :meth:`_decode_dispatch` (launch the executables,
+        tokens still lazy) and :meth:`_decode_commit` (block + deliver)
+        so the overlap tick can do its host bookkeeping between the two;
+        this method is the back-to-back composition."""
+        pending = self._decode_dispatch()
+        if pending is not None:
+            self._decode_commit(pending)
+
+    def _decode_dispatch(self):
+        """Dispatch the decode (or verify) executable once per version
+        cohort WITHOUT materializing the token output. Cohort dispatch
+        order and inputs are identical to the fused path: a later
+        cohort's call only reads the earlier ones' cache outputs (pure
+        lazy dataflow) and every non-member slot is steered to the safe
+        row, so committing before or after the remaining dispatches is
+        bit-equivalent. Returns the pending state for
+        :meth:`_decode_commit`, or None when no slot is live."""
         import jax.numpy as jnp
 
         if self._live == 0:
-            return
+            return None
         if self._spec_k:
-            self._spec_decode()
-            return
+            return self._spec_dispatch()
         fn = self._decode_fn()
         cohorts = self._cohorts()
         mixed = len(cohorts) > 1
-        trc = tracing._enabled
-        live = 0
+        pending = []
         for version in sorted(cohorts):
             slots = cohorts[version]
             with tracing.span("generation.decode", cat="generation",
@@ -1245,12 +1303,32 @@ class GenerationEngine:
                     jnp.asarray(self._last_tok),
                     jnp.asarray(self._tick_positions(
                         slots if mixed else None)))
-                toks = np.asarray(toks)
+            # snapshot the cohort's sessions: a slot evicted or re-
+            # admitted between dispatch and commit fails the identity
+            # check and its token is discarded
+            pending.append((slots, [self._sessions[s] for s in slots],
+                            toks))
+        return ("plain", pending)
+
+    def _decode_commit(self, state):
+        """Block on the dispatched token outputs and commit them:
+        deliver one token per still-live slot, advance lengths, evict
+        terminal sessions. A slot whose session changed since dispatch
+        (overlap-window evict/re-admit) is skipped — its slab write is
+        masked garbage the next prefill overwrites."""
+        kind, pending = state
+        if kind == "spec":
+            self._spec_commit(pending)
+            return
+        trc = tracing._enabled
+        live = 0
+        for slots, snap, toks in pending:
+            toks = np.asarray(toks)
             if trc:
                 t_us = tracing.now_us()
-            for slot in slots:
+            for slot, dispatched in zip(slots, snap):
                 sess = self._sessions[slot]
-                if sess is None:
+                if sess is None or sess is not dispatched:
                     continue
                 live += 1
                 # the token we fed now occupies position lengths[slot]
@@ -1269,14 +1347,10 @@ class GenerationEngine:
         if telemetry._enabled:
             telemetry.counter("serving.generation.decode_tokens").inc(live)
 
-    def _spec_decode(self):
-        """The speculative verify tick: draft proposes k tokens per live
-        slot, ONE verify executable checks all of them, each slot commits
-        the longest agreeing draft prefix plus the target's next token
-        (1..k+1 tokens) and rolls the rest back by NOT advancing its
-        position past the last commit — the rejected rows beyond the new
-        frontier are never attended and the next tick overwrites them in
-        order before they could be."""
+    def _spec_dispatch(self):
+        """Speculative half of :meth:`_decode_dispatch`: draft proposes,
+        the verify executable is dispatched per cohort, tokens stay
+        lazy. Returns the pending state for :meth:`_spec_commit`."""
         import jax.numpy as jnp
 
         k = self._spec_k
@@ -1290,9 +1364,7 @@ class GenerationEngine:
         fn = self._verify_fn()
         cohorts = self._cohorts()
         mixed = len(cohorts) > 1
-        tele = telemetry._enabled
-        trc = tracing._enabled
-        live = accepted = committed_total = 0
+        pending = []
         for version in sorted(cohorts):
             slots = cohorts[version]
             with tracing.span("generation.verify", cat="generation",
@@ -1302,12 +1374,29 @@ class GenerationEngine:
                     jnp.asarray(tokens),
                     jnp.asarray(self._tick_positions(
                         slots if mixed else None)))
-                toks = np.asarray(toks)                         # [S, k+1]
+            pending.append((slots, [self._sessions[s] for s in slots],
+                            toks))
+        return ("spec", (props, pending))
+
+    def _spec_commit(self, state):
+        """Block on the dispatched verify outputs and commit: each
+        still-live slot takes the longest agreeing draft prefix plus the
+        target's next token (1..k+1 tokens), rolling the rest back by
+        NOT advancing its position past the last commit — the rejected
+        rows beyond the new frontier are never attended and the next
+        tick overwrites them in order before they could be."""
+        props, pending = state
+        k = self._spec_k
+        tele = telemetry._enabled
+        trc = tracing._enabled
+        live = accepted = committed_total = 0
+        for slots, snap, toks in pending:
+            toks = np.asarray(toks)                             # [S, k+1]
             if trc:
                 t_us = tracing.now_us()
-            for slot in slots:
+            for slot, dispatched in zip(slots, snap):
                 sess = self._sessions[slot]
-                if sess is None:
+                if sess is None or sess is not dispatched:
                     continue
                 live += 1
                 t = toks[slot]

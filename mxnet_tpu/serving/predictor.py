@@ -362,29 +362,45 @@ class Predictor:
         rows). Outputs are materialized before delivery so an execution
         failure surfaces HERE — retryable and attributable — never in a
         caller thread touching a lazy value later."""
-        import jax
+        return self._run_wait(self._run_dispatch(bucket, arrays))
 
+    def _run_dispatch(self, bucket, arrays):
+        """Dispatch half of :meth:`_run`: pad + forward, NO drain. The
+        returned pending handle must be settled with :meth:`_run_wait`;
+        between the two the caller owns the host — the batcher's overlap
+        lane stages its NEXT flush there while this one executes."""
         exec_ = self._bind_bucket(bucket)
         with tracing.span("serving.pad", cat="serving", bucket=bucket):
             padded, _ = pad_arrays(list(arrays), bucket)
         feed = dict(zip(self._data_names, padded))
-        tele = telemetry._enabled
-        obs = observatory._enabled
-        t0 = time.perf_counter() if tele or obs else 0.0
+        t0 = time.perf_counter() if telemetry._enabled \
+            or observatory._enabled else 0.0
         with self._lock, tracing.span("serving.forward", cat="serving",
                                       bucket=bucket):
             outs = list(exec_.forward(is_train=False, **feed))
-            jax.block_until_ready([o._data for o in outs])
+        return outs, padded, exec_, t0
+
+    def _run_wait(self, pending):
+        """Drain a :meth:`_run_dispatch` handle: block on the outputs so
+        an execution failure surfaces here (retryable), then account the
+        batch. ``exec_s`` spans dispatch->drained — the honest device
+        window; the flush WALL is the batcher's to observe, so the
+        serving lane's host gap reflects what staging actually hides."""
+        import jax
+
+        outs, padded, exec_, t0 = pending
+        jax.block_until_ready([o._data for o in outs])
         # in-flight batch residency: weak refs, swept as batches retire
         memory.track_transient("serving_batches", padded + outs)
+        tele = telemetry._enabled
+        obs = observatory._enabled
         dt = time.perf_counter() - t0 if tele or obs else 0.0
         if tele:
             telemetry.histogram("serving.compute_us").record(dt * 1e6)
         if obs:
-            # block_until_ready above makes dt an honest device window;
             # the executor recorded which compiled entry this forward hit
             observatory.observe("serving", self._cache, exec_._last_fwd_key,
-                                wall_s=dt, exec_s=dt)
+                                exec_s=dt)
         return outs
 
     # -- weight rollout ------------------------------------------------------

@@ -319,6 +319,22 @@ def snapshot():
     compute = out["counters"].get("io.prefetch_compute_us_total", 0.0)
     if wait + compute > 0:
         out["derived"]["io.starvation_ratio"] = wait / (wait + compute)
+    swait = out["counters"].get("io.stage_wait_us_total", 0.0)
+    sprep = out["counters"].get("io.stage_prep_us_total", 0.0)
+    if swait + sprep > 0:
+        # time the consumer blocked on the staging thread over total
+        # staging time — near 0 means batches are fully prepared behind
+        # device compute, near 1 means staging isn't hiding anything
+        # (docs/faq/perf.md "Closing the host gap")
+        out["derived"]["io.stage_wait_ratio"] = swait / (swait + sprep)
+    step_wall = out["counters"].get("step.wall_us_total", 0.0)
+    if step_wall > 0:
+        # every host-side input stall a step can see — prefetch wait plus
+        # stage wait — over step wall time: the one number that says how
+        # much of the run the input pipeline cost (composes PrefetchingIter
+        # starvation with DeviceStager waits)
+        out["derived"]["io.pipeline_stall_ratio"] = min(
+            (wait + swait) / step_wall, 1.0)
     hits = out["counters"].get("compile.cache_hits", 0)
     misses = out["counters"].get("compile.cache_misses", 0)
     if hits + misses > 0:

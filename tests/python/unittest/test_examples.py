@@ -34,6 +34,7 @@ def test_train_mnist_example():
     assert acc > 0.9, out[-500:]
 
 
+@pytest.mark.slow
 def test_sparse_linear_example():
     out = _run("sparse/linear_classification.py", "--num-features", "20000",
                "--epochs", "3")

@@ -640,6 +640,7 @@ def test_router_prefix_affinity(lm64, tele):
             assert s2.cached_prefix_len == 0
 
 
+@pytest.mark.slow
 def test_router_scale_to_and_autoscale(lm64, tele):
     """scale_to grows from the factory (warmed) and drains surplus
     replicas with zero dropped sessions; bind_autoscale wires the

@@ -26,7 +26,8 @@ def test_resnet(name):
     _check(name, 224, classes=10)
 
 
-@pytest.mark.parametrize("name", ["vgg11", "vgg11_bn"])
+@pytest.mark.parametrize(
+    "name", ["vgg11", pytest.param("vgg11_bn", marks=pytest.mark.slow)])
 def test_vgg(name):
     _check(name, 224, classes=10)
 

@@ -63,8 +63,16 @@ GOLDEN_COUNTS = [
 ]
 
 
-@pytest.mark.parametrize("name,size,expect", GOLDEN_COUNTS,
-                         ids=[c[0] for c in GOLDEN_COUNTS])
+# The two big-image builds dominate this file's wall time; they stay in
+# the full CI unit lane but sit out the tier-1 fast lane.
+_SLOW_GOLDEN = {"vgg11_bn", "densenet121"}
+
+
+@pytest.mark.parametrize(
+    "name,size,expect",
+    [pytest.param(*c, id=c[0],
+                  marks=[pytest.mark.slow] if c[0] in _SLOW_GOLDEN else [])
+     for c in GOLDEN_COUNTS])
 def test_param_count_golden(name, size, expect):
     got = _param_count(name, size)
     assert got == expect, f"{name}: {got} params, expected {expect}"

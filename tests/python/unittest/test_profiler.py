@@ -4,6 +4,7 @@
 import json
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import profiler
@@ -17,6 +18,7 @@ def _run_ops():
     return c
 
 
+@pytest.mark.slow
 def test_per_op_events_recorded(tmp_path):
     profiler.set_config(filename=str(tmp_path / "p.json"),
                         aggregate_stats=False)

@@ -155,6 +155,7 @@ def test_big_embedding_trains_without_densify():
         assert not g.densified(), "dense view of the 1M-row grad was materialized"
 
 
+@pytest.mark.slow
 def test_sparse_linear_classification():
     """Port of `example/sparse/linear_classification/train.py` as an
     accuracy-threshold test: logistic regression over sparse categorical

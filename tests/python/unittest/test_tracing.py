@@ -554,6 +554,7 @@ def test_prom_text_format():
     assert "mxnet_memory_weights_bytes" in text2
 
 
+@pytest.mark.slow
 def test_http_endpoint_serves_metrics_trace_memory(trc):
     with tracing.span("http.test"):
         pass

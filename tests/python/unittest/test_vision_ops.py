@@ -189,6 +189,7 @@ def test_correlation_self_is_squared_norm():
     assert np.allclose(out.asnumpy(), expect, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_correlation_shapes_and_grad():
     rng = np.random.RandomState(7)
     a = mx.nd.array(rng.randn(1, 2, 8, 8).astype(np.float32))

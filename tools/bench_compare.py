@@ -59,6 +59,17 @@ METRICS = [
     ("spmd.parity_rel", "spmd whole-run parity rel", "down"),
     ("spmd.cold_compile_s", "spmd cold compile s", "down"),
     ("framework_module_compile_s", "module compile s", "down"),
+    # host-gap rows (ISSUE 19): wall − exec per lane, the host-side work
+    # still serializing with device compute — direction-aware so a
+    # regrown gap (someone re-adding a sync point to the hot loop) trips
+    # the diff even though throughput may hide it in noise
+    ("host_gap_us", "train step host gap us", "down"),
+    ("serving.host_gap_us", "serving host gap us", "down"),
+    ("generation.host_gap_us", "generation tick host gap us", "down"),
+    ("overlap.train.on.host_gap_us", "overlap train host gap us", "down"),
+    ("overlap.serving.on.host_gap_us", "overlap serving host gap us", "down"),
+    ("overlap.generation.on.host_gap_us",
+     "overlap generation host gap us", "down"),
 ]
 
 # roofline utilisation rows (bench.py stamps them per lane from the
@@ -155,6 +166,37 @@ def compare_hlolint(old, new, write):
     return regressions
 
 
+def compare_overlap(new, write):
+    """Within-record overlap invariants (bench.py's ``overlap`` lane
+    measures both modes on the SAME run, so NEW is self-contained):
+    per plane, ``on.host_gap_us`` must sit below ``off.host_gap_us``
+    and parity must be bit-exact. Returns the regression list."""
+    regressions = []
+    lane = new.get("overlap")
+    if not isinstance(lane, dict):
+        return regressions
+    for plane in ("train", "serving", "generation"):
+        sub = lane.get(plane)
+        if not isinstance(sub, dict):
+            continue
+        off = get(sub, "off.host_gap_us")
+        on = get(sub, "on.host_gap_us")
+        if off is not None and on is not None:
+            label = f"overlap {plane} gap on<off"
+            bad = on >= off and off > 0
+            verdict = "REGRESSION (hard)" if bad else "ok"
+            write(f"{label:<34}{off:>12.1f}{on:>12.1f}{'':>9}  {verdict}\n")
+            if bad:
+                regressions.append((label, off, on, 0.0))
+        parity = sub.get("parity")
+        if parity is not None and parity != "bit-exact":
+            label = f"overlap {plane} parity"
+            write(f"{label:<34}{'bit-exact':>12}{str(parity)[:12]:>12}"
+                  f"{'':>9}  REGRESSION (hard)\n")
+            regressions.append((label, "bit-exact", parity, 0.0))
+    return regressions
+
+
 # nonzero in NEW = broken compile-once contract, whatever OLD said
 INVARIANTS = [
     ("serving.steady_state_compiles", "serving steady-state compiles"),
@@ -170,6 +212,14 @@ INVARIANTS = [
     ("serving.swap_steady_state_compiles",
      "weight-swap steady-state compiles"),
     ("serving.swap_errors", "weight-swap request errors"),
+    ("overlap.train.on.steady_state_compiles",
+     "overlap train steady-state compiles"),
+    ("overlap.train.off.steady_state_compiles",
+     "lockstep train steady-state compiles"),
+    ("overlap.serving.on.steady_state_compiles",
+     "overlap serving steady-state compiles"),
+    ("overlap.generation.on.steady_state_compiles",
+     "overlap generation steady-state compiles"),
 ]
 
 
@@ -242,6 +292,7 @@ def main(argv=None):
                          f"{delta * 100:>8.1f}%  {verdict}\n")
     regressions.extend(compare_roofline(old, new, sys.stdout.write))
     regressions.extend(compare_hlolint(old, new, sys.stdout.write))
+    regressions.extend(compare_overlap(new, sys.stdout.write))
     for path, label in INVARIANTS:
         n = get(new, path)
         if n is None:

@@ -58,6 +58,12 @@ METRICS = [
     ("generation.tokens_per_s", "up"),
     ("generation.ttft_p99_ms", "down"),
     ("generation.tick_mbu", "up"),
+    ("train.host_gap_us", "down"),
+    ("serving.host_gap_us", "down"),
+    ("generation.host_gap_us", "down"),
+    ("overlap.train_host_gap_us", "down"),
+    ("overlap.serving_host_gap_us", "down"),
+    ("overlap.generation_host_gap_us", "down"),
     ("lazy.lazy_vs_eager", "up"),
     ("lazy_fused.rewrite_speedup", "up"),
     ("lazy_fused.compile_speedup", "up"),
@@ -140,6 +146,7 @@ def record_from_bench(rec, source="bench.py", historical=False):
         ("img_per_s", "framework_module_fused"),
         ("mfu", "mfu"), ("mbu", "mbu"),
         ("predicted_floor_s", "predicted_floor_s"),
+        ("host_gap_us", "host_gap_us"),
     ])
     if "train" not in lanes or "img_per_s" not in lanes.get("train", {}):
         # historical schema: headline value was the gluon path, MFU was
@@ -157,12 +164,23 @@ def record_from_bench(rec, source="bench.py", historical=False):
         ("req_per_s", "req_per_s"), ("p99_ms", "p99_ms"),
         ("mfu", "mfu"), ("mbu", "mbu"),
         ("predicted_floor_s", "predicted_floor_s"),
+        ("host_gap_us", "host_gap_us"),
     ])
     _lane(lanes, "generation", rec.get("generation"), [
         ("tokens_per_s", "tokens_per_s"), ("ttft_p99_ms", "ttft_p99_ms"),
         ("tick_mbu", "tick_mbu"), ("mfu", "mfu"),
         ("predicted_floor_s", "predicted_floor_s"),
+        ("host_gap_us", "host_gap_us"),
     ])
+    ovl = rec.get("overlap") if isinstance(rec.get("overlap"), dict) else {}
+    flat_ovl = {}
+    for plane in ("train", "serving", "generation"):
+        sub = ovl.get(plane)
+        on = sub.get("on") if isinstance(sub, dict) else None
+        v = _num(on.get("host_gap_us")) if isinstance(on, dict) else None
+        if v is not None:
+            flat_ovl[plane + "_host_gap_us"] = v
+    _lane(lanes, "overlap", flat_ovl, [(k, k) for k in flat_ovl])
     _lane(lanes, "lazy", rec.get("lazy"), [("lazy_vs_eager", "lazy_vs_eager")])
     _lane(lanes, "lazy_fused", rec.get("lazy_fused"), [
         ("rewrite_speedup", "rewrite_speedup"),

@@ -132,6 +132,29 @@ def main(argv=None):
             f"dropped {t_dropped} events (buffer overflow); traces from "
             "this process are INCOMPLETE. Raise profiler max_events / "
             "MXNET_TRACING_MAX_EVENTS or dump more often.\n")
+    staged = counters.get("overlap.staged_batches", 0)
+    overlap_steps = counters.get("overlap.steps", 0)
+    if staged or overlap_steps:
+        derived = snap.get("derived", {})
+        line = (f"\nstage: {staged} batches device-staged over "
+                f"{overlap_steps} overlapped steps")
+        fb = counters.get("overlap.fallback_batches", 0)
+        full = counters.get("io.stage_ring_full", 0)
+        if fb or full:
+            line += f"; fallbacks {fb}, ring-full refusals {full}"
+        swait = counters.get("io.stage_wait_us_total", 0)
+        sprep = counters.get("io.stage_prep_us_total", 0)
+        line += (f"; wait {swait / 1e3:.1f}ms / prep {sprep / 1e3:.1f}ms")
+        ratio = derived.get("io.stage_wait_ratio")
+        if ratio is not None:
+            line += f" (stage_wait_ratio {ratio:.2f})"
+        stall = derived.get("io.pipeline_stall_ratio")
+        if stall is not None:
+            line += f"; pipeline_stall_ratio {stall:.2f}"
+        line += ("\n  (stage_wait_ratio near 1 = staging hides nothing; "
+                 "pipeline_stall_ratio = all input waits over step wall; "
+                 "docs/faq/perf.md \"Closing the host gap\")\n")
+        sys.stdout.write(line)
     req = counters.get("serving.requests", 0)
     if req:
         hists = snap.get("histograms", {})
