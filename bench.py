@@ -1508,6 +1508,125 @@ def _measure_generation(on_tpu):
     }
 
 
+def _measure_qos(on_tpu):
+    """qos_isolation probe: an interactive tenant's TTFT under a batch
+    tenant's flood, with and without the QoS layer.
+
+    Three phases on the same tiny TransformerLM engine shape:
+
+    * **unloaded** — interactive sessions alone; TTFT p50/p99 baseline.
+    * **FIFO flood** — QoS off: 2x-slots batch sessions saturate the
+      slab AND the queue, then an interactive trickle queues behind
+      them. FIFO makes its TTFT the flood's drain time — the
+      multi-tenant failure this lane exists to demonstrate (recorded as
+      ``fifo_interactive_ttft_p99_ms``; it grows with flood depth).
+    * **QoS flood** — the same flood through an engine built under an
+      installed registry (``latency:interactive; bulk:batch``): the
+      queue reorders by class, the engine parks a batch session per
+      park slot (``preemptions`` counts them), and the trickle's
+      ``interactive_ttft_p99_ms`` stays within a small multiple of the
+      unloaded baseline (``ttft_degradation``, direction-pinned by
+      ``tools/bench_compare.py``).
+
+    Asserts zero steady-state compiles on the QoS engine: park/preempt/
+    resume ride the warmed fork executable, so multi-tenancy adds no
+    compile churn (``qos_steady_state_compiles``)."""
+    import threading
+
+    import numpy as np
+
+    import jax
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu import serving, telemetry
+    from mxnet_tpu.models import TransformerLM, TransformerLMConfig
+    from mxnet_tpu.serving import qos
+    from mxnet_tpu.serving.generation import GenerationEngine
+
+    mesh = par.create_mesh(devices=jax.devices()[:1], dp=1)
+    cfg = TransformerLMConfig(
+        vocab_size=256, d_model=64, n_heads=4, d_ff=128, n_layers=2,
+        max_len=128, dtype="bfloat16" if on_tpu else "float32")
+    lm = TransformerLM(cfg, mesh)
+    params = lm.init_params(jax.random.PRNGKey(0))
+    slots, buckets = 4, (8, 16, 32)
+    rng = np.random.RandomState(0)
+    flood_n = 2 * slots
+    trickle_n = 5
+    flood_prompts = [rng.randint(1, cfg.vocab_size, 12).astype(np.int32)
+                     for _ in range(flood_n)]
+    inter_prompts = [rng.randint(1, cfg.vocab_size, 6).astype(np.int32)
+                     for _ in range(trickle_n)]
+
+    def _counter(name):
+        m = telemetry.get(name)
+        return float(m.value) if m is not None else 0.0
+
+    def _trickle(eng, tenant=None):
+        ttfts = []
+        for p in inter_prompts:
+            t0 = time.perf_counter()
+            stream = eng.submit(p, max_new_tokens=4, tenant=tenant)
+            next(stream)
+            ttfts.append(time.perf_counter() - t0)
+            stream.result(timeout=120)
+        return sorted(ttfts)
+
+    def _flood(eng, tenant=None):
+        return [eng.submit(p, max_new_tokens=32, tenant=tenant)
+                for p in flood_prompts]
+
+    # phase 1+2: QoS OFF (installed None overrides any ambient
+    # MXNET_QOS_SPEC) — unloaded baseline, then the FIFO pathology
+    qos.install(None)
+    with GenerationEngine(lm, params, max_slots=slots, max_len=cfg.max_len,
+                          buckets=buckets) as eng:
+        serving.warmup(eng)
+        base = _trickle(eng)
+        streams = _flood(eng)
+        fifo = _trickle(eng)
+        for s in streams:
+            s.result(timeout=120)
+
+    # phase 3: the same flood with the QoS layer active (installed
+    # registry, not env — the lane must not perturb later phases)
+    pre0 = _counter("serving.generation.preemptions")
+    qos.install(qos.TenantRegistry(qos.parse_spec(
+        "latency:interactive;bulk:batch")))
+    try:
+        with GenerationEngine(lm, params, max_slots=slots,
+                              max_len=cfg.max_len, buckets=buckets) as eng:
+            serving.warmup(eng)
+            misses_warm = eng.cache.misses
+            streams = _flood(eng, tenant="bulk")
+            loaded = _trickle(eng, tenant="latency")
+            for s in streams:
+                s.result(timeout=120)
+            steady = eng.cache.misses - misses_warm
+    finally:
+        qos.clear()
+    assert steady == 0, f"steady-state qos compiles: {steady}"
+    preemptions = _counter("serving.generation.preemptions") - pre0
+
+    return {
+        "metric": "qos_isolation",
+        "slots": slots,
+        "park_slots": 1,
+        "flood_sessions": flood_n,
+        "interactive_sessions": trickle_n,
+        "unloaded_ttft_p50_ms": round(_pct(base, 50) * 1e3, 3),
+        "unloaded_ttft_p99_ms": round(_pct(base, 99) * 1e3, 3),
+        "interactive_ttft_p50_ms": round(_pct(loaded, 50) * 1e3, 3),
+        "interactive_ttft_p99_ms": round(_pct(loaded, 99) * 1e3, 3),
+        "fifo_interactive_ttft_p99_ms": round(_pct(fifo, 99) * 1e3, 3),
+        "ttft_degradation": round(
+            _pct(loaded, 99) / max(_pct(base, 99), 1e-9), 3),
+        "fifo_ttft_degradation": round(
+            _pct(fifo, 99) / max(_pct(base, 99), 1e-9), 3),
+        "preemptions": int(preemptions),
+        "qos_steady_state_compiles": steady,
+    }
+
+
 def _measure_overlap(on_tpu):
     """Overlap on/off sub-lanes: the SAME host-heavy workloads driven
     twice — lockstep (``MXNET_OVERLAP=0``) then overlapped (``=1``) —
@@ -1908,6 +2027,15 @@ def main():
                             mbu_headline="tick_mbu")
         except Exception:  # noqa: BLE001
             result["generation_error"] = \
+                traceback.format_exc(limit=3).strip().splitlines()[-1]
+        try:
+            # multi-tenant QoS: interactive TTFT under a batch flood,
+            # FIFO vs priority-classed admission + preemptive parking —
+            # the isolation number plus a zero-steady-compile assertion
+            with _phase_scope("qos"):
+                result["qos"] = _measure_qos(on_tpu)
+        except Exception:  # noqa: BLE001
+            result["qos_error"] = \
                 traceback.format_exc(limit=3).strip().splitlines()[-1]
         try:
             # overlap on/off sub-lanes: the same train/serving/generation

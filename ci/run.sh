@@ -59,6 +59,7 @@ unit() {
       --ignore=tests/python/unittest/test_serving.py \
       --ignore=tests/python/unittest/test_generation.py \
       --ignore=tests/python/unittest/test_generation_scale.py \
+      --ignore=tests/python/unittest/test_qos.py \
       --ignore=tests/python/unittest/test_rollout.py \
       --ignore=tests/python/unittest/test_zero1.py \
       --ignore=tests/python/unittest/test_tracing.py \
@@ -117,6 +118,16 @@ unit() {
   log "generation-scale suite (radix prefix cache + KV forking, speculative decoding, fleet affinity/autoscale)"
   env MXNET_HLOLINT_DUMP="$hlolint_dump" \
       python -m pytest tests/python/unittest/test_generation_scale.py -q
+  # qos gate, standalone: these tests flip the process-global tenant
+  # registry (qos.install/clear), spin engine scheduler threads and pin
+  # (a) MXNET_QOS_SPEC unset => admission order, compile-cache keys AND
+  # miss counts bit-identical to the pre-QoS engine, and (b) spec set =>
+  # priority/deadline ordering, quota fast-rejects, preempt-to-park with
+  # greedy BIT-EXACT resume and ZERO new steady-state executables — a
+  # scheduling, parking or accounting regression fails HERE, attributed
+  log "qos suite (tenant registry, priority admission, quotas, preempt/resume parity, migration)"
+  env MXNET_HLOLINT_DUMP="$hlolint_dump" \
+      python -m pytest tests/python/unittest/test_qos.py -q
   # rollout gate, standalone: the chaos swap suite — publish/subscribe
   # fault rejects (torn/corrupt/stale via the publish fault point),
   # zero-compile hot swaps with bit-exact drain pinning on BOTH serving
@@ -250,12 +261,13 @@ unit() {
   # fails the run on ANY lock-order inversion or blocking hazard the
   # suites drove, with both stacks printed — the dynamic complement of
   # the static tpulint gate (the PR 10 / PR 12 deadlock classes)
-  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/rollout/lazy/rewrite/elastic/overlap)"
+  log "lock-order race detector rerun (MXNET_DEBUG_SYNC=1 over serving/generation/qos/rollout/lazy/rewrite/elastic/overlap)"
   env MXNET_DEBUG_SYNC=1 python -m pytest \
       tests/python/unittest/test_overlap.py \
       tests/python/unittest/test_serving.py \
       tests/python/unittest/test_generation.py \
       tests/python/unittest/test_generation_scale.py \
+      tests/python/unittest/test_qos.py \
       tests/python/unittest/test_rollout.py \
       tests/python/unittest/test_lazy.py \
       tests/python/unittest/test_lazy_rewrite.py \

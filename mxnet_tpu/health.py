@@ -560,7 +560,12 @@ class Objective:
                     f"SLO objective {spec!r}: bad threshold {val!r}")
             self.threshold = float(v.group(1)) * _UNIT_US.get(v.group(2), 1.0)
             self.rel_stat = None
-        self.key = f"{self.metric}_{self.stat}".replace("*", "x")
+        # labeled metric names (QoS per-tenant rows like
+        # ``qos.ttft_us|tenant=acme``) carry ``|``/``=`` — sanitized here
+        # so the key stays a clean telemetry-name segment
+        # (``slo.<key>.burn_short`` gauges, report rows)
+        self.key = (f"{self.metric}_{self.stat}".replace("*", "x")
+                    .replace("|", ".").replace("=", "_"))
 
     def _hist_field(self, h, stat):
         if stat.startswith("p"):
@@ -877,7 +882,15 @@ def autoscale_signal(engines=None):
     if not engines:
         return None
     n = len(engines)
-    demand = sum(e.live_slots + e.queue_depth for e in engines)
+    # QoS active: demand is fairness-WEIGHTED (an interactive session
+    # votes harder for replicas than a batch one — the fleet scales for
+    # its latency-sensitive load, not its backlog); engines without the
+    # hook (or with QoS off → qos_demand() is None) fall back to the raw
+    # live + queued count, so the signal is unchanged by default
+    demand = 0.0
+    for e in engines:
+        w = (e.qos_demand() if hasattr(e, "qos_demand") else None)
+        demand += (e.live_slots + e.queue_depth) if w is None else w
     slots = sum(e.max_slots for e in engines) / n
     fill = float(getenv("MXNET_HEALTH_TARGET_FILL"))
     desired = max(1, -(-demand // max(slots * fill, 1e-9)))

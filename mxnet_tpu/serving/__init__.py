@@ -32,6 +32,13 @@ thread-safe server:
   timeout + rejected counters, and the derived
   ``serving.batch_fill_ratio`` (``tools/telemetry_report.py`` renders a
   summary; ``docs/faq/perf.md`` explains how to size buckets from it);
+* :mod:`qos` — multi-tenant quality of service (``MXNET_QOS_SPEC``):
+  priority-classed (interactive/standard/batch) deadline-aware admission
+  ordering with per-tenant rate quotas (:class:`QuotaExceededError`),
+  anti-starvation aging, preemptive parking of batch sessions into the
+  KV slab's park region under interactive pressure (bit-exact resume via
+  the traced fork executable), and per-tenant/per-class ``qos.*``
+  telemetry + SLO burn rows;
 * :mod:`rollout` — zero-downtime train→serve weight streaming: versioned
   CRC-verified :class:`WeightSet` publishes over a watched directory
   (``MXNET_ROLLOUT_DIR``), atomic ``swap_weights`` hot-flips on both
@@ -51,15 +58,18 @@ from .admission import (AdmissionQueue, DeadlineExceededError, QueueFullError,
 from .batcher import DynamicBatcher
 from .generation import GenerationEngine, GenerationRouter, GenerationStream
 from .predictor import Predictor, bucket_ladder
+from .qos import QuotaExceededError, TenantRegistry
 from .rollout import (RolloutSubscriber, RolloutWatcher, WeightSet, publish,
                       publish_checkpoint)
 from .warmup import warmup
 from . import generation
+from . import qos
 from . import rollout
 
 __all__ = ["Predictor", "DynamicBatcher", "AdmissionQueue", "Request",
            "ServingError", "QueueFullError", "DeadlineExceededError",
-           "ServerClosedError", "bucket_ladder", "warmup", "generation",
-           "GenerationEngine", "GenerationRouter", "GenerationStream",
-           "rollout", "WeightSet", "RolloutSubscriber", "RolloutWatcher",
-           "publish", "publish_checkpoint"]
+           "ServerClosedError", "QuotaExceededError", "bucket_ladder",
+           "warmup", "generation", "GenerationEngine", "GenerationRouter",
+           "GenerationStream", "qos", "TenantRegistry", "rollout",
+           "WeightSet", "RolloutSubscriber", "RolloutWatcher", "publish",
+           "publish_checkpoint"]

@@ -43,6 +43,10 @@ register_env("MXNET_SERVING_MAX_QUEUE", 1024,
              "admission-queue depth bound: serving submit() fast-rejects "
              "with QueueFullError once this many requests are waiting")
 
+# the qos module, bound lazily on first queue construction — qos.py
+# imports THIS module for ServingError, so a top-level import would cycle
+_qos = None
+
 
 class ServingError(MXNetError):
     """Base class of serving-plane failures."""
@@ -81,14 +85,20 @@ class Request:
 
     __slots__ = ("arrays", "rows", "future", "deadline", "enqueued_at",
                  "parent", "offset", "total_rows", "parts", "span",
-                 "traced_queue", "flow_ended", "payload")
+                 "traced_queue", "flow_ended", "payload", "tenant",
+                 "qos_rank", "qos_exempt")
 
-    def __init__(self, arrays, rows, future, deadline=None, payload=None):
+    def __init__(self, arrays, rows, future, deadline=None, payload=None,
+                 tenant=None):
         self.arrays = arrays
         self.rows = int(rows)
         self.future = future
         self.deadline = deadline
         self.payload = payload      # owner-defined (a generation session)
+        self.tenant = tenant        # QoS tenant name (None = default class)
+        self.qos_rank = None        # class rank stamped at put() (QoS on)
+        self.qos_exempt = False     # skip quotas: re-admission of already-
+        #                             admitted work (preemption migration)
         self.enqueued_at = time.monotonic()
         self.parent = None          # set on split-off head pieces
         self.offset = 0             # row offset within the original request
@@ -115,7 +125,15 @@ class AdmissionQueue:
     ``metric_prefix`` names the telemetry series this queue publishes
     (``<prefix>.queue_depth`` gauge, ``<prefix>.rejected`` counter) — the
     batcher keeps the historical ``serving.*`` names, the generation
-    engine's intake reports as ``serving.generation.*``."""
+    engine's intake reports as ``serving.generation.*``.
+
+    With a QoS registry active (:mod:`.qos`, captured at construction)
+    the pop order becomes ``(class rank, earliest deadline, enqueue
+    time)`` — batch requests age into standard rank per
+    ``MXNET_QOS_AGING_S`` — and ``put()`` additionally enforces
+    per-tenant quotas (:class:`~.qos.QuotaExceededError`). Without one,
+    every path below is byte-identical to the pre-QoS FIFO (pinned by
+    ``test_qos.py``)."""
 
     def __init__(self, max_depth=None, metric_prefix="serving"):
         self._max_depth = int(getenv("MXNET_SERVING_MAX_QUEUE")
@@ -124,6 +142,12 @@ class AdmissionQueue:
         if self._max_depth < 1:
             raise MXNetError("serving queue depth must be >= 1, got "
                              f"{self._max_depth}")
+        global _qos
+        if _qos is None:
+            from . import qos as _qos_module
+
+            _qos = _qos_module
+        self._qos = _qos.active()
         self._q = collections.deque()
         self._rows = 0
         self._cond = analysis.make_condition(f"{metric_prefix}.admission")
@@ -148,15 +172,38 @@ class AdmissionQueue:
         return self._max_depth
 
     def put(self, req):
-        """Admit ``req`` or reject NOW (QueueFullError / ServerClosedError).
+        """Admit ``req`` or reject NOW (QueueFullError / ServerClosedError
+        / — QoS active — QuotaExceededError for an over-quota tenant).
         Never blocks — backpressure is a synchronous signal, not a stall."""
         with self._cond:
             if self._closed:
                 raise ServerClosedError(
                     "serving queue is closed; no new requests accepted")
+            spec = None
+            if self._qos is not None:
+                spec = self._qos.spec_for(req.tenant)
+                req.qos_rank = spec.rank
+                if not req.qos_exempt:
+                    try:
+                        self._qos.check_admit(req.tenant)
+                    except Exception as e:
+                        if telemetry._enabled:
+                            telemetry.counter(
+                                f"{self._prefix}.rejected").inc()
+                            telemetry.counter(_qos.labeled_metric(
+                                "qos.rejected", spec)).inc()
+                        if health._enabled:
+                            health.event("qos_quota_reject",
+                                         prefix=self._prefix,
+                                         tenant=spec.name, cls=spec.cls,
+                                         error=repr(e))
+                        raise
             if len(self._q) >= self._max_depth:
                 if telemetry._enabled:
                     telemetry.counter(f"{self._prefix}.rejected").inc()
+                    if spec is not None:
+                        telemetry.counter(_qos.labeled_metric(
+                            "qos.rejected", spec)).inc()
                 if health._enabled:
                     health.event("admission_reject", prefix=self._prefix,
                                  depth=len(self._q))
@@ -169,6 +216,10 @@ class AdmissionQueue:
             if telemetry._enabled:
                 telemetry.gauge(f"{self._prefix}.queue_depth").set(
                     len(self._q))
+                if spec is not None:
+                    telemetry.counter(_qos.labeled_metric(
+                        "qos.admitted", spec)).inc()
+                    self._qos_depth_gauges()
             if not self.assist_active:
                 self._cond.notify()
 
@@ -177,6 +228,54 @@ class AdmissionQueue:
         left queued are not stranded behind a swallowed notify)."""
         with self._cond:
             self._cond.notify_all()
+
+    def _qos_sort(self, now=None):
+        """Reorder the queue by (effective class rank, earliest deadline,
+        enqueue time) — called under the held condition right before a
+        pop, because batch->standard aging makes the effective rank a
+        function of NOW. Stable within a key, so equal-priority requests
+        stay FIFO. No-op while QoS is off."""
+        if self._qos is None or len(self._q) < 2:
+            return
+        now = time.monotonic() if now is None else now
+        reg, inf = self._qos, float("inf")
+        self._q = collections.deque(sorted(
+            self._q,
+            key=lambda r: (reg.effective_rank(r.qos_rank, r.enqueued_at,
+                                              now),
+                           r.deadline if r.deadline is not None else inf,
+                           r.enqueued_at)))
+
+    def _qos_depth_gauges(self):
+        """Per-class queue-depth gauges (held condition; QoS + telemetry
+        on). O(queue) — admission pops are already O(queue log queue)."""
+        counts = {cls: 0 for cls in _qos.CLASSES}
+        for r in self._q:
+            rank = (self._qos.default_rank if r.qos_rank is None
+                    else r.qos_rank)
+            counts[_qos.CLASSES[rank]] += 1
+        for cls, n in counts.items():
+            telemetry.gauge(telemetry.labeled(
+                "qos.queue_depth", **{"class": cls})).set(n)
+
+    def peek(self):
+        """The request the next pop would hand out (QoS order when
+        active), skipping already-resolved futures — the generation
+        engine's preemption probe. None when nothing is pending."""
+        with self._cond:
+            self._qos_sort()
+            for r in self._q:
+                if not r.origin.future.done():
+                    return r
+            return None
+
+    def weighted_depth(self):
+        """Fairness-weighted queue depth (QoS registry weights; plain
+        ``len`` while QoS is off) — the autoscale demand contribution."""
+        with self._cond:
+            if self._qos is None:
+                return float(len(self._q))
+            return float(sum(self._qos.weight(r.tenant) for r in self._q))
 
     def get_batch(self, max_rows, max_wait_s):
         """Block until a flushable batch is ready and pop it.
@@ -205,8 +304,14 @@ class AdmissionQueue:
                 elif self._rows >= max_rows:
                     reason = "full"
                 else:
-                    remaining = (self._q[0].enqueued_at + max_wait_s
-                                 - time.monotonic())
+                    oldest = self._q[0].enqueued_at
+                    if self._qos is not None:
+                        # priority reordering can bury the oldest request
+                        # behind the head — the flush timer must still
+                        # honor ITS age or a backlogged batch request
+                        # waits the full window once per pop
+                        oldest = min(r.enqueued_at for r in self._q)
+                    remaining = oldest + max_wait_s - time.monotonic()
                     if remaining > 0:
                         self._cond.wait(timeout=remaining)
                         continue
@@ -230,7 +335,10 @@ class AdmissionQueue:
 
     def _pop(self, max_rows):
         """FIFO row-order pop under the held condition: whole requests
-        while they fit, the boundary request split at ``max_rows``."""
+        while they fit, the boundary request split at ``max_rows``.
+        With QoS active the 'FIFO' order is the class/deadline order
+        :meth:`_qos_sort` just imposed."""
+        self._qos_sort()
         out, rows = [], 0
         while self._q and rows < max_rows:
             req = self._q[0]
@@ -252,6 +360,8 @@ class AdmissionQueue:
                 rows += k
         if telemetry._enabled:
             telemetry.gauge(f"{self._prefix}.queue_depth").set(len(self._q))
+            if self._qos is not None:
+                self._qos_depth_gauges()
         return out
 
     def expire(self, now=None):
@@ -273,6 +383,8 @@ class AdmissionQueue:
             if expired and telemetry._enabled:
                 telemetry.gauge(f"{self._prefix}.queue_depth").set(
                     len(self._q))
+                if self._qos is not None:
+                    self._qos_depth_gauges()
         return expired
 
     @staticmethod
@@ -282,7 +394,8 @@ class AdmissionQueue:
         deadline and enqueue time — the flush timer still sees the
         original age)."""
         head = Request([a[0:k] for a in req.arrays], k, req.future,
-                       deadline=req.deadline)
+                       deadline=req.deadline, tenant=req.tenant)
+        head.qos_rank = req.qos_rank
         head.enqueued_at = req.enqueued_at
         head.parent = req.origin
         head.offset = req.offset

@@ -124,21 +124,25 @@ class DynamicBatcher:
             return False, "predictor warmup not run"
         return queue_ready(self._admission)
 
-    def submit(self, data, timeout=None):
+    def submit(self, data, timeout=None, tenant=None):
         """Enqueue one request; returns a Future resolving to the same
         value ``predictor.predict(data)`` would. ``timeout`` (seconds)
         sets the request deadline: expire in queue (or before a retry) and
-        the future fails with :class:`DeadlineExceededError`. Raises
-        :class:`QueueFullError` / :class:`ServerClosedError` synchronously.
-        Any row count is accepted — requests larger than the biggest
-        bucket stream through successive batches and reassemble."""
+        the future fails with :class:`DeadlineExceededError`. ``tenant``
+        names the QoS tenant (class/quota per ``MXNET_QOS_SPEC``; ignored
+        while QoS is off — the queue then also raises
+        :class:`~mxnet_tpu.serving.qos.QuotaExceededError`
+        synchronously). Raises :class:`QueueFullError` /
+        :class:`ServerClosedError` synchronously. Any row count is
+        accepted — requests larger than the biggest bucket stream through
+        successive batches and reassemble."""
         arrays = self._predictor._as_arrays(data)
         n = int(arrays[0].shape[0])
         deadline = (time.monotonic() + float(timeout)
                     if timeout is not None else None)
-        return self._submit_one(arrays, n, deadline)
+        return self._submit_one(arrays, n, deadline, tenant=tenant)
 
-    def predict(self, data, timeout=None):
+    def predict(self, data, timeout=None, tenant=None):
         """Blocking convenience: ``submit(...).result()`` — with
         CALLER-RUNS assistance. A blocking caller that finds the assist
         slot free drains queued batches inline (its own plus whatever
@@ -146,7 +150,7 @@ class DynamicBatcher:
         worker; under tiny per-batch compute the handoffs, not the math,
         dominate latency (the GIL hands off in multi-ms quanta). Async
         ``submit()`` traffic keeps the worker + flush-window path."""
-        fut = self.submit(data, timeout=timeout)
+        fut = self.submit(data, timeout=timeout, tenant=tenant)
         if self._assist.acquire(blocking=False):
             self._admission.assist_active = True
             try:
@@ -189,9 +193,9 @@ class DynamicBatcher:
 
     # -- worker --------------------------------------------------------------
 
-    def _submit_one(self, arrays, rows, deadline):
+    def _submit_one(self, arrays, rows, deadline, tenant=None):
         fut = Future()
-        req = Request(arrays, rows, fut, deadline=deadline)
+        req = Request(arrays, rows, fut, deadline=deadline, tenant=tenant)
         if tracing._enabled:
             # root span of this request's trace — finished by the thread
             # that resolves the future (worker, assisting caller, or this

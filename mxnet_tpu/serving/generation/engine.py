@@ -78,6 +78,7 @@ from ...base import MXNetError, getenv, register_env
 from ...compile_cache import CompileCache
 from ...io import staging as _staging
 from ...log import get_logger
+from .. import qos
 from ..admission import AdmissionQueue, DeadlineExceededError, Request
 from ..health import attach_engine, queue_ready
 from . import speculative
@@ -137,9 +138,11 @@ class _Session:
     """Engine-side state of one admitted (or queued) generation."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "deadline", "stream",
-                 "span", "slot", "generated", "prefix_len", "version")
+                 "span", "slot", "generated", "prefix_len", "version",
+                 "tenant", "qos_rank", "admit_seq")
 
-    def __init__(self, prompt, max_new_tokens, eos_id, deadline, stream):
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline, stream,
+                 tenant=None):
         self.prompt = prompt            # np.int32 [n]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -153,6 +156,10 @@ class _Session:
         #                                 (rollout: the session finishes
         #                                 bit-exact on these weights even
         #                                 after a swap)
+        self.tenant = tenant            # QoS tenant (None = default class)
+        self.qos_rank = None            # class rank stamped at admission
+        self.admit_seq = 0              # admission order: the preemptor
+        #                                 parks the YOUNGEST batch session
 
 
 class GenerationEngine:
@@ -232,12 +239,30 @@ class GenerationEngine:
         # pins it
         self._weights_version = 0
         self._param_sets = {0: (params, None)}
-        self._ck, self._cv = model.init_cache(self._slots, self._slab_len)
+        # multi-tenant QoS (default-off): with a registry active the slab
+        # grows MXNET_QOS_PARK_SLOTS park rows past session capacity —
+        # preemption forks a batch session's KV rows into the park region
+        # and resumes it later, bit-exact, through the SAME fork
+        # executable. With QoS off _total_slots == _slots, so every
+        # executable key (and the compile accounting) is bit-identical
+        self._qos = qos.active()
+        self._park = (int(getenv("MXNET_QOS_PARK_SLOTS"))
+                      if self._qos is not None else 0)
+        if self._park < 0:
+            raise MXNetError(
+                f"MXNET_QOS_PARK_SLOTS must be >= 0, got {self._park}")
+        self._total_slots = self._slots + self._park
+        self._parked = {}            # park slot -> {sess, length, last_tok,
+        #                              parked_at}
+        self._park_free = list(range(self._slots, self._total_slots))
+        self._admit_seq = 0
+        self._ck, self._cv = model.init_cache(self._total_slots,
+                                              self._slab_len)
         # host-side slot metadata — only the tick loop (under _tick_lock)
         # mutates these
-        self._sessions = [None] * self._slots
-        self._lengths = np.zeros(self._slots, np.int32)
-        self._last_tok = np.zeros(self._slots, np.int32)
+        self._sessions = [None] * self._total_slots
+        self._lengths = np.zeros(self._total_slots, np.int32)
+        self._last_tok = np.zeros(self._total_slots, np.int32)
         self._live = 0
 
         self._queue = AdmissionQueue(max_queue,
@@ -256,6 +281,10 @@ class GenerationEngine:
         self._warmed = False          # set by warm(); ready() also
         #                               accepts traffic-compiled engines
         self.health_name, self._beacon = attach_engine(self)
+        if self._qos is not None and health._enabled:
+            # per-tenant TTFT burn rows join the SLO tracker once per
+            # registry (idempotent across replicas)
+            qos.attach_slo(self._qos)
 
         use_prefix = (bool(getenv("MXNET_GENERATION_PREFIX_CACHE"))
                       if prefix_cache is None else bool(prefix_cache))
@@ -299,7 +328,30 @@ class GenerationEngine:
 
     @property
     def max_slots(self):
+        """Session capacity (park slots excluded — they are preemption
+        headroom, never admittable)."""
         return self._slots
+
+    @property
+    def total_slots(self):
+        """Slab slot count including the QoS park region — the dimension
+        every slab-shaped executable and the draft's slab use."""
+        return self._total_slots
+
+    @property
+    def parked_count(self):
+        """Preempted sessions currently parked in the slab's park region."""
+        return len(self._parked)
+
+    @property
+    def batch_live(self):
+        """Live batch-class sessions — the router's class-aware placement
+        signal (interactive avoids batch-heavy replicas, batch packs onto
+        them). Always 0 while QoS is off."""
+        if self._qos is None:
+            return 0
+        return sum(1 for s in self._sessions
+                   if s is not None and s.qos_rank == qos.BATCH_RANK)
 
     @property
     def max_len(self):
@@ -413,14 +465,17 @@ class GenerationEngine:
 
     # -- client API ----------------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens=64, eos_id=None, timeout=None):
+    def submit(self, prompt, max_new_tokens=64, eos_id=None, timeout=None,
+               tenant=None):
         """Admit one prompt; returns a :class:`GenerationStream`
         immediately. ``timeout`` (seconds) is the SESSION deadline —
         checked every scheduler tick, in queue and mid-generation; expiry
         evicts the slot and fails the stream with
-        :class:`DeadlineExceededError`. Raises ``QueueFullError`` /
-        ``ServerClosedError`` synchronously (backpressure is a signal,
-        not a stall)."""
+        :class:`DeadlineExceededError`. ``tenant`` names the QoS tenant
+        (class/quota/weight per ``MXNET_QOS_SPEC``; ignored while QoS is
+        off). Raises ``QueueFullError`` / ``ServerClosedError`` (and,
+        QoS active, ``QuotaExceededError``) synchronously (backpressure
+        is a signal, not a stall)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if prompt.size < 1:
             raise MXNetError("empty prompt")
@@ -438,16 +493,16 @@ class GenerationEngine:
         deadline = (time.monotonic() + float(timeout)
                     if timeout is not None else None)
         stream = GenerationStream(self, prompt.size, max_new_tokens,
-                                  deadline)
+                                  deadline, tenant=tenant)
         sess = _Session(prompt, max_new_tokens,
                         self._eos_id if eos_id is None else eos_id,
-                        deadline, stream)
+                        deadline, stream, tenant=tenant)
         if tracing._enabled:
             sess.span = tracing.begin("generation.session", cat="generation",
                                       prompt_tokens=int(prompt.size),
                                       max_new_tokens=int(max_new_tokens))
         req = Request([prompt], 1, stream._future, deadline=deadline,
-                      payload=sess)
+                      payload=sess, tenant=tenant)
         try:
             self._queue.put(req)
         except Exception as e:
@@ -518,8 +573,11 @@ class GenerationEngine:
                         jnp.asarray(1, jnp.int32),
                         jnp.asarray(free, jnp.int32),
                         jnp.asarray(0, jnp.int32))
-            if self._prefix is not None and free is not None:
+            if (self._prefix is not None or self._park) and free is not None:
                 # self-copy: compiles the fork without disturbing anything
+                # (the prefix cache's admission fork AND the QoS
+                # preempt/park/resume path share this one executable —
+                # warming it here is what keeps preemption compile-free)
                 fn = self._fork_fn()
                 self._ck, self._cv = fn(self._ck, self._cv,
                                         jnp.asarray(free, jnp.int32),
@@ -530,7 +588,7 @@ class GenerationEngine:
                     fn = self._verify_fn()
                     _, self._ck, self._cv = fn(
                         self._params, self._ck, self._cv,
-                        jnp.zeros((self._slots, self._spec_k + 1),
+                        jnp.zeros((self._total_slots, self._spec_k + 1),
                                   jnp.int32),
                         jnp.asarray(self._tick_positions()))
                     self._draft.warm()
@@ -753,6 +811,10 @@ class GenerationEngine:
             out["prefix"] = self._prefix.stats()
         if self._draft is not None and hasattr(self._draft, "slab_bytes"):
             out["draft_slab_bytes"] = self._draft.slab_bytes()
+        if self._qos is not None:
+            out["qos"] = {"park_slots": self._park,
+                          "parked": len(self._parked),
+                          "weighted_demand": self.qos_demand()}
         return out
 
     # -- compiled programs ---------------------------------------------------
@@ -773,7 +835,7 @@ class GenerationEngine:
 
             return jax.jit(fn, donate_argnums=(1, 2))
 
-        key = ("prefill", bucket, self._slots, self._slab_len)
+        key = ("prefill", bucket, self._total_slots, self._slab_len)
         return cache.get_or_build(key, build, persistent=False)
 
     def _decode_fn(self):
@@ -793,7 +855,7 @@ class GenerationEngine:
 
             return jax.jit(fn, donate_argnums=(1, 2))
 
-        key = ("decode", self._slots, self._slab_len)
+        key = ("decode", self._total_slots, self._slab_len)
         return cache.get_or_build(key, build, persistent=False)
 
     def _fork_fn(self):
@@ -817,7 +879,7 @@ class GenerationEngine:
 
             return jax.jit(fn, donate_argnums=(0, 1))
 
-        key = ("fork", self._slots, self._slab_len)
+        key = ("fork", self._total_slots, self._slab_len)
         return cache.get_or_build(key, build, persistent=False)
 
     def _suffix_prefill_fn(self, bucket):
@@ -837,7 +899,7 @@ class GenerationEngine:
 
             return jax.jit(fn, donate_argnums=(1, 2))
 
-        key = ("suffix_prefill", bucket, self._slots, self._slab_len)
+        key = ("suffix_prefill", bucket, self._total_slots, self._slab_len)
         return cache.get_or_build(key, build, persistent=False)
 
     def _verify_fn(self):
@@ -859,13 +921,14 @@ class GenerationEngine:
 
             return jax.jit(fn, donate_argnums=(1, 2))
 
-        key = ("verify", self._spec_k, self._slots, self._slab_len)
+        key = ("verify", self._spec_k, self._total_slots, self._slab_len)
         return cache.get_or_build(key, build, persistent=False)
 
     # -- scheduler -----------------------------------------------------------
 
     def _has_work(self):
-        return self._live > 0 or len(self._queue) > 0
+        return (self._live > 0 or len(self._queue) > 0
+                or len(self._parked) > 0)
 
     def _loop(self):
         while True:
@@ -942,6 +1005,7 @@ class GenerationEngine:
                                 slot, "deadline", DeadlineExceededError(
                                     f"session deadline passed after "
                                     f"{sess.generated} generated token(s)"))
+                    self._sweep_parked(now)
                     self._admit()
                     if pending is not None:
                         self._decode_commit(pending)
@@ -961,6 +1025,7 @@ class GenerationEngine:
                                 slot, "deadline", DeadlineExceededError(
                                     f"session deadline passed after "
                                     f"{sess.generated} generated token(s)"))
+                    self._sweep_parked(now)
                     self._admit()
                     decoded = self._live > 0
                     t_dec = time.perf_counter()
@@ -976,9 +1041,13 @@ class GenerationEngine:
                 for slot, sess in enumerate(self._sessions):
                     if sess is not None:
                         self._evict(slot, "error", e)
+                # parked sessions died with the slab too (their KV rows
+                # lived in the same donated buffers) — never-strand
+                for park, rec in list(self._parked.items()):
+                    self._fail_parked(park, rec, e)
                 # the failed executable may have consumed the donated slab
-                self._ck, self._cv = self._model.init_cache(self._slots,
-                                                            self._slab_len)
+                self._ck, self._cv = self._model.init_cache(
+                    self._total_slots, self._slab_len)
                 if self._prefix is not None:
                     # the cached rows died with the donated buffers
                     self._prefix.clear("slab_reset")
@@ -1011,9 +1080,9 @@ class GenerationEngine:
             # the tick wall against THE decode executable's bytes is the
             # per-tick MBU — the honest decode metric (arXiv:2603.09555),
             # bandwidth-bound by construction at steady state
-            key = (("verify", self._spec_k, self._slots, self._slab_len)
-                   if self._spec_k else
-                   ("decode", self._slots, self._slab_len))
+            key = (("verify", self._spec_k, self._total_slots,
+                    self._slab_len) if self._spec_k else
+                   ("decode", self._total_slots, self._slab_len))
             observatory.observe("generation.tick", self._cache, key,
                                 wall_s=time.perf_counter() - t0,
                                 exec_s=dec_s)
@@ -1037,10 +1106,12 @@ class GenerationEngine:
                 self._rate_t0 = now
 
     def _free_slots(self):
-        """Slots holding neither a live session nor a cached prefix."""
+        """Session slots holding neither a live session nor a cached
+        prefix (park-region slots are preemption headroom, never
+        admission targets)."""
         held = self._prefix.slots() if self._prefix is not None else ()
-        return [i for i, s in enumerate(self._sessions)
-                if s is None and i not in held]
+        return [i for i in range(self._slots)
+                if self._sessions[i] is None and i not in held]
 
     def _tick_positions(self, active=None):
         """Write positions for the fixed-shape decode/verify executables:
@@ -1092,12 +1163,19 @@ class GenerationEngine:
         return None
 
     def _admit(self):
-        """Move queued sessions into free slots (prefill), oldest first,
-        until the slab is full, the queue is empty, or the tick budget is
-        spent — at least one admission per tick when a slot is free (or
-        freeable by evicting a cached prefix), so backlog always drains
-        even under a tiny budget."""
+        """Move queued sessions into free slots (prefill), oldest first
+        (QoS active: class/deadline order), until the slab is full, the
+        queue is empty, or the tick budget is spent — at least one
+        admission per tick when a slot is free (or freeable by evicting
+        a cached prefix), so backlog always drains even under a tiny
+        budget. Under QoS, a full slab with a higher-class request at
+        the queue head first PARKS the youngest batch session (one per
+        tick — bounded churn) to free its slot."""
         free = self._free_slots()
+        if self._qos is not None and not free:
+            freed = self._preempt_for_priority()
+            if freed is not None:
+                free = [freed]
         if not free and not (self._prefix_claimable()
                              and len(self._queue)):
             return
@@ -1114,11 +1192,22 @@ class GenerationEngine:
             slot = self._claim_slot(free)
             if slot is None:
                 return
+            if (self._qos is not None and self._parked
+                    and self._should_resume()):
+                # no queued request outranks the parked batch work: un-park
+                # the oldest preempted session into this slot instead of
+                # admitting (anti-starvation — parked work drains the
+                # moment pressure lifts)
+                if self._resume_into(slot):
+                    if time.perf_counter() - t0 > self._tick_budget_s:
+                        return
+                    continue
             batch, _ = self._queue.get_batch_nowait(1)
             if not batch:
                 free.append(slot)
                 return
             sess = batch[0].payload
+            sess.qos_rank = batch[0].qos_rank
             now = time.monotonic()
             if sess.deadline is not None and now >= sess.deadline:
                 self._fail_queued(sess, now)
@@ -1187,6 +1276,8 @@ class GenerationEngine:
             # pinned for the session's whole life: after a swap the tick
             # keeps decoding this session under these exact weights
             sess.version = self._weights_version
+            self._admit_seq += 1
+            sess.admit_seq = self._admit_seq
             self._sessions[slot] = sess
             self._lengths[slot] = n
             self._last_tok[slot] = tok
@@ -1456,12 +1547,33 @@ class GenerationEngine:
         sess.generated += 1
         sess.stream._push(tok)
         self._tokens_window += 1
+        if self._qos is not None:
+            # token-rate quota burn-down — may push the tenant's bucket
+            # negative, which blocks its NEXT admission (generation length
+            # is unknowable at admit time, so charging at delivery is the
+            # only honest accounting)
+            self._qos.charge_tokens(sess.tenant, 1)
         if telemetry._enabled:
             telemetry.counter("serving.generation.tokens").inc()
-            if first:
+            spec = (self._qos.spec_for(sess.tenant)
+                    if self._qos is not None else None)
+            if spec is not None:
+                telemetry.counter(
+                    qos.labeled_metric("qos.tokens", spec)).inc()
+            # generated == 1 guards the adopt path: a migrated session's
+            # re-prefill redelivers into an old stream whose TTFT already
+            # happened on the source replica — recording it again would
+            # double-count (and flatter: the adopting engine only re-ran
+            # the prefill, not the queue wait)
+            if first and sess.generated == 1:
                 ttft = (time.monotonic() - sess.stream.submitted_at) * 1e6
                 telemetry.histogram("serving.generation.ttft_us").record(
                     ttft)
+                if spec is not None:
+                    # the per-tenant histogram the SLO burn rows
+                    # (qos.attach_slo) and the worst-tenant report line read
+                    telemetry.histogram(
+                        qos.labeled_metric("qos.ttft_us", spec)).record(ttft)
                 if sess.prefix_len:
                     # hit-path TTFT separately: the fork+suffix admission
                     # vs the full-prefill population above
@@ -1527,3 +1639,262 @@ class GenerationEngine:
         sess.stream._fail(exc)
         if sess.span is not None:
             sess.span.set(error=repr(exc), reason="deadline").finish()
+
+    # -- QoS park region (preemption / resume / migration) -------------------
+
+    def _sweep_parked(self, now):
+        """Deadline sweep over the park region — parking a session does
+        not stop its clock (the client's deadline is wall time, and a
+        parked batch session under sustained interactive pressure may
+        never get its slot back)."""
+        if not self._parked:
+            return
+        for park, rec in list(self._parked.items()):
+            sess = rec["sess"]
+            if sess.deadline is not None and now >= sess.deadline:
+                self._fail_parked(
+                    park, rec, DeadlineExceededError(
+                        f"session deadline passed while parked after "
+                        f"{sess.generated} generated token(s)"),
+                    reason="deadline")
+
+    def _fail_parked(self, park, rec, exc, reason="error"):
+        """Terminal failure for a PARKED session: free the park slot and
+        fail the stream in-band (never-strand — a parked session is in
+        neither the queue nor a live slot, so nobody else will)."""
+        del self._parked[park]
+        self._park_free.append(park)
+        sess = rec["sess"]
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.evictions").inc()
+            telemetry.counter(f"serving.generation.evict_{reason}").inc()
+        if health._enabled:
+            health.event("generation_evict", engine=self.health_name,
+                         reason=reason, parked=True, tokens=sess.generated)
+        sess.stream._fail(exc)
+        if sess.span is not None:
+            sess.span.set(error=repr(exc), reason=reason,
+                          parked=True).finish()
+
+    def _preempt_for_priority(self):
+        """Park the YOUNGEST live batch-class session (fewest sunk tokens
+        by admission order) when a higher-class request heads the queue
+        and the slab is full: one traced fork copies its KV rows into a
+        free park slot, host metadata moves aside, and the slot frees for
+        the interactive admission. One victim per call (the tick calls
+        once) bounds preemption churn. Returns the freed slot, or None
+        when preemption is impossible (no park headroom, no batch victim)
+        or unwarranted (the queue head is itself batch — an AGED batch
+        request never preempts, aging only reorders the queue).
+
+        Zero new executables: the fork program is the prefix cache's /
+        warm()'s, keyed ``("fork", total_slots, slab_len)``."""
+        import jax.numpy as jnp
+
+        if not self._park_free:
+            return None
+        head = self._queue.peek()
+        if (head is None or head.qos_rank is None
+                or head.qos_rank >= qos.BATCH_RANK):
+            return None
+        victim = None
+        for slot in range(self._slots):
+            sess = self._sessions[slot]
+            if sess is None or sess.qos_rank != qos.BATCH_RANK:
+                continue
+            if (victim is None
+                    or sess.admit_seq > self._sessions[victim].admit_seq):
+                victim = slot
+        if victim is None:
+            return None
+        sess = self._sessions[victim]
+        park = self._park_free.pop()
+        try:
+            fn = self._fork_fn()
+            self._ck, self._cv = fn(self._ck, self._cv,
+                                    jnp.asarray(victim, jnp.int32),
+                                    jnp.asarray(park, jnp.int32))
+        except Exception:
+            # the victim is still live in its slot; the tick handler's
+            # sweep will fail it with everyone else
+            self._park_free.append(park)
+            raise
+        self._parked[park] = {"sess": sess,
+                              "length": int(self._lengths[victim]),
+                              "last_tok": int(self._last_tok[victim]),
+                              "parked_at": time.monotonic()}
+        # host metadata moves aside WITHOUT failing the stream — the
+        # session is paused, not dead; its slot row becomes masked
+        # garbage steered to the safe row by _tick_positions
+        self._sessions[victim] = None
+        self._lengths[victim] = 0
+        self._last_tok[victim] = 0
+        self._live -= 1
+        if self._draft is not None:
+            self._draft.on_evict(victim)
+        spec = self._qos.spec_for(sess.tenant)
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.preemptions").inc()
+            telemetry.counter(qos.labeled_metric("qos.preempted", spec)).inc()
+        if health._enabled:
+            health.event("qos_preempt", engine=self.health_name,
+                         slot=victim, park=park, tenant=spec.name,
+                         tokens=sess.generated)
+        if sess.span is not None:
+            tracing.emit_span("generation.preempt", tracing.now_us(), 0.0,
+                              cat="generation", parent=sess.span,
+                              slot=victim, park=park)
+        return victim
+
+    def _should_resume(self):
+        """A free slot goes to a parked session unless a HIGHER-class
+        request heads the queue (batch-vs-batch: the parked session wins
+        — it has sunk prefill + decode work the queued one hasn't)."""
+        head = self._queue.peek()
+        return (head is None or head.qos_rank is None
+                or head.qos_rank >= qos.BATCH_RANK)
+
+    def _resume_into(self, slot):
+        """Un-park the OLDEST parked session into the free slot: one
+        traced fork copies its KV rows back, host metadata is restored,
+        and greedy decode continues bit-exact with an uninterrupted run
+        (fork is a bitwise row copy; decode is slot-index-independent).
+        Returns True when a session was resumed."""
+        import jax.numpy as jnp
+
+        park = min(self._parked,
+                   key=lambda p: self._parked[p]["parked_at"])
+        rec = self._parked.pop(park)
+        sess = rec["sess"]
+        try:
+            fn = self._fork_fn()
+            self._ck, self._cv = fn(self._ck, self._cv,
+                                    jnp.asarray(park, jnp.int32),
+                                    jnp.asarray(slot, jnp.int32))
+        except Exception as e:
+            # never-strand: the session is now in neither _parked nor a
+            # slot — fail its stream here, then let the tick handler
+            # reallocate the slab
+            self._park_free.append(park)
+            sess.stream._fail(e)
+            if sess.span is not None:
+                sess.span.set(error=repr(e), reason="error").finish()
+            raise
+        self._park_free.append(park)
+        sess.slot = slot
+        self._sessions[slot] = sess
+        self._lengths[slot] = rec["length"]
+        self._last_tok[slot] = rec["last_tok"]
+        self._live += 1
+        if self._draft is not None:
+            # rebuild the draft's context: prompt + all delivered tokens
+            # except the pending last (exactly what on_admit saw at the
+            # original admission, extended by the generated prefix)
+            ctx = np.concatenate([
+                sess.prompt,
+                np.asarray(sess.stream.tokens[:-1], np.int32)])
+            self._draft.on_admit(slot, ctx, rec["last_tok"])
+        spec = self._qos.spec_for(sess.tenant)
+        if telemetry._enabled:
+            telemetry.counter(qos.labeled_metric("qos.resumed", spec)).inc()
+        if health._enabled:
+            health.event("qos_resume", engine=self.health_name, slot=slot,
+                         tenant=spec.name,
+                         parked_s=round(
+                             time.monotonic() - rec["parked_at"], 3))
+        if sess.span is not None:
+            tracing.emit_span("generation.resume", tracing.now_us(), 0.0,
+                              cat="generation", parent=sess.span, slot=slot,
+                              park=park)
+        return True
+
+    def qos_demand(self):
+        """Fairness-weighted demand for the autoscaler: every live and
+        parked session plus every queued request, each weighted by its
+        tenant's QoS weight (interactive work votes harder for replicas
+        than batch). None while QoS is off — callers fall back to the
+        raw ``live_slots + queue_depth`` count."""
+        if self._qos is None:
+            return None
+        d = 0.0
+        for sess in self._sessions:
+            if sess is not None:
+                d += self._qos.weight(sess.tenant)
+        for rec in self._parked.values():
+            d += self._qos.weight(rec["sess"].tenant)
+        return d + self._queue.weighted_depth()
+
+    def eject_parked(self, max_n=None):
+        """Pop up to ``max_n`` parked sessions (oldest first) OUT of this
+        engine as host-side migration records — the router's
+        ``rebalance_parked`` hands them to a less-loaded peer replica's
+        :meth:`adopt`. Each record carries everything needed to continue
+        the generation elsewhere: prompt, tokens generated so far,
+        remaining budget, tenant, and the LIVE stream (the client keeps
+        iterating the same object; only its engine changes). The park
+        slots free immediately — the KV rows become masked garbage."""
+        out = []
+        with self._tick_lock:
+            parks = sorted(self._parked,
+                           key=lambda p: self._parked[p]["parked_at"])
+            if max_n is not None:
+                parks = parks[:max_n]
+            for park in parks:
+                rec = self._parked.pop(park)
+                self._park_free.append(park)
+                sess = rec["sess"]
+                out.append({"prompt": sess.prompt,
+                            "tokens": list(sess.stream.tokens),
+                            "max_new_tokens": sess.max_new_tokens,
+                            "eos_id": sess.eos_id,
+                            "deadline": sess.deadline,
+                            "tenant": sess.tenant,
+                            "stream": sess.stream,
+                            "span": sess.span})
+        if out and telemetry._enabled:
+            telemetry.counter("serving.generation.qos.ejected").inc(len(out))
+        return out
+
+    def adopt(self, record):
+        """Admit a migrated session ejected from a peer replica:
+        re-prefill the FULL context (prompt + every token generated so
+        far) through the normal admission path and keep delivering the
+        remaining budget into the ORIGINAL stream. Greedy continuation
+        is bit-exact with a fresh submit of that context — it IS one
+        (same prefill executable, same greedy argmax). The request rides
+        ``qos_exempt`` (its quota was charged at original admission;
+        double-charging would punish the tenant for the fleet's
+        rebalancing). Returns False when the context cannot fit this
+        engine (caller keeps the record and tries elsewhere)."""
+        toks = [int(t) for t in record["tokens"]]
+        ctx = np.concatenate([np.asarray(record["prompt"], np.int32).ravel(),
+                              np.asarray(toks, np.int32)])
+        n = int(ctx.size)
+        remaining = int(record["max_new_tokens"]) - len(toks)
+        if (remaining < 1 or n > self._buckets[-1]
+                or n + remaining > self._max_len or self._closed):
+            return False
+        stream = record["stream"]
+        sess = _Session(ctx, record["max_new_tokens"], record["eos_id"],
+                        record["deadline"], stream,
+                        tenant=record["tenant"])
+        sess.generated = len(toks)
+        sess.span = record.get("span")
+        # the stream's caller-runs assist must drive THIS engine's ticks
+        # from now on
+        stream._engine = self
+        req = Request([ctx], 1, stream._future, deadline=record["deadline"],
+                      payload=sess, tenant=record["tenant"])
+        req.qos_exempt = True
+        try:
+            self._queue.put(req)
+        except Exception:
+            return False
+        if telemetry._enabled:
+            telemetry.counter("serving.generation.qos.adopted").inc()
+        if health._enabled:
+            self._beacon.arm()
+        with self._work:
+            self.sessions_submitted += 1
+            self._work.notify_all()
+        return True

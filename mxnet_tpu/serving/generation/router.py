@@ -54,6 +54,7 @@ from ... import analysis
 from ... import health
 from ... import telemetry
 from ...base import MXNetError, getenv
+from .. import qos
 from ..admission import QueueFullError, ServerClosedError
 
 __all__ = ["GenerationRouter"]
@@ -158,10 +159,27 @@ class GenerationRouter:
         best = max(matches)
         # affinity tier: longest usable match wins outright (the fork it
         # unlocks is worth far more than perfect load spread); load (and
-        # the rotation) break ties and order the no-match fallback
-        order = sorted(range(n),
-                       key=lambda i: (-matches[(i + k) % n],
-                                      engines[(i + k) % n].load, i))
+        # the rotation) break ties and order the no-match fallback.
+        # QoS active: class-aware placement slots in BETWEEN affinity and
+        # load — an interactive session avoids batch-heavy replicas (its
+        # TTFT should not queue behind a flood it will only preempt), a
+        # batch session packs onto them (keeps interactive replicas
+        # clean, and co-locating batch work concentrates the preemption
+        # victims where the park region already absorbs them)
+        reg = qos.active()
+        rank = (reg.rank(kwargs.get("tenant"))
+                if reg is not None else None)
+
+        def _key(i):
+            j = (i + k) % n
+            if rank is None:
+                return (-matches[j], engines[j].load, i)
+            b = getattr(engines[j], "batch_live", 0)
+            if rank < qos.BATCH_RANK:
+                return (-matches[j], b, engines[j].load, i)
+            return (-matches[j], engines[j].load, -b, i)
+
+        order = sorted(range(n), key=_key)
         last_exc = None
         for i in order:
             j = (i + k) % n
@@ -196,6 +214,54 @@ class GenerationRouter:
     def generate(self, prompt, **kwargs):
         """Blocking convenience: route, then collect the full token list."""
         return list(self.submit(prompt, **kwargs))
+
+    def rebalance_parked(self, max_n=None):
+        """Migrate parked (preempted) sessions to peer replicas with
+        spare capacity: eject each source's park records
+        (:meth:`GenerationEngine.eject_parked`) and :meth:`adopt` them on
+        the least-loaded OTHER replica — the session's full context
+        re-prefills there and its original stream keeps delivering,
+        greedy bit-exact with a fresh submit of that context. A record
+        nobody can place falls back to the SOURCE replica's own queue;
+        only when even that refuses does the stream fail in-band
+        (never-strand). Call under sustained single-replica pressure —
+        e.g. from the autoscale callback after a grow. Returns the
+        number of sessions migrated to a peer."""
+        engines = self.engines
+        if len(engines) < 2:
+            return 0
+        migrated = 0
+        for src in engines:
+            if getattr(src, "parked_count", 0) == 0:
+                continue
+            for rec in src.eject_parked(max_n):
+                placed = None
+                peers = sorted((e for e in engines if e is not src),
+                               key=lambda e: e.load)
+                for dst in peers:
+                    if dst.adopt(rec):
+                        placed = dst
+                        migrated += 1
+                        break
+                if placed is None and not src.adopt(rec):
+                    exc = QueueFullError(
+                        "no replica could adopt the preempted session")
+                    rec["stream"]._fail(exc)
+                    if rec.get("span") is not None:
+                        rec["span"].set(error=repr(exc),
+                                        reason="migrate").finish()
+                    continue
+                if placed is not None:
+                    if telemetry._enabled:
+                        telemetry.counter(
+                            "serving.generation.qos.migrated").inc()
+                    if health._enabled:
+                        health.event("qos_migrate",
+                                     source=src.health_name,
+                                     target=placed.health_name,
+                                     tenant=rec.get("tenant") or "default",
+                                     tokens=len(rec["tokens"]))
+        return migrated
 
     # -- autoscale actuator --------------------------------------------------
 

@@ -34,8 +34,12 @@ _TOK, _END, _ERR = 0, 1, 2
 class GenerationStream:
     """Iterator of generated tokens for one submitted prompt."""
 
-    def __init__(self, engine, prompt_len, max_new_tokens, deadline=None):
-        self._engine = engine
+    def __init__(self, engine, prompt_len, max_new_tokens, deadline=None,
+                 tenant=None):
+        self._engine = engine       # reassigned when a preempted session
+        #                             migrates to a peer replica (the
+        #                             caller-runs assist then drives the
+        #                             adopting engine's ticks)
         self._q = queue.Queue()
         self._future = Future()
         self._stop = False          # iterator-side: terminal item consumed
@@ -43,6 +47,7 @@ class GenerationStream:
         self.prompt_len = int(prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self.deadline = deadline
+        self.tenant = tenant        # QoS tenant name (None = default class)
         self.submitted_at = time.monotonic()
         self.first_token_at = None
         # set at admission when the engine forked a cached prompt prefix

@@ -171,8 +171,13 @@ class CheckpointDraft(Draft):
                 "MXNET_GENERATION_SPEC_K or train a longer draft")
         self._slab_len = need
         self._alloc()
-        self._len = np.zeros(engine.max_slots, np.int32)
-        self._pending = [[] for _ in range(engine.max_slots)]
+        # total_slots (not max_slots): the draft slab mirrors the engine's
+        # slab row-for-row, INCLUDING the QoS park region — a preempted
+        # session's resume re-prefills the draft row anyway, but every
+        # propose/ingest runs fixed-shape over the whole slab, so the
+        # shapes (and executable keys) must match. QoS off: identical.
+        self._len = np.zeros(engine.total_slots, np.int32)
+        self._pending = [[] for _ in range(engine.total_slots)]
         # the draft slab is replaced by every donated draft_step — a live
         # view, like the engine's own slab; distinct buffers, so the
         # census adds it to kv_cache without double-counting the target's
@@ -181,7 +186,7 @@ class CheckpointDraft(Draft):
 
     def _alloc(self):
         self._dk, self._dv = self._model.init_cache(
-            self._eng.max_slots, self._slab_len)
+            self._eng.total_slots, self._slab_len)
 
     def slab_bytes(self):
         return int(self._dk.nbytes) + int(self._dv.nbytes)
@@ -200,7 +205,8 @@ class CheckpointDraft(Draft):
 
             return jax.jit(fn, donate_argnums=(1, 2))
 
-        key = ("draft_prefill", bucket, self._eng.max_slots, self._slab_len)
+        key = ("draft_prefill", bucket, self._eng.total_slots,
+               self._slab_len)
         # audit="generation": the draft slab programs live in the engine's
         # "generation" cache (passed in) — same hlolint contract row
         return cache.get_or_build(key, build, persistent=False,
@@ -241,7 +247,7 @@ class CheckpointDraft(Draft):
 
             return jax.jit(fn, donate_argnums=(1, 2))
 
-        key = ("draft_step", k, self._eng.max_slots, self._slab_len)
+        key = ("draft_step", k, self._eng.total_slots, self._slab_len)
         return cache.get_or_build(key, build, persistent=False,
                                   audit="generation")
 
@@ -262,9 +268,9 @@ class CheckpointDraft(Draft):
         fn = self._step_fn(k)
         _, self._dk, self._dv = fn(
             self._params, self._dk, self._dv,
-            jnp.zeros((eng.max_slots, k + 1), jnp.int32),
-            jnp.zeros(eng.max_slots, jnp.int32),
-            jnp.zeros(eng.max_slots, jnp.int32))
+            jnp.zeros((eng.total_slots, k + 1), jnp.int32),
+            jnp.zeros(eng.total_slots, jnp.int32),
+            jnp.zeros(eng.total_slots, jnp.int32))
         # warm garbage lands in rows the next real prefill/ingest
         # overwrites before attending (the frontier argument); lengths
         # were never advanced, so no state to undo
@@ -295,7 +301,7 @@ class CheckpointDraft(Draft):
     def reset(self):
         self._alloc()
         self._len[:] = 0
-        self._pending = [[] for _ in range(self._eng.max_slots)]
+        self._pending = [[] for _ in range(self._eng.total_slots)]
 
     def swap_params(self, params):
         """Flip the draft to new weights immediately — the slab survives

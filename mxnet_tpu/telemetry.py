@@ -63,7 +63,7 @@ from .base import getenv, register_env
 from .log import get_logger
 
 __all__ = ["Counter", "Gauge", "Histogram",
-           "counter", "gauge", "histogram", "get",
+           "counter", "gauge", "histogram", "get", "labeled",
            "enabled", "enable", "disable", "reset",
            "snapshot", "dumps", "dump", "dumps_table", "prom_text",
            "trace_counter_events", "start_log_thread", "stop_log_thread",
@@ -275,6 +275,23 @@ def histogram(name):
 def get(name):
     """The metric named ``name``, or None."""
     return _registry.get(name)
+
+
+def labeled(name, **labels):
+    """Compose a metric name carrying Prometheus-style labels:
+    ``labeled("qos.admitted", tenant="acme")`` ->
+    ``"qos.admitted|tenant=acme"`` (keys sorted for a stable identity).
+    Flat views (``dumps_table``, ``snapshot``) show the composed name;
+    :func:`prom_text` splits it back into a real label set —
+    ``mxnet_qos_admitted{tenant="acme"}`` — so per-tenant series land as
+    one metric family, not N name-mangled metrics. Label VALUES have the
+    ``|``/``=`` separators sanitized to ``_``; the label-escape path
+    handles the rest at render time."""
+    parts = [name]
+    for k in sorted(labels):
+        v = str(labels[k]).replace("|", "_").replace("=", "_")
+        parts.append(f"{k}={v}")
+    return "|".join(parts)
 
 
 def enabled():
@@ -566,6 +583,21 @@ def _prom_label(value):
             .replace("\n", "\\n"))
 
 
+def _prom_split(name):
+    """Split a :func:`labeled` metric name into ``(base, labelstr)`` —
+    ``"qos.admitted|class=batch|tenant=acme"`` becomes
+    ``("qos.admitted", 'class="batch",tenant="acme"')``. Unlabeled names
+    pass through with an empty label string."""
+    if "|" not in name:
+        return name, ""
+    base, _, rest = name.partition("|")
+    pairs = []
+    for tok in rest.split("|"):
+        k, _, v = tok.partition("=")
+        pairs.append(f'{_prom_name(k)}="{_prom_label(v)}"')
+    return base, ",".join(pairs)
+
+
 def prom_text(refresh_memory=True):
     """The registry in Prometheus text exposition format (what the HTTP
     ``/metrics`` endpoint serves, scrapeable by any Prometheus-compatible
@@ -583,6 +615,10 @@ def prom_text(refresh_memory=True):
             pass
     snap = snapshot()
     lines = []
+    # labeled() series of one base name form ONE metric family: the
+    # # TYPE header is emitted once per family, however many label sets
+    # report under it (names sort adjacently, so families stay grouped)
+    typed = set()
 
     def emit(name, kind, value):
         v = _prom_value(value)
@@ -590,9 +626,12 @@ def prom_text(refresh_memory=True):
             # un-renderable (e.g. a gauge set to a string): a skipped
             # sample keeps the whole exposition parseable
             return
-        n = "mxnet_" + _prom_name(name)
-        lines.append(f"# TYPE {n} {kind}")
-        lines.append(f"{n} {v}")
+        base, labels = _prom_split(name)
+        n = "mxnet_" + _prom_name(base)
+        if (n, kind) not in typed:
+            typed.add((n, kind))
+            lines.append(f"# TYPE {n} {kind}")
+        lines.append(f"{n}{{{labels}}} {v}" if labels else f"{n} {v}")
 
     for name, v in sorted(snap["counters"].items()):
         emit(name, "counter", v)
@@ -601,8 +640,11 @@ def prom_text(refresh_memory=True):
     for name, v in sorted(snap["derived"].items()):
         emit(name, "gauge", v)
     for name, h in sorted(snap["histograms"].items()):
-        n = "mxnet_" + _prom_name(name)
-        lines.append(f"# TYPE {n} summary")
+        base, labels = _prom_split(name)
+        n = "mxnet_" + _prom_name(base)
+        if (n, "summary") not in typed:
+            typed.add((n, "summary"))
+            lines.append(f"# TYPE {n} summary")
         if h["count"]:
             for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
                 qv = _prom_value(h[key])
@@ -610,9 +652,12 @@ def prom_text(refresh_memory=True):
                     # a zero-size reservoir records count/sum but no
                     # quantiles — "None" is not a float the parser takes
                     continue
-                lines.append(f'{n}{{quantile="{_prom_label(q)}"}} {qv}')
-        lines.append(f"{n}_sum {_prom_value(h['sum'])}")
-        lines.append(f"{n}_count {_prom_value(h['count'])}")
+                lab = (f'{labels},quantile="{_prom_label(q)}"' if labels
+                       else f'quantile="{_prom_label(q)}"')
+                lines.append(f"{n}{{{lab}}} {qv}")
+        suffix = f"{{{labels}}}" if labels else ""
+        lines.append(f"{n}_sum{suffix} {_prom_value(h['sum'])}")
+        lines.append(f"{n}_count{suffix} {_prom_value(h['count'])}")
     return "\n".join(lines) + "\n"
 
 

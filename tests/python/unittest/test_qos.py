@@ -1,0 +1,640 @@
+"""Multi-tenant QoS: tenant registry, priority-classed admission, quotas,
+preemptive parking and migration.
+
+Covers the QoS PR end to end:
+* spec grammar — ``name:class[:rps=N,tps=N,weight=N]`` parsing, class
+  validation, duplicate rejection, default-class fallback for unknown
+  tenants;
+* default-off bit-identity — with no registry active every consulting
+  call site takes its pre-QoS path: FIFO admission order, compile-cache
+  keys and miss counts identical to the pre-QoS engine (the acceptance
+  pin: ``MXNET_QOS_SPEC`` unset must change NOTHING);
+* priority-classed deadline-aware admission — pop order is (class rank,
+  earliest deadline, enqueue time) with anti-starvation aging promoting
+  queued batch work to standard rank;
+* quotas — request-rate / token-rate token buckets, synchronous
+  ``QuotaExceededError`` fast-rejection with labeled reject counters;
+* preemption — an interactive arrival into a batch-saturated slab parks
+  the youngest batch session via the traced fork executable and resumes
+  it later GREEDY BIT-EXACT, with zero new steady-state executables;
+* migration — ``GenerationRouter.rebalance_parked`` moves parked
+  sessions to a peer replica (full-context re-prefill, same stream, same
+  tokens) and placement is class-aware atop prefix affinity;
+* observability — per-tenant/class labeled ``qos.*`` series, the PINNED
+  ``prom_text`` label rendering, the ``tools/telemetry_report.py``
+  ``qos:`` line, per-tenant SLO rows (sanitized ``Objective.key``) and
+  the fairness-weighted autoscale demand;
+* chaos acceptance — a 3-tenant mix over a saturated slab: zero
+  interactive drops, preempted batch sessions complete bit-exact, zero
+  steady-state compiles.
+"""
+import json
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import jax
+
+from mxnet_tpu import health, serving, telemetry
+from mxnet_tpu import parallel as par
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import TransformerLM, TransformerLMConfig
+from mxnet_tpu.serving import DeadlineExceededError, QuotaExceededError
+from mxnet_tpu.serving import qos
+from mxnet_tpu.serving.admission import AdmissionQueue, Request
+from mxnet_tpu.serving.generation import GenerationEngine, GenerationRouter
+
+VOCAB = 64
+
+
+def _model(max_len=48, n_layers=2, d_model=32, vocab=VOCAB, seed=0):
+    mesh = par.create_mesh(devices=jax.devices()[:1], dp=1)
+    cfg = TransformerLMConfig(vocab_size=vocab, d_model=d_model, n_heads=2,
+                              d_ff=2 * d_model, n_layers=n_layers,
+                              max_len=max_len, dtype="float32")
+    lm = TransformerLM(cfg, mesh)
+    return lm, lm.init_params(jax.random.PRNGKey(seed))
+
+
+@pytest.fixture(scope="module")
+def lm48():
+    """One small model shared across the suite (compiles are per-engine,
+    params are read-only)."""
+    return _model(max_len=48)
+
+
+@pytest.fixture
+def tele():
+    prev = telemetry.enabled()
+    telemetry.enable()
+    yield telemetry
+    telemetry.enable(prev)
+
+
+@pytest.fixture(autouse=True)
+def _qos_clean():
+    """Every test leaves the process-global registry the way it found
+    it: cleared, so the next active() re-reads the (unset) env."""
+    yield
+    qos.clear()
+
+
+def _counter(name):
+    m = telemetry.get(name)
+    return m.value if m is not None else 0
+
+
+def _reg(spec, **kw):
+    """Install a registry parsed from ``spec`` (the test-side analog of
+    setting MXNET_QOS_SPEC before server construction)."""
+    return qos.install(qos.TenantRegistry(qos.parse_spec(spec), **kw))
+
+
+def _prompts(n, lo=2, hi=12, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, VOCAB, rng.randint(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _drive(eng, streams, max_ticks=600):
+    """Manually tick a start=False engine until every stream resolved."""
+    for _ in range(max_ticks):
+        if all(s._future.done() for s in streams):
+            return
+        eng._tick_once()
+    raise AssertionError("sessions did not complete within the tick budget")
+
+
+def _req(tenant=None, deadline=None):
+    return Request([np.zeros((1, 1), np.float32)], 1, Future(),
+                   deadline=deadline, tenant=tenant)
+
+
+# ---------------------------------------------------------------------------
+# spec grammar / registry
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec():
+    t = qos.parse_spec(
+        "acme:interactive:rps=10,tps=500,weight=3;"
+        "api:standard; bulk:batch:tps=100")
+    assert set(t) == {"acme", "api", "bulk"}
+    assert (t["acme"].rank, t["acme"].rps, t["acme"].tps,
+            t["acme"].weight) == (0, 10.0, 500.0, 3.0)
+    assert (t["api"].rank, t["api"].rps, t["api"].weight) == (1, None, 1.0)
+    assert (t["bulk"].rank, t["bulk"].weight) == (2, 0.25)
+    assert qos.parse_spec("") == {} and qos.parse_spec("  ;; ") == {}
+
+
+def test_parse_spec_rejects():
+    for bad in ("acme", "acme:gold", ":interactive", "a:batch:rps=fast",
+                "a:batch:burst=9", "a:interactive;a:batch",
+                "a:interactive:rps=0"):
+        with pytest.raises(MXNetError):
+            qos.parse_spec(bad)
+    with pytest.raises(MXNetError):
+        qos.TenantRegistry({}, default_class="gold")
+
+
+def test_registry_defaults_and_aging():
+    reg = qos.TenantRegistry(qos.parse_spec("bulk:batch"),
+                             default_class="interactive", aging_s=30.0)
+    # unknown tenants (and None) land in the default class, quota-free
+    assert reg.rank("stranger") == 0 and reg.rank(None) == 0
+    assert reg.spec_for(None).name == "default"
+    assert reg.weight("stranger") == 2.0 and reg.weight("bulk") == 0.25
+    reg.check_admit("stranger")        # no quota, never raises
+    # aging: batch promotes to standard rank past the window, batch only
+    now = time.monotonic()
+    assert reg.effective_rank(qos.BATCH_RANK, now - 31.0, now) == 1
+    assert reg.effective_rank(qos.BATCH_RANK, now - 1.0, now) == 2
+    assert reg.effective_rank(0, now - 500.0, now) == 0
+    frozen = qos.TenantRegistry({}, aging_s=0.0)     # 0 disables aging
+    assert frozen.effective_rank(qos.BATCH_RANK, now - 500.0, now) == 2
+
+
+def test_request_rate_quota():
+    reg = qos.TenantRegistry(qos.parse_spec("acme:interactive:rps=2"))
+    t0 = time.monotonic()
+    reg.check_admit("acme", now=t0)
+    reg.check_admit("acme", now=t0)
+    with pytest.raises(QuotaExceededError):
+        reg.check_admit("acme", now=t0)
+    # the bucket refills continuously: one second later one token is back
+    reg.check_admit("acme", now=t0 + 0.6)
+
+
+def test_token_rate_quota():
+    reg = qos.TenantRegistry(qos.parse_spec("bulk:batch:tps=10"))
+    t0 = time.monotonic()
+    reg.check_admit("bulk", now=t0)                  # bucket full: fine
+    reg.charge_tokens("bulk", 25, now=t0)            # overdraft allowed
+    with pytest.raises(QuotaExceededError):
+        reg.check_admit("bulk", now=t0)              # blocked until refill
+    reg.check_admit("bulk", now=t0 + 2.0)            # -15 + 2s*10 > 0
+
+
+def test_active_lifecycle(monkeypatch):
+    qos.clear()
+    monkeypatch.setenv("MXNET_QOS_SPEC", "acme:interactive")
+    assert qos.active().rank("acme") == 0
+    monkeypatch.setenv("MXNET_QOS_SPEC", "acme:batch")
+    assert qos.active().rank("acme") == 0    # resolved once, not re-read
+    qos.clear()
+    assert qos.active().rank("acme") == 2    # clear() re-reads
+    qos.install(None)                        # programmatic OFF beats env
+    assert qos.active() is None
+    qos.clear()
+    monkeypatch.delenv("MXNET_QOS_SPEC")
+    assert qos.active() is None
+
+
+# ---------------------------------------------------------------------------
+# admission queue: FIFO identity off, priority order on
+# ---------------------------------------------------------------------------
+
+
+def test_queue_fifo_when_off():
+    qos.install(None)
+    q = AdmissionQueue(8, metric_prefix="t_off")
+    reqs = [_req(tenant="bulk"), _req(deadline=time.monotonic() + 0.1),
+            _req(tenant="acme")]
+    for r in reqs:
+        q.put(r)
+    assert all(r.qos_rank is None for r in reqs)   # no stamping at all
+    out = q._pop(3)
+    assert out == reqs                             # strict arrival order
+    assert q.weighted_depth() == 0.0
+
+
+def test_queue_priority_and_deadline_order():
+    _reg("lat:interactive;api:standard;bulk:batch")
+    q = AdmissionQueue(8, metric_prefix="t_prio")
+    b, s = _req(tenant="bulk"), _req(tenant="api")
+    i_late = _req(tenant="lat", deadline=time.monotonic() + 60)
+    i_soon = _req(tenant="lat", deadline=time.monotonic() + 1)
+    for r in (b, s, i_late, i_soon):
+        q.put(r)
+    assert q.peek() is i_soon
+    # class rank first; within a class the earliest deadline wins even
+    # though it enqueued later
+    assert q._pop(4) == [i_soon, i_late, s, b]
+
+
+def test_queue_aging_promotion():
+    _reg("bulk:batch", aging_s=0.05)
+    q = AdmissionQueue(8, metric_prefix="t_age")
+    old_batch = _req(tenant="bulk")
+    q.put(old_batch)
+    time.sleep(0.06)
+    fresh_standard = _req()                       # default class: standard
+    q.put(fresh_standard)
+    # the batch request aged into standard rank; FIFO breaks the tie in
+    # its favor (it has waited longer)
+    assert q._pop(2) == [old_batch, fresh_standard]
+
+
+def test_quota_reject_counters(tele):
+    _reg("acme:standard:rps=1")
+    q = AdmissionQueue(8, metric_prefix="t_quota")
+    rej = telemetry.labeled("qos.rejected", tenant="acme",
+                            **{"class": "standard"})
+    adm = telemetry.labeled("qos.admitted", tenant="acme",
+                            **{"class": "standard"})
+    r0, a0, p0 = _counter(rej), _counter(adm), _counter("t_quota.rejected")
+    q.put(_req(tenant="acme"))
+    with pytest.raises(QuotaExceededError):
+        q.put(_req(tenant="acme"))
+    assert _counter(adm) - a0 == 1
+    assert _counter(rej) - r0 == 1
+    assert _counter("t_quota.rejected") - p0 == 1
+    # qos_exempt re-admission (migration) skips the quota entirely
+    ex = _req(tenant="acme")
+    ex.qos_exempt = True
+    q.put(ex)
+
+
+# ---------------------------------------------------------------------------
+# engine: default-off bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_engine_off_bit_identity(lm48, tele):
+    """QoS off: no park rows, no qos stats, the executable keys and the
+    compile accounting are EXACTLY the pre-QoS engine's — the acceptance
+    pin that MXNET_QOS_SPEC unset changes nothing."""
+    qos.install(None)
+    lm, params = lm48
+    eng = GenerationEngine(lm, params, max_slots=2, max_len=48,
+                           buckets=(8, 16), start=False)
+    try:
+        assert eng.total_slots == eng.max_slots == 2
+        assert eng.parked_count == 0 and eng.batch_live == 0
+        assert eng.qos_demand() is None
+        assert "qos" not in eng.stats()
+        w = eng.warm()
+        assert w["compiles"] == 3                 # 2 prefill + 1 decode
+        # keys are keyed on the SESSION slot count — no park widening
+        assert ("decode", 2, 48) in eng.cache.keys()
+        m0 = eng.cache.misses
+        streams = [eng.submit(p, max_new_tokens=3, tenant="ignored")
+                   for p in _prompts(4, seed=21)]
+        _drive(eng, streams)
+        assert eng.cache.misses == m0             # zero steady-state
+        assert all(len(s.result(1)) == 3 for s in streams)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# engine: preemption, parking, bit-exact resume
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_resume_bit_parity(lm48, tele):
+    """Two batch sessions saturate a 2-slot slab; an interactive arrival
+    parks the youngest via the traced fork and takes its slot; the parked
+    session resumes into the next free slot and finishes GREEDY BIT-EXACT
+    with an uncontended run. A second identical round compiles NOTHING."""
+    lm, params = lm48
+    _reg("lat:interactive;bulk:batch")
+    bp = _prompts(2, seed=30)
+    (ip,) = _prompts(1, seed=31)
+    # uncontended baseline on an engine with the SAME slab shape
+    # (2 slots + 1 park row), one session at a time
+    with GenerationEngine(lm, params, max_slots=2, max_len=48,
+                          buckets=(16,)) as base_eng:
+        base = [base_eng.generate(p, max_new_tokens=8) for p in bp]
+        ibase = base_eng.generate(ip, max_new_tokens=4)
+
+    eng = GenerationEngine(lm, params, max_slots=2, max_len=48,
+                           buckets=(16,), start=False)
+    try:
+        assert eng.total_slots == 3 and eng.max_slots == 2
+
+        def round_trip():
+            bs = [eng.submit(p, max_new_tokens=8, tenant="bulk")
+                  for p in bp]
+            for _ in range(200):
+                if eng.live_slots == 2:
+                    break
+                eng._tick_once()
+            assert eng.live_slots == 2 and eng.batch_live == 2
+            istream = eng.submit(ip, max_new_tokens=4, tenant="lat")
+            _drive(eng, bs + [istream])
+            return [s.result(1) for s in bs], istream.result(1)
+
+        pre0 = _counter("serving.generation.preemptions")
+        res0 = _counter(telemetry.labeled(
+            "qos.resumed", tenant="bulk", **{"class": "batch"}))
+        got, igot = round_trip()
+        assert _counter("serving.generation.preemptions") - pre0 == 1
+        assert _counter(telemetry.labeled(
+            "qos.resumed", tenant="bulk", **{"class": "batch"})) - res0 == 1
+        assert got == base, "preempted batch stream diverged after resume"
+        assert igot == ibase
+        assert eng.parked_count == 0
+        assert eng.stats()["qos"] == {"park_slots": 1, "parked": 0,
+                                      "weighted_demand": 0.0}
+        # steady state: the same contention pattern again compiles zero
+        m0 = eng.cache.misses
+        got2, igot2 = round_trip()
+        assert eng.cache.misses == m0, \
+            "preempt/resume compiled a new executable at steady state"
+        assert got2 == base and igot2 == ibase
+    finally:
+        eng.close()
+
+
+def test_parked_deadline_sweep(lm48, tele):
+    """Parking does not stop a session's deadline clock: a batch session
+    whose deadline expires IN the park region fails with
+    DeadlineExceededError at the sweep, freeing the park row."""
+    lm, params = lm48
+    _reg("lat:interactive;bulk:batch")
+    (bp,), (ip,) = _prompts(1, seed=33), _prompts(1, seed=34)
+    eng = GenerationEngine(lm, params, max_slots=1, max_len=48,
+                           buckets=(16,), start=False)
+    try:
+        # generous timeout: fork/prefill COMPILE time must not expire the
+        # session before it ever reaches the park (the sweep under test)
+        b = eng.submit(bp, max_new_tokens=30, tenant="bulk", timeout=60.0)
+        for _ in range(100):
+            if eng.live_slots == 1:
+                break
+            eng._tick_once()
+        assert eng.live_slots == 1
+        i = eng.submit(ip, max_new_tokens=40, tenant="lat")
+        eng._tick_once()                          # preempts b into the park
+        assert eng.parked_count == 1
+        # rewind the parked deadline rather than sleeping it out: the clock
+        # keeps running while parked, so the next sweep must evict b
+        rec = next(iter(eng._parked.values()))
+        rec["sess"].deadline = time.monotonic() - 0.01
+        ev0 = _counter("serving.generation.evict_deadline")
+        _drive(eng, [b, i])
+        with pytest.raises(DeadlineExceededError):
+            b.result(1)
+        assert len(i.result(1)) == 40             # survivor unaffected
+        assert _counter("serving.generation.evict_deadline") - ev0 == 1
+        assert eng.parked_count == 0
+    finally:
+        eng.close()
+
+
+def test_qos_demand_weighting(lm48):
+    """Fairness-weighted demand: queued interactive work votes 8x harder
+    than batch (2.0 vs 0.25), and the autoscale signal consumes it."""
+    lm, params = lm48
+    _reg("lat:interactive;bulk:batch")
+    hot = GenerationEngine(lm, params, max_slots=2, max_len=48,
+                           buckets=(16,), start=False)
+    cold = GenerationEngine(lm, params, max_slots=2, max_len=48,
+                            buckets=(16,), start=False)
+    try:
+        for p in _prompts(8, seed=40):
+            hot.submit(p, max_new_tokens=3, tenant="lat")
+            cold.submit(p, max_new_tokens=3, tenant="bulk")
+        assert hot.qos_demand() == pytest.approx(16.0)
+        assert cold.qos_demand() == pytest.approx(2.0)
+        want_hot = health.autoscale_signal(engines=[hot])
+        want_cold = health.autoscale_signal(engines=[cold])
+        assert want_hot > want_cold >= 1
+    finally:
+        hot.close()
+        cold.close()
+
+
+# ---------------------------------------------------------------------------
+# router: class-aware placement + parked-session migration
+# ---------------------------------------------------------------------------
+
+
+def test_router_class_aware_placement(lm48):
+    """Interactive avoids the batch-heavy replica even when it is the
+    less loaded one (load-only routing would pick it); batch packs onto
+    the replica already running batch work when loads tie."""
+    lm, params = lm48
+    _reg("lat:interactive;bulk:batch")
+
+    def _engine():
+        return GenerationEngine(lm, params, max_slots=2, max_len=48,
+                                buckets=(16,), start=False)
+
+    def _live_one(e, tenant, seed):
+        e.submit(_prompts(1, seed=seed)[0], max_new_tokens=30,
+                 tenant=tenant)
+        for _ in range(100):
+            if e.live_slots == 1:
+                break
+            e._tick_once()
+        assert e.live_slots == 1
+
+    # interactive: e0 is LESS loaded (0.5 vs 1.0) but batch-heavy — a
+    # load-only router would pick e0; class-aware placement picks e1
+    e0, e1 = _engine(), _engine()
+    try:
+        _live_one(e0, "bulk", 50)                  # load 0.5, batch_live 1
+        for p in _prompts(2, seed=51):
+            e1.submit(p, max_new_tokens=3, tenant="lat")   # load 1.0
+        assert e0.load < e1.load and e0.batch_live == 1
+        router = GenerationRouter([e0, e1])
+        s = router.submit(_prompts(1, seed=52)[0], max_new_tokens=3,
+                          tenant="lat")
+        assert s._engine is e1, \
+            "interactive placed on the batch-heavy replica"
+    finally:
+        e0.close()
+        e1.close()
+
+    # batch at load parity: packs onto the replica already running batch
+    f0, f1 = _engine(), _engine()
+    try:
+        _live_one(f0, "bulk", 53)
+        _live_one(f1, "lat", 54)
+        assert f0.load == f1.load == 0.5
+        router = GenerationRouter([f0, f1])
+        b = router.submit(_prompts(1, seed=55)[0], max_new_tokens=3,
+                          tenant="bulk")
+        assert b._engine is f0, "batch did not pack onto the batch replica"
+    finally:
+        f0.close()
+        f1.close()
+
+
+def test_router_rebalance_parked_migration(lm48, tele):
+    """A parked session migrates to a peer replica: eject_parked ->
+    adopt re-prefills the full context there, the ORIGINAL stream keeps
+    delivering, and the final token list is bit-exact with an
+    un-preempted run."""
+    lm, params = lm48
+    _reg("lat:interactive;bulk:batch")
+    (bp,), (ip,) = _prompts(1, seed=60), _prompts(1, seed=61)
+    with GenerationEngine(lm, params, max_slots=1, max_len=48,
+                          buckets=(16, 32)) as base_eng:
+        base = base_eng.generate(bp, max_new_tokens=8)
+    src = GenerationEngine(lm, params, max_slots=1, max_len=48,
+                           buckets=(16, 32), start=False)
+    dst = GenerationEngine(lm, params, max_slots=1, max_len=48,
+                           buckets=(16, 32), start=False)
+    try:
+        b = src.submit(bp, max_new_tokens=8, tenant="bulk")
+        for _ in range(100):
+            if src.live_slots == 1 and len(b.tokens) >= 2:
+                break
+            src._tick_once()
+        i = src.submit(ip, max_new_tokens=20, tenant="lat")
+        src._tick_once()                           # park b, admit i
+        assert src.parked_count == 1
+        router = GenerationRouter([src, dst])
+        mig0 = _counter("serving.generation.qos.migrated")
+        assert router.rebalance_parked() == 1
+        assert _counter("serving.generation.qos.migrated") - mig0 == 1
+        assert src.parked_count == 0
+        assert b._engine is dst                    # stream re-homed
+        for _ in range(400):
+            if b._future.done() and i._future.done():
+                break
+            src._tick_once()
+            dst._tick_once()
+        assert b.result(1) == base, "migrated stream diverged"
+        assert len(i.result(1)) == 20
+    finally:
+        src.close()
+        dst.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: 3-tenant mix over a saturated slab
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_acceptance(lm48, tele):
+    """Interactive trickle + standard traffic + batch flood through a
+    3-slot engine: the slab saturates with batch work, interactive
+    arrivals preempt into the park region, and at the end every tenant's
+    every stream completed bit-exact vs an uncontended run — zero drops
+    for interactive, preempted batch included, zero steady-state
+    compiles."""
+    lm, params = lm48
+    _reg("lat:interactive;api:standard;bulk:batch")
+    bulk_p = _prompts(5, seed=70)
+    api_p = _prompts(2, seed=71)
+    lat_p = _prompts(3, seed=72)
+    with GenerationEngine(lm, params, max_slots=3, max_len=48,
+                          buckets=(16,)) as base_eng:
+        base_bulk = [base_eng.generate(p, max_new_tokens=8) for p in bulk_p]
+        base_api = [base_eng.generate(p, max_new_tokens=5) for p in api_p]
+        base_lat = [base_eng.generate(p, max_new_tokens=3) for p in lat_p]
+
+    eng = GenerationEngine(lm, params, max_slots=3, max_len=48,
+                           buckets=(16,), start=False)
+    try:
+        eng.warm()
+        eng._fork_fn()       # the preemption path's one (shared) program
+        m0 = eng.cache.misses
+        pre0 = _counter("serving.generation.preemptions")
+        bulk_s = [eng.submit(p, max_new_tokens=8, tenant="bulk")
+                  for p in bulk_p]
+        for _ in range(200):                       # saturate the slab
+            if eng.live_slots == 3:
+                break
+            eng._tick_once()
+        assert eng.live_slots == 3 and eng.batch_live == 3
+        api_s = [eng.submit(p, max_new_tokens=5, tenant="api")
+                 for p in api_p]
+        lat_s = [eng.submit(p, max_new_tokens=3, tenant="lat")
+                 for p in lat_p]
+        _drive(eng, bulk_s + api_s + lat_s)
+        # zero interactive drops, batch included — everyone bit-exact
+        assert [s.result(1) for s in lat_s] == base_lat
+        assert [s.result(1) for s in api_s] == base_api
+        assert [s.result(1) for s in bulk_s] == base_bulk
+        assert _counter("serving.generation.preemptions") - pre0 >= 1
+        assert eng.cache.misses == m0, \
+            "the chaos run compiled past the warmed set"
+        assert eng.parked_count == 0 and eng.live_slots == 0
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: labels, prom format, report line, SLO rows
+# ---------------------------------------------------------------------------
+
+
+def test_labeled_names_and_prom_format(tele):
+    """The PINNED Prometheus rendering: labeled qos series become real
+    label sets, one # TYPE header per family however many tenants report
+    under it, unlabeled metrics byte-identical to before."""
+    telemetry.reset()          # pin exact values: drop earlier tests' counts
+    name = telemetry.labeled("qos.admitted", tenant="acme",
+                             **{"class": "interactive"})
+    assert name == "qos.admitted|class=interactive|tenant=acme"
+    telemetry.counter(name).inc(3)
+    telemetry.counter(telemetry.labeled(
+        "qos.admitted", tenant="bulkco", **{"class": "batch"})).inc()
+    telemetry.counter("qos_plain").inc()
+    text = telemetry.prom_text(refresh_memory=False)
+    assert ('mxnet_qos_admitted{class="interactive",tenant="acme"} 3'
+            in text)
+    assert 'mxnet_qos_admitted{class="batch",tenant="bulkco"} 1' in text
+    assert text.count("# TYPE mxnet_qos_admitted counter") == 1
+    assert "mxnet_qos_plain 1" in text             # unlabeled: unchanged
+
+
+def test_telemetry_report_qos_line(tele, tmp_path, capsys):
+    """tools/telemetry_report.py renders the per-class qos summary and
+    names the worst tenant by TTFT p99."""
+    telemetry.reset()          # pin exact values: drop earlier tests' counts
+    for cls, tenant, n in (("interactive", "acme", 7), ("batch", "bulk", 4)):
+        telemetry.counter(telemetry.labeled(
+            "qos.admitted", tenant=tenant, **{"class": cls})).inc(n)
+    telemetry.counter(telemetry.labeled(
+        "qos.rejected", tenant="bulk", **{"class": "batch"})).inc(2)
+    telemetry.counter(telemetry.labeled(
+        "qos.preempted", tenant="bulk", **{"class": "batch"})).inc()
+    for us in (900.0, 1100.0):
+        telemetry.histogram(telemetry.labeled(
+            "qos.ttft_us", tenant="acme", **{"class": "interactive"})
+        ).record(us)
+    telemetry.histogram(telemetry.labeled(
+        "qos.ttft_us", tenant="bulk", **{"class": "batch"})).record(250000.0)
+    path = tmp_path / "telemetry.json"
+    path.write_text(json.dumps(telemetry.snapshot()))
+    from tools import telemetry_report
+
+    assert telemetry_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "qos: interactive 7 admitted, batch 4 admitted/2 rejected/1 " \
+           "preempted" in out
+    assert "worst tenant TTFT p99: bulk 250.00 ms" in out
+
+
+def test_attach_slo_rows():
+    """One sanitized per-tenant TTFT burn objective per declared tenant,
+    idempotent across engine replicas."""
+    reg = qos.TenantRegistry(
+        qos.parse_spec("acme:interactive;bulk:batch"))
+    assert reg.slo_specs() == [
+        "qos.ttft_us|tenant=acme:p99<500ms",
+        "qos.ttft_us|tenant=bulk:p99<10000ms"]
+    prev = health.enabled()
+    health.enable()
+    try:
+        tracker = health.tracker()
+        n0 = len(tracker.objectives)
+        assert qos.attach_slo(reg, tracker) == 2
+        assert qos.attach_slo(reg, tracker) == 0       # idempotent
+        added = tracker.objectives[n0:]
+        assert [o.metric for o in added] == [
+            "qos.ttft_us|tenant=acme", "qos.ttft_us|tenant=bulk"]
+        for o in added:
+            # sample/gauge keys must be label-safe identifiers
+            assert "|" not in o.key and "=" not in o.key
+            assert o.key in tracker._samples
+    finally:
+        health.enable(prev)

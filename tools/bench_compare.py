@@ -70,6 +70,10 @@ METRICS = [
     ("overlap.serving.on.host_gap_us", "overlap serving host gap us", "down"),
     ("overlap.generation.on.host_gap_us",
      "overlap generation host gap us", "down"),
+    # multi-tenant QoS (ISSUE 20): interactive TTFT under a batch flood —
+    # degradation is loaded p99 over unloaded p99, the isolation headline
+    ("qos.interactive_ttft_p99_ms", "qos interactive TTFT p99 ms", "down"),
+    ("qos.ttft_degradation", "qos TTFT degradation (loaded/base)", "down"),
 ]
 
 # roofline utilisation rows (bench.py stamps them per lane from the
@@ -212,6 +216,7 @@ INVARIANTS = [
     ("serving.swap_steady_state_compiles",
      "weight-swap steady-state compiles"),
     ("serving.swap_errors", "weight-swap request errors"),
+    ("qos.qos_steady_state_compiles", "qos steady-state compiles"),
     ("overlap.train.on.steady_state_compiles",
      "overlap train steady-state compiles"),
     ("overlap.train.off.steady_state_compiles",

@@ -58,6 +58,8 @@ METRICS = [
     ("generation.tokens_per_s", "up"),
     ("generation.ttft_p99_ms", "down"),
     ("generation.tick_mbu", "up"),
+    ("qos.interactive_ttft_p99_ms", "down"),
+    ("qos.ttft_degradation", "down"),
     ("train.host_gap_us", "down"),
     ("serving.host_gap_us", "down"),
     ("generation.host_gap_us", "down"),
@@ -171,6 +173,12 @@ def record_from_bench(rec, source="bench.py", historical=False):
         ("tick_mbu", "tick_mbu"), ("mfu", "mfu"),
         ("predicted_floor_s", "predicted_floor_s"),
         ("host_gap_us", "host_gap_us"),
+    ])
+    _lane(lanes, "qos", rec.get("qos"), [
+        ("interactive_ttft_p99_ms", "interactive_ttft_p99_ms"),
+        ("ttft_degradation", "ttft_degradation"),
+        ("preemptions", "preemptions"),
+        ("qos_steady_state_compiles", "qos_steady_state_compiles"),
     ])
     ovl = rec.get("overlap") if isinstance(rec.get("overlap"), dict) else {}
     flat_ovl = {}

@@ -222,6 +222,48 @@ def main(argv=None):
             line = ""
         if line:
             sys.stdout.write(line)
+
+    def _labels(name):
+        # "qos.admitted|class=interactive|tenant=acme" -> {"class": ...}
+        return dict(tok.partition("=")[::2] for tok in name.split("|")[1:])
+
+    qos_admitted = {k: v for k, v in counters.items()
+                    if k.startswith("qos.admitted|")}
+    if qos_admitted:
+        hists = snap.get("histograms", {})
+        by_class = {}
+        for metric in ("admitted", "rejected", "preempted", "resumed"):
+            for k, v in counters.items():
+                if k.startswith(f"qos.{metric}|"):
+                    cls = _labels(k).get("class", "?")
+                    by_class.setdefault(cls, {}).setdefault(metric, 0)
+                    by_class[cls][metric] += v
+        parts = []
+        for cls in ("interactive", "standard", "batch"):
+            row = by_class.get(cls)
+            if not row:
+                continue
+            bit = f"{cls} {row.get('admitted', 0)} admitted"
+            if row.get("rejected"):
+                bit += f"/{row['rejected']} rejected"
+            if row.get("preempted"):
+                bit += f"/{row['preempted']} preempted"
+            parts.append(bit)
+        line = "\nqos: " + ", ".join(parts)
+        # worst tenant by TTFT p99 — the single number a multi-tenant
+        # operator pages on (one noisy neighbour hides inside any average)
+        worst = None
+        for k, h in hists.items():
+            if k.startswith("qos.ttft_us|") and h.get("count"):
+                t = _labels(k).get("tenant", "?")
+                if worst is None or h["p99"] > worst[1]:
+                    worst = (t, h["p99"])
+        if worst is not None:
+            line += (f"; worst tenant TTFT p99: {worst[0]} "
+                     f"{worst[1] / 1e3:.2f} ms")
+        line += ("\n  (per-tenant quotas/classes come from MXNET_QOS_SPEC; "
+                 "docs/faq/perf.md \"Operating a multi-tenant fleet\")\n")
+        sys.stdout.write(line)
     pp_steps = counters.get("pipeline.steps", 0)
     if pp_steps:
         gauges = snap.get("gauges", {})
